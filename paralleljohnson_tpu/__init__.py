@@ -1,0 +1,39 @@
+"""paralleljohnson_tpu — a TPU-native parallel Johnson's-algorithm APSP framework.
+
+A from-scratch rebuild of the capabilities of ``fagan2888/ParallelJohnson``
+(see SURVEY.md; the reference mount was empty, so the attested spec is
+BASELINE.json:5): a ``ParallelJohnsonSolver`` running Bellman-Ford reweighting
+followed by an N-source shortest-path fan-out over a pluggable
+``Backend`` / ``GraphLoader`` boundary — with the compute path designed for
+TPU: XLA edge-relaxation sweeps over CSR, batched min-plus frontier kernels
+(Pallas), source batches sharded across a ``jax.sharding.Mesh``, and an ICI
+all-gather assembling the distance matrix.
+"""
+
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import CSRGraph, load_graph
+from paralleljohnson_tpu.solver import (
+    ConvergenceError,
+    NegativeCycleError,
+    ParallelJohnsonSolver,
+    SolveResult,
+    ValidationError,
+)
+from paralleljohnson_tpu.backends import Backend, available_backends, get_backend
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Backend",
+    "CSRGraph",
+    "ConvergenceError",
+    "NegativeCycleError",
+    "ValidationError",
+    "ParallelJohnsonSolver",
+    "SolveResult",
+    "SolverConfig",
+    "available_backends",
+    "get_backend",
+    "load_graph",
+    "__version__",
+]

@@ -1,0 +1,7 @@
+"""``python -m paralleljohnson_tpu`` entry point."""
+
+import sys
+
+from paralleljohnson_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,29 @@
+"""Backend plugin registry (SURVEY.md §2 #4).
+
+Importing this package registers the built-in backends:
+``numpy`` (scipy-backed reference), ``jax`` (TPU/XLA), and — when the
+native library is buildable — ``cpp`` (C++/OpenMP).
+"""
+
+from paralleljohnson_tpu.backends.base import (
+    Backend,
+    KernelResult,
+    available_backends,
+    get_backend,
+    register_backend,
+)
+import paralleljohnson_tpu.backends.numpy_backend  # noqa: F401  (registers)
+import paralleljohnson_tpu.backends.jax_backend  # noqa: F401  (registers)
+
+try:  # native backend is optional: needs a working g++ at first use
+    import paralleljohnson_tpu.backends.cpp_backend  # noqa: F401
+except Exception:  # pragma: no cover
+    pass
+
+__all__ = [
+    "Backend",
+    "KernelResult",
+    "available_backends",
+    "get_backend",
+    "register_backend",
+]

@@ -1,0 +1,131 @@
+"""The ``Backend`` plugin boundary (SURVEY.md §2 #4, BASELINE.json:5).
+
+A backend owns device-resident graph buffers and the two numeric kernels of
+Johnson's algorithm: the Bellman-Ford edge-relaxation pass and the N-source
+non-negative shortest-path fan-out. The solver orchestrates phases through
+this interface, so CPU/OpenMP <-> TPU substitution happens exactly here —
+the architectural seam the reference attests ("The existing `Backend` /
+`GraphLoader` plugin boundary gains a `JaxBackend`").
+
+Kernel results carry a ``negative_cycle`` flag instead of raising, so
+device backends can stay jit-compatible; the solver raises host-side.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import CSRGraph
+
+
+@dataclasses.dataclass
+class KernelResult:
+    """Output of one backend kernel invocation.
+
+    dist: [V] (single-source) or [B, V] (multi-source) distances, +inf for
+      unreachable.
+    negative_cycle: True iff a negative cycle is reachable (Bellman-Ford
+      only; always False for the non-negative fan-out). Only claimed when
+      the kernel ran the full |V|-sweep Bellman-Ford bound — a user-capped
+      ``max_iterations`` below |V| yields converged=False instead, never a
+      spurious cycle report.
+    converged: False iff the kernel hit its iteration cap while distances
+      were still improving (the solver raises ConvergenceError host-side).
+    iterations: relaxation sweeps (sweep backends) or 0 (heap Dijkstra).
+    edges_relaxed: edge relaxations performed — the attested instrumentation
+      metric (BASELINE.json:2 "edges-relaxed/sec/chip"). Convention: a sweep
+      counts every edge it scans; heap Dijkstra counts edges scanned from
+      settled vertices.
+    """
+
+    dist: np.ndarray
+    negative_cycle: bool = False
+    iterations: int = 0
+    edges_relaxed: int = 0
+    converged: bool = True
+
+
+class Backend(abc.ABC):
+    """Execution engine behind the solver. Subclass + register to plug in."""
+
+    name: str = "abstract"
+
+    def __init__(self, config: SolverConfig | None = None) -> None:
+        self.config = config or SolverConfig()
+
+    # -- device residency ---------------------------------------------------
+
+    @abc.abstractmethod
+    def upload(self, graph: CSRGraph) -> Any:
+        """Move CSR buffers to execution memory (HBM on TPU; no-op on CPU).
+
+        Returns an opaque device-graph handle accepted by the kernels below.
+        """
+
+    # -- kernels ------------------------------------------------------------
+
+    @abc.abstractmethod
+    def bellman_ford(self, dgraph: Any, source: int | None) -> KernelResult:
+        """SSSP with negative weights from ``source``.
+
+        ``source=None`` runs the virtual-source variant used for Johnson
+        potentials: dist starts at 0 for every vertex (equivalent to a
+        virtual vertex q with 0-weight edges to all, SURVEY.md §3.1, without
+        materializing it).
+        """
+
+    @abc.abstractmethod
+    def multi_source(self, dgraph: Any, sources: np.ndarray) -> KernelResult:
+        """N-source shortest paths on a non-negative graph ("Dijkstra
+        fan-out"). Returns dist[B, V] in the order of ``sources``."""
+
+    # -- optional fast paths (defaults compose the kernels host-side) -------
+
+    def reweight(self, dgraph: Any, potentials: np.ndarray) -> Any:
+        """Return a device graph with w'(u,v) = w + h(u) - h(v) (>= 0)."""
+        graph = self.download_graph(dgraph)
+        h = np.asarray(potentials, graph.dtype)
+        wp = graph.weights + h[graph.src] - h[graph.indices]
+        # Guard tiny negative float residue so the fan-out's non-negativity
+        # precondition holds exactly.
+        return self.upload(graph.with_weights(np.maximum(wp, 0.0)))
+
+    def batch_apsp(self, batch: dict[str, np.ndarray]) -> KernelResult:
+        """Many-small-graphs mode (BASELINE.json:11): APSP for a padded
+        batch (see ``stack_graphs``). Returns dist[B, V, V]. Backends with a
+        vectorized path override this; the default loops host-side."""
+        raise NotImplementedError(f"{self.name} has no batch_apsp")
+
+    def download_graph(self, dgraph: Any) -> CSRGraph:
+        """Inverse of upload, for host-side composition/debug."""
+        raise NotImplementedError(f"{self.name} cannot download graphs")
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<{type(self).__name__} name={self.name!r}>"
+
+
+_BACKENDS: dict[str, type[Backend]] = {}
+
+
+def register_backend(name: str, cls: type[Backend]) -> None:
+    _BACKENDS[name] = cls
+
+
+def available_backends() -> list[str]:
+    return sorted(_BACKENDS)
+
+
+def get_backend(name: str, config: SolverConfig | None = None) -> Backend:
+    """Instantiate a registered backend — the attested ``backend=`` switch."""
+    try:
+        cls = _BACKENDS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown backend {name!r}; available: {available_backends()}"
+        ) from None
+    return cls(config)

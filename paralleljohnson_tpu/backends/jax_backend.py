@@ -1,0 +1,220 @@
+"""``JaxBackend`` — the TPU execution engine (SURVEY.md §7 step 4).
+
+The attested goal (BASELINE.json:5): Bellman-Ford as a vmapped
+edge-relaxation scan over CSR, the N-source phase as batched min-plus
+frontier relaxation, source batches sharded across the TPU mesh, and an ICI
+all-gather of distance rows. This backend owns the HBM-resident CSR buffers
+and the jitted kernels; sharding lives in ``paralleljohnson_tpu.parallel``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paralleljohnson_tpu.backends.base import Backend, KernelResult, register_backend
+from paralleljohnson_tpu.graphs import CSRGraph
+from paralleljohnson_tpu.ops import relax
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxDeviceGraph:
+    """HBM-resident COO/CSR buffers (padded edges are (0, 0, +inf) no-ops)."""
+
+    src: jax.Array      # int32[E_pad]
+    dst: jax.Array      # int32[E_pad]
+    weights: jax.Array  # f32[E_pad]
+    indptr: np.ndarray  # host-side int32[V+1] (row structure, rarely needed)
+    num_nodes: int
+    num_real_edges: int
+
+
+def _edge_chunk_for(batch: int, num_edges: int, budget_elems: int = 1 << 26) -> int:
+    """Bound the [B, chunk] relaxation intermediate to ~``budget_elems``
+    floats (256 MB at f32) regardless of graph size."""
+    chunk = max(1, budget_elems // max(batch, 1))
+    return int(min(max(chunk, 1 << 12), max(num_edges, 1)))
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "edge_chunk"))
+def _bf_kernel(dist0, src, dst, w, *, max_iter: int, edge_chunk: int):
+    return relax.bellman_ford_sweeps(
+        dist0, src, dst, w, max_iter=max_iter, edge_chunk=edge_chunk
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_nodes", "max_iter", "edge_chunk")
+)
+def _fanout_kernel(
+    sources, src, dst, w, *, num_nodes: int, max_iter: int, edge_chunk: int
+):
+    dist0 = relax.multi_source_init(sources, num_nodes, dtype=w.dtype)
+    return relax.bellman_ford_sweeps(
+        dist0, src, dst, w, max_iter=max_iter, edge_chunk=edge_chunk
+    )
+
+
+_reweight_kernel = jax.jit(relax.reweight_weights)
+
+
+@functools.partial(jax.jit, static_argnames=("num_nodes", "max_iter"))
+def _dense_fanout_kernel(sources, src, dst, w, *, num_nodes: int, max_iter: int):
+    a = relax.dense_adjacency(src, dst, w, num_nodes, dtype=w.dtype)
+    return relax.dense_fanout(a, sources, max_iter=max_iter)
+
+
+@functools.partial(jax.jit, static_argnames=("num_nodes", "graph_chunk"))
+def _batch_johnson_kernel(src, dst, w, *, num_nodes: int, graph_chunk: int):
+    """Johnson APSP vmapped over a padded batch of graphs
+    (BASELINE.json:11). Per graph: virtual-source BF (one no-op sweep on
+    non-negative graphs), reweight, V-source sweeps, un-reweight. Graphs
+    are streamed in ``graph_chunk`` slabs via lax.map to bound HBM."""
+    v = num_nodes
+    eye0 = jnp.where(jnp.eye(v, dtype=bool), 0.0, jnp.inf).astype(w.dtype)
+
+    def per_graph(args):
+        s, t, wt = args
+        h, _, neg = relax.bellman_ford_sweeps(
+            jnp.zeros(v, wt.dtype), s, t, wt, max_iter=v
+        )
+        wp = relax.reweight_weights(wt, s, t, h)
+        dist, iters, _ = relax.bellman_ford_sweeps(
+            eye0, s, t, wp, max_iter=v
+        )
+        dist = dist - h[:, None] + h[None, :]
+        return dist, iters, neg
+
+    g = src.shape[0]
+    chunk = min(graph_chunk, g)
+    nb = -(-g // chunk)
+    pad = nb * chunk - g
+
+    def pad_g(x):
+        if not pad:
+            return x
+        fill = jnp.full((pad, x.shape[1]), jnp.inf, x.dtype) if jnp.issubdtype(
+            x.dtype, jnp.floating
+        ) else jnp.zeros((pad, x.shape[1]), x.dtype)
+        return jnp.concatenate([x, fill])
+
+    src, dst, w = pad_g(src), pad_g(dst), pad_g(w)
+    reshape = lambda x: x.reshape(nb, chunk, x.shape[1])
+    dist, iters, neg = jax.lax.map(
+        jax.vmap(per_graph), (reshape(src), reshape(dst), reshape(w))
+    )
+    unchunk = lambda x: x.reshape(nb * chunk, *x.shape[2:])[:g]
+    return unchunk(dist), unchunk(iters), unchunk(neg)
+
+
+class JaxBackend(Backend):
+    """XLA/TPU backend: jitted frontier sweeps, device-resident buffers."""
+
+    name = "jax"
+
+    @property
+    def _dtype(self):
+        if self.config.precision == "f64" and not jax.config.jax_enable_x64:
+            raise ValueError(
+                "precision=f64 on the jax backend requires jax_enable_x64"
+            )
+        return jnp.float64 if self.config.precision == "f64" else jnp.float32
+
+    def upload(self, graph: CSRGraph) -> JaxDeviceGraph:
+        g = graph.pad_edges(self.config.edge_pad_multiple)
+        return JaxDeviceGraph(
+            src=jnp.asarray(g.src, jnp.int32),
+            dst=jnp.asarray(g.indices, jnp.int32),
+            weights=jnp.asarray(g.weights, self._dtype),
+            indptr=graph.indptr,
+            num_nodes=graph.num_nodes,
+            num_real_edges=graph.num_real_edges,
+        )
+
+    def download_graph(self, dgraph: JaxDeviceGraph) -> CSRGraph:
+        e = dgraph.num_real_edges
+        g = CSRGraph(
+            indptr=dgraph.indptr,
+            indices=np.asarray(dgraph.dst)[:e],
+            weights=np.asarray(dgraph.weights)[:e],
+        )
+        g.__dict__["_src"] = np.asarray(dgraph.src)[:e]
+        return g
+
+    def bellman_ford(self, dgraph: JaxDeviceGraph, source: int | None) -> KernelResult:
+        v = dgraph.num_nodes
+        if source is None:
+            dist0 = jnp.zeros(v, self._dtype)
+        else:
+            dist0 = jnp.full(v, jnp.inf, self._dtype).at[source].set(0.0)
+        max_iter = self.config.max_iterations or v
+        chunk = _edge_chunk_for(1, dgraph.src.shape[0])
+        dist, iters, improving = _bf_kernel(
+            dist0, dgraph.src, dgraph.dst, dgraph.weights,
+            max_iter=max_iter, edge_chunk=chunk,
+        )
+        iters = int(iters)
+        improving = bool(improving)
+        return KernelResult(
+            dist=np.asarray(dist),
+            negative_cycle=improving and max_iter >= v,
+            converged=not improving,
+            iterations=iters,
+            edges_relaxed=iters * dgraph.num_real_edges,
+        )
+
+    def multi_source(self, dgraph: JaxDeviceGraph, sources: np.ndarray) -> KernelResult:
+        v = dgraph.num_nodes
+        sources = jnp.asarray(sources, jnp.int32)
+        max_iter = self.config.max_iterations or v
+        if v <= self.config.dense_threshold:
+            dist, iters, improving = _dense_fanout_kernel(
+                sources, dgraph.src, dgraph.dst, dgraph.weights,
+                num_nodes=v, max_iter=max_iter,
+            )
+        else:
+            chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
+            dist, iters, improving = _fanout_kernel(
+                sources, dgraph.src, dgraph.dst, dgraph.weights,
+                num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+            )
+        iters = int(iters)
+        return KernelResult(
+            dist=np.asarray(dist),
+            converged=not bool(improving),
+            iterations=iters,
+            edges_relaxed=iters * dgraph.num_real_edges * int(sources.shape[0]),
+        )
+
+    def reweight(self, dgraph: JaxDeviceGraph, potentials) -> JaxDeviceGraph:
+        h = jnp.asarray(potentials, self._dtype)
+        return dataclasses.replace(
+            dgraph,
+            weights=_reweight_kernel(dgraph.weights, dgraph.src, dgraph.dst, h),
+        )
+
+    def batch_apsp(self, batch: dict[str, np.ndarray]) -> KernelResult:
+        src = jnp.asarray(batch["src"], jnp.int32)
+        dst = jnp.asarray(batch["dst"], jnp.int32)
+        w = jnp.asarray(batch["weights"], self._dtype)
+        v = int(batch["v_max"])
+        g, e = src.shape
+        # Bound the per-slab [chunk, V, E] relaxation intermediate.
+        slab = max(1, (1 << 26) // max(v * e, 1))
+        dist, iters, neg = _batch_johnson_kernel(
+            src, dst, w, num_nodes=v, graph_chunk=slab
+        )
+        total_iters = int(jnp.sum(iters))
+        return KernelResult(
+            dist=np.asarray(dist),
+            negative_cycle=bool(jnp.any(neg)),
+            iterations=int(jnp.max(iters)),
+            edges_relaxed=total_iters * e * v,
+        )
+
+
+register_backend("jax", JaxBackend)

@@ -1,0 +1,163 @@
+"""Command-line interface (SURVEY.md §2 #15).
+
+Subcommands mirror the solver API and the attested benchmark configs:
+
+  pjtpu solve  <graphspec> [--backend jax] [--sources 0,5,9 | --num-sources K]
+  pjtpu sssp   <graphspec> --source S
+  pjtpu batch  <n> <nodes> <p>          # many-small-graphs mode
+  pjtpu info                            # devices / backends / loaders
+
+Graph specs are anything ``load_graph`` accepts: a path (.gr/.txt) or a
+scheme spec like ``er:n=1000,p=0.01`` / ``rmat:scale=20``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--backend", default="jax", help="execution backend")
+    p.add_argument("--precision", default="f32", choices=["f32", "f64"])
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="sources per device batch")
+    p.add_argument("--max-iterations", type=int, default=None)
+    p.add_argument("--dense-threshold", type=int, default=1024)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--validate", action="store_true",
+                   help="cross-check against the scipy oracle (slow)")
+    p.add_argument("--output", default=None, help="write result .npz here")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one machine-readable JSON line")
+
+
+def _config(args) -> "SolverConfig":
+    from paralleljohnson_tpu.config import SolverConfig
+
+    return SolverConfig(
+        backend=args.backend,
+        precision=args.precision,
+        source_batch_size=args.batch_size,
+        max_iterations=args.max_iterations,
+        dense_threshold=args.dense_threshold,
+        checkpoint_dir=args.checkpoint_dir,
+        validate=args.validate,
+    )
+
+
+def _report(res, args) -> None:
+    finite = float(np.isfinite(res.dist).mean())
+    payload = {
+        "shape": list(res.dist.shape),
+        "finite_fraction": round(finite, 6),
+        **res.stats.as_dict(),
+    }
+    if args.output:
+        np.savez_compressed(args.output, dist=res.dist, sources=res.sources,
+                            potentials=res.potentials)
+        payload["output"] = args.output
+    if args.as_json:
+        print(json.dumps(payload))
+    else:
+        print(f"distances: {res.dist.shape}, {finite:.1%} finite")
+        for phase, secs in res.stats.phase_seconds.items():
+            print(f"  {phase:>14s}: {secs * 1e3:9.2f} ms")
+        print(f"  edges relaxed: {res.stats.edges_relaxed:,} "
+              f"({res.stats.edges_relaxed_per_second():,.0f}/s)")
+        if args.output:
+            print(f"  wrote {args.output}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="pjtpu",
+        description="TPU-native parallel Johnson's-algorithm APSP solver",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_solve = sub.add_parser("solve", help="Johnson APSP (all or some sources)")
+    p_solve.add_argument("graph", help="path or loader spec")
+    p_solve.add_argument("--sources", default=None,
+                         help="comma-separated source vertices (default: all)")
+    p_solve.add_argument("--num-sources", type=int, default=None,
+                         help="solve the first K sources only")
+    _add_common(p_solve)
+
+    p_sssp = sub.add_parser("sssp", help="single-source Bellman-Ford")
+    p_sssp.add_argument("graph")
+    p_sssp.add_argument("--source", type=int, required=True)
+    _add_common(p_sssp)
+
+    p_batch = sub.add_parser("batch", help="many-small-graphs vmapped APSP")
+    p_batch.add_argument("count", type=int)
+    p_batch.add_argument("nodes", type=int)
+    p_batch.add_argument("p", type=float)
+    p_batch.add_argument("--seed", type=int, default=0)
+    _add_common(p_batch)
+
+    p_info = sub.add_parser("info", help="environment / plugin summary")
+    p_info.add_argument("--json", action="store_true", dest="as_json")
+
+    args = parser.parse_args(argv)
+
+    from paralleljohnson_tpu import (
+        NegativeCycleError,
+        ParallelJohnsonSolver,
+        available_backends,
+        load_graph,
+    )
+    from paralleljohnson_tpu.graphs import available_loaders, random_graph_batch
+
+    if args.command == "info":
+        import jax
+
+        info = {
+            "backends": available_backends(),
+            "loaders": available_loaders(),
+            "devices": [str(d) for d in jax.devices()],
+            "default_backend_platform": jax.default_backend(),
+        }
+        print(json.dumps(info, indent=None if args.as_json else 2))
+        return 0
+
+    try:
+        if args.command == "solve":
+            g = load_graph(args.graph)
+            sources = None
+            if args.sources is not None:
+                sources = np.array([int(s) for s in args.sources.split(",")])
+            elif args.num_sources is not None:
+                sources = np.arange(args.num_sources)
+            res = ParallelJohnsonSolver(_config(args)).solve(g, sources=sources)
+            _report(res, args)
+        elif args.command == "sssp":
+            g = load_graph(args.graph)
+            res = ParallelJohnsonSolver(_config(args)).sssp(g, args.source)
+            _report(res, args)
+        elif args.command == "batch":
+            graphs = random_graph_batch(args.count, args.nodes, args.p,
+                                        seed=args.seed)
+            results = ParallelJohnsonSolver(_config(args)).solve_batch(graphs)
+            stats = results[0].stats
+            payload = {"graphs": len(results),
+                       "matrix_shape": list(results[0].dist.shape),
+                       **stats.as_dict()}
+            print(json.dumps(payload) if args.as_json else
+                  f"{len(results)} graphs solved; " +
+                  f"{stats.total_seconds:.3f}s total, "
+                  f"{stats.edges_relaxed:,} edges relaxed")
+    except NegativeCycleError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except (ValueError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Solver configuration (SURVEY.md §5 "Config / flag system").
+
+The attested reference surface is a ``backend=`` switch (BASELINE.json:5);
+the rebuild widens it to a small dataclass mirrored by CLI flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SolverConfig:
+    """Knobs for :class:`~paralleljohnson_tpu.solver.ParallelJohnsonSolver`.
+
+    Attributes:
+      backend: execution engine name — ``"jax"`` (TPU/XLA path), ``"numpy"``
+        (scipy oracle-backed), ``"cpp"`` (native C++/OpenMP), as registered
+        in :mod:`paralleljohnson_tpu.backends`.
+      precision: ``"f32"`` or ``"f64"`` (f64 only meaningful off-TPU).
+      source_batch_size: sources solved per device batch in the N-source
+        phase; ``None`` picks a batch that fits VMEM/HBM heuristically.
+      mesh_shape: devices along the ``("sources",)`` mesh axis; ``None``
+        uses every visible device. Consumed by
+        :mod:`paralleljohnson_tpu.parallel` when the jax backend shards the
+        fan-out.
+      max_iterations: cap on relaxation sweeps; ``None`` = |V| (the
+        Bellman-Ford bound).
+      dense_threshold: graphs with V <= threshold use the dense min-plus
+        (MXU-friendly) path instead of the sparse CSR sweep.
+      edge_pad_multiple: pad E to this multiple for stable jit shapes.
+      checkpoint_dir: if set, per-source-batch distance rows are saved here
+        and resumed after preemption (SURVEY.md §5 checkpoint/resume).
+      validate: cross-check results against the scipy oracle (slow; tests).
+    """
+
+    backend: str = "jax"
+    precision: str = "f32"
+    source_batch_size: int | None = None
+    mesh_shape: tuple[int, ...] | None = None
+    max_iterations: int | None = None
+    dense_threshold: int = 1024
+    edge_pad_multiple: int = 512
+    checkpoint_dir: str | None = None
+    validate: bool = False
+
+    @property
+    def np_dtype(self):
+        return {"f32": np.float32, "f64": np.float64}[self.precision]
+
+    def __post_init__(self) -> None:
+        if self.precision not in ("f32", "f64"):
+            raise ValueError(f"precision must be f32/f64, got {self.precision!r}")

@@ -1,0 +1,31 @@
+"""Graph representation, loaders, and generators (SURVEY.md §2 #5, #7-#11)."""
+
+from paralleljohnson_tpu.graphs.csr import CSRGraph, PAD_WEIGHT, stack_graphs
+from paralleljohnson_tpu.graphs.generators import (
+    erdos_renyi,
+    random_dag,
+    random_graph_batch,
+    rmat,
+)
+from paralleljohnson_tpu.graphs.loaders import load_dimacs, load_snap, save_dimacs
+from paralleljohnson_tpu.graphs.registry import (
+    available_loaders,
+    load_graph,
+    register_loader,
+)
+
+__all__ = [
+    "CSRGraph",
+    "PAD_WEIGHT",
+    "available_loaders",
+    "erdos_renyi",
+    "load_dimacs",
+    "load_graph",
+    "load_snap",
+    "random_dag",
+    "random_graph_batch",
+    "register_loader",
+    "rmat",
+    "save_dimacs",
+    "stack_graphs",
+]

@@ -1,0 +1,257 @@
+"""CSR graph representation — the buffer format every backend consumes.
+
+Rebuild of the reference's attested CSR edge list (SURVEY.md §2 #5,
+BASELINE.json:5 "a vmapped edge-relaxation scan over a CSR edge list").
+Host-side arrays are numpy; backends move them to device memory at upload.
+
+Layout:
+  - ``indptr``  : int32[V+1]  — row pointers (out-edges of vertex u are
+                  ``indices[indptr[u]:indptr[u+1]]``)
+  - ``indices`` : int32[E]    — destination vertex of each edge
+  - ``weights`` : f32/f64[E]  — edge weights (negative allowed)
+  - ``src``     : int32[E]    — cached COO source column (derived from
+                  indptr); the relaxation sweep is a gather on ``src`` and a
+                  scatter-min on ``indices``, so both columns are kept hot.
+
+Padding convention: padded edges are ``(src=0, dst=0, w=+inf)`` self-loops —
+``dist[0] + inf == inf`` never wins a min, so padded edges are relaxation
+no-ops with no masking needed inside kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+PAD_WEIGHT = np.inf
+
+
+@dataclasses.dataclass(frozen=True)
+class CSRGraph:
+    """An immutable directed weighted graph in CSR form."""
+
+    indptr: np.ndarray   # int32[V+1]
+    indices: np.ndarray  # int32[E]
+    weights: np.ndarray  # float32/float64[E]
+
+    def __post_init__(self) -> None:
+        indptr = np.ascontiguousarray(self.indptr, dtype=np.int32)
+        indices = np.ascontiguousarray(self.indices, dtype=np.int32)
+        weights = np.ascontiguousarray(self.weights)
+        if weights.dtype not in (np.float32, np.float64):
+            weights = weights.astype(np.float32)
+        object.__setattr__(self, "indptr", indptr)
+        object.__setattr__(self, "indices", indices)
+        object.__setattr__(self, "weights", weights)
+        if indptr.ndim != 1 or indices.ndim != 1 or weights.ndim != 1:
+            raise ValueError("CSR arrays must be 1-D")
+        if len(indices) != len(weights):
+            raise ValueError(
+                f"indices ({len(indices)}) and weights ({len(weights)}) disagree"
+            )
+        # indptr[-1] may be < len(indices): the tail is edge padding
+        # (no-op edges that belong to no CSR row — see pad_edges).
+        if len(indptr) == 0 or indptr[0] != 0 or indptr[-1] > len(indices):
+            raise ValueError("indptr must start at 0 and end at <= num_edges")
+        if np.any(np.diff(indptr) < 0):
+            raise ValueError("indptr must be non-decreasing")
+        if len(indices) and (indices.min() < 0 or indices.max() >= self.num_nodes):
+            raise ValueError("edge destination out of range")
+
+    # -- basic properties ---------------------------------------------------
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.indptr) - 1
+
+    @property
+    def num_edges(self) -> int:
+        return len(self.indices)
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self.weights.dtype
+
+    @property
+    def src(self) -> np.ndarray:
+        """COO source column, cached after first use."""
+        cached = self.__dict__.get("_src")
+        if cached is None:
+            cached = np.repeat(
+                np.arange(self.num_nodes, dtype=np.int32), np.diff(self.indptr)
+            )
+            pad = self.num_edges - len(cached)  # edge-padding tail -> vertex 0
+            if pad:
+                cached = np.concatenate([cached, np.zeros(pad, np.int32)])
+            self.__dict__["_src"] = cached
+        return cached
+
+    @property
+    def dst(self) -> np.ndarray:
+        """Alias for ``indices`` to pair with :attr:`src`."""
+        return self.indices
+
+    @property
+    def has_negative_weights(self) -> bool:
+        return bool(self.num_edges) and bool((self.weights < 0).any())
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def from_edges(
+        src: Sequence[int] | np.ndarray,
+        dst: Sequence[int] | np.ndarray,
+        weights: Sequence[float] | np.ndarray,
+        num_nodes: int | None = None,
+        *,
+        dedupe: bool = True,
+        dtype: np.dtype | type = np.float32,
+    ) -> "CSRGraph":
+        """Build CSR from a COO edge list.
+
+        Canonicalizes: sorts by (src, dst); with ``dedupe`` keeps the minimum
+        weight among parallel edges (the shortest-path-relevant one).
+        """
+        src = np.asarray(src, dtype=np.int64)
+        dst = np.asarray(dst, dtype=np.int64)
+        weights = np.asarray(weights, dtype=dtype)
+        if not (len(src) == len(dst) == len(weights)):
+            raise ValueError("src/dst/weights length mismatch")
+        if num_nodes is None:
+            num_nodes = int(max(src.max(), dst.max())) + 1 if len(src) else 0
+        if len(src) and (src.min() < 0 or dst.min() < 0):
+            raise ValueError("negative vertex id")
+        if len(src) and (src.max() >= num_nodes or dst.max() >= num_nodes):
+            raise ValueError("vertex id out of range")
+
+        if len(src):
+            # Sort by (src, dst, weight) so dedupe-keep-first keeps min weight.
+            order = np.lexsort((weights, dst, src))
+            src, dst, weights = src[order], dst[order], weights[order]
+            if dedupe:
+                keep = np.ones(len(src), dtype=bool)
+                keep[1:] = (src[1:] != src[:-1]) | (dst[1:] != dst[:-1])
+                src, dst, weights = src[keep], dst[keep], weights[keep]
+
+        indptr = np.zeros(num_nodes + 1, dtype=np.int64)
+        np.add.at(indptr, src + 1, 1)
+        np.cumsum(indptr, out=indptr)
+        return CSRGraph(
+            indptr=indptr.astype(np.int32),
+            indices=dst.astype(np.int32),
+            weights=weights,
+        )
+
+    @staticmethod
+    def from_scipy(mat) -> "CSRGraph":
+        """From a scipy sparse matrix (any format); explicit zeros are kept."""
+        csr = mat.tocsr()
+        return CSRGraph(
+            indptr=csr.indptr.astype(np.int32),
+            indices=csr.indices.astype(np.int32),
+            weights=np.asarray(csr.data),
+        )
+
+    # -- conversions --------------------------------------------------------
+
+    def to_scipy(self):
+        """To ``scipy.sparse.csr_matrix`` for oracle comparisons.
+
+        Zero-weight edges stay explicitly stored; scipy's csgraph routines
+        treat explicitly-stored sparse zeros as true zero-weight edges.
+        """
+        import scipy.sparse as sp
+
+        return sp.csr_matrix(
+            (self.weights, self.indices, self.indptr),
+            shape=(self.num_nodes, self.num_nodes),
+        )
+
+    def to_dense(self, fill: float = np.inf) -> np.ndarray:
+        """Dense adjacency with ``fill`` for absent edges and 0 diagonal kept
+        only if a self-loop exists (absent self-edges stay ``fill``)."""
+        out = np.full((self.num_nodes, self.num_nodes), fill, dtype=self.dtype)
+        out[self.src, self.indices] = self.weights
+        return out
+
+    def with_weights(self, weights: np.ndarray) -> "CSRGraph":
+        """Same structure, new weights (used for reweighting)."""
+        return CSRGraph(indptr=self.indptr, indices=self.indices, weights=weights)
+
+    def astype(self, dtype) -> "CSRGraph":
+        return self.with_weights(self.weights.astype(dtype))
+
+    # -- padding ------------------------------------------------------------
+
+    def pad_edges(self, multiple: int = 128) -> "CSRGraph":
+        """Pad the edge arrays to a multiple of ``multiple`` with no-op edges.
+
+        Padded edges are (0 -> 0, +inf): they never change a distance, so
+        kernels need no masks. ``indptr`` is NOT updated — padded edges
+        belong to no CSR row; they only exist in the COO view. Kernels that
+        operate on the COO columns (src/dst/weights) see them; row-wise CSR
+        consumers use ``indptr`` and never touch them.
+        """
+        e = self.num_edges
+        target = ((e + multiple - 1) // multiple) * multiple if e else multiple
+        if target == e:
+            return self
+        pad = target - e
+        return CSRGraph(
+            indptr=self.indptr,
+            indices=np.concatenate([self.indices, np.zeros(pad, np.int32)]),
+            weights=np.concatenate(
+                [self.weights, np.full(pad, PAD_WEIGHT, self.dtype)]
+            ),
+        )
+
+    @property
+    def num_real_edges(self) -> int:
+        """Edge count before padding (== num_edges if unpadded); the CSR row
+        structure only ever covers real edges, so this is ``indptr[-1]``."""
+        return int(self.indptr[-1])
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"CSRGraph(V={self.num_nodes}, E={self.num_edges}, "
+            f"dtype={self.dtype}, neg={self.has_negative_weights})"
+        )
+
+
+def stack_graphs(
+    graphs: Sequence[CSRGraph],
+    *,
+    num_nodes: int | None = None,
+    num_edges: int | None = None,
+) -> dict[str, np.ndarray]:
+    """Pad a batch of graphs to uniform (V, E) and stack the COO columns.
+
+    Returns a dict of batched arrays for the vmapped solver path
+    (SURVEY.md §3.4): ``src``/``dst`` int32[B, E_max], ``weights`` [B, E_max],
+    ``num_nodes`` int32[B] (true sizes), with padding edges (0, 0, +inf).
+    Vertices are NOT remapped; each graph keeps ids in [0, V_i). Distance
+    rows for padded vertices of smaller graphs come out +inf (unreachable),
+    d(v,v)=0 excepted — callers slice to the true V_i.
+    """
+    if not graphs:
+        raise ValueError("empty batch")
+    v_max = num_nodes or max(g.num_nodes for g in graphs)
+    e_max = num_edges or max(g.num_edges for g in graphs)
+    if any(g.num_nodes > v_max or g.num_edges > e_max for g in graphs):
+        raise ValueError("explicit num_nodes/num_edges smaller than a graph")
+    b = len(graphs)
+    dtype = np.result_type(*[g.dtype for g in graphs])
+    src = np.zeros((b, e_max), np.int32)
+    dst = np.zeros((b, e_max), np.int32)
+    wts = np.full((b, e_max), PAD_WEIGHT, dtype)
+    sizes = np.zeros(b, np.int32)
+    for i, g in enumerate(graphs):
+        e = g.num_edges
+        src[i, :e] = g.src
+        dst[i, :e] = g.indices
+        wts[i, :e] = g.weights
+        sizes[i] = g.num_nodes
+    return {"src": src, "dst": dst, "weights": wts, "num_nodes": sizes,
+            "v_max": v_max}

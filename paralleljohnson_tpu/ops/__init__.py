@@ -1,0 +1,5 @@
+"""Numeric kernels: XLA relaxation primitives and Pallas kernels."""
+
+from paralleljohnson_tpu.ops import relax
+
+__all__ = ["relax"]

@@ -1,0 +1,212 @@
+"""Core XLA relaxation primitives — the TPU-native replacement for the
+reference's OpenMP edge-relaxation loops (SURVEY.md §2 #6 rebuild mapping).
+
+Design notes (TPU-first):
+  - No priority queue exists on TPU; both phases are formulated as batched
+    min-plus frontier sweeps over the COO edge arrays (gather on ``src``,
+    deterministic scatter-min via ``segment_min`` on ``dst``), iterated to
+    fixpoint under ``lax.while_loop`` — compiler-friendly static shapes,
+    data-dependent trip count only in the loop condition.
+  - Edge arrays are streamed in chunks with ``lax.scan`` so the [B, E_chunk]
+    relaxation intermediate stays bounded regardless of graph size (the
+    HBM-bandwidth analogue of blockwise attention streaming). The carried
+    distances make later chunks see earlier updates within one sweep
+    (Gauss-Seidel flavored — monotone relaxation keeps this correct and it
+    converges no slower than Jacobi sweeps).
+  - A dense min-plus product (``minplus``) serves small/dense graphs where
+    the O(V^2) formulation beats gather/scatter, and min-plus matrix
+    squaring gives log2(diameter) convergence for batched small-graph APSP.
+
+All functions are shape-polymorphic pure functions, safe under jit/vmap/
+shard_map; the wrappers in ``jax_backend`` own jit caching.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+INF = jnp.inf
+
+
+def _chunk_edges(src, dst, w, chunk: int):
+    """Pad E to a multiple of ``chunk`` with no-op (0, 0, +inf) edges and
+    reshape to [n_chunks, chunk] for lax.scan streaming."""
+    e = src.shape[0]
+    n_chunks = max(1, -(-e // chunk))
+    pad = n_chunks * chunk - e
+    if pad:
+        src = jnp.concatenate([src, jnp.zeros(pad, src.dtype)])
+        dst = jnp.concatenate([dst, jnp.zeros(pad, dst.dtype)])
+        w = jnp.concatenate([w, jnp.full(pad, INF, w.dtype)])
+    return (
+        src.reshape(n_chunks, chunk),
+        dst.reshape(n_chunks, chunk),
+        w.reshape(n_chunks, chunk),
+    )
+
+
+def relax_sweep(dist, src, dst, w, *, edge_chunk: int = 1 << 20):
+    """One full relaxation sweep: dist'[.., v] = min(dist[.., v],
+    min over edges (u->v) of dist[.., u] + w).
+
+    dist: [V] or [B, V]. Edges are streamed in ``edge_chunk`` blocks; within
+    a block the scatter-min is a flattened ``segment_min`` (deterministic).
+    """
+    squeeze = dist.ndim == 1
+    if squeeze:
+        dist = dist[None, :]
+    b, v = dist.shape
+    csrc, cdst, cw = _chunk_edges(src, dst, w, min(edge_chunk, src.shape[0] or 1))
+    row_offset = jnp.arange(b, dtype=jnp.int32)[:, None] * v  # [B,1]
+
+    def body(d, chunk):
+        s, t, wt = chunk
+        cand = d[:, s] + wt[None, :]              # [B, Ec] gather on src
+        seg = (row_offset + t[None, :]).ravel()   # flatten (row, dst) ids
+        upd = jax.ops.segment_min(
+            cand.ravel(), seg, num_segments=b * v, indices_are_sorted=False
+        ).reshape(b, v)
+        return jnp.minimum(d, upd), None
+
+    dist, _ = lax.scan(body, dist, (csrc, cdst, cw))
+    return dist[0] if squeeze else dist
+
+
+def bellman_ford_sweeps(
+    dist0, src, dst, w, *, max_iter: int, edge_chunk: int = 1 << 20
+):
+    """Iterate relaxation sweeps to fixpoint under ``lax.while_loop``.
+
+    Runs at most ``max_iter`` sweeps (pass |V| for Bellman-Ford semantics:
+    V-1 sweeps reach the fixpoint on cycle-free shortest paths, so a V-th
+    sweep that still improves proves a reachable negative cycle).
+
+    Returns (dist, iterations, still_improving) — all device values;
+    ``still_improving`` after exit is the negative-cycle flag.
+    """
+
+    def cond(state):
+        _, i, improving = state
+        return improving & (i < max_iter)
+
+    def body(state):
+        d, i, _ = state
+        nd = relax_sweep(d, src, dst, w, edge_chunk=edge_chunk)
+        return nd, i + 1, jnp.any(nd < d)
+
+    dist, iters, improving = lax.while_loop(
+        cond, body, (dist0, jnp.int32(0), jnp.bool_(True))
+    )
+    return dist, iters, improving
+
+
+def multi_source_init(sources, num_nodes: int, dtype=jnp.float32):
+    """dist0[B, V]: +inf everywhere, 0 at each row's source."""
+    b = sources.shape[0]
+    dist0 = jnp.full((b, num_nodes), INF, dtype)
+    return dist0.at[jnp.arange(b), sources].set(0.0)
+
+
+def reweight_weights(w, src, dst, h):
+    """Johnson reweighting w'(u,v) = w + h(u) - h(v), clamped at 0 against
+    float residue (mathematically >= 0 on shortest-path tree edges), with
+    +inf (padding / unreachable) preserved. Single source of truth — used by
+    the reweight kernel and the batched Johnson path alike."""
+    wp = w + h[src] - h[dst]
+    return jnp.where(jnp.isfinite(wp), jnp.maximum(wp, 0.0), INF)
+
+
+# -- dense min-plus (small/dense graphs; MXU-adjacent VPU path) -------------
+
+
+def dense_adjacency(src, dst, w, num_nodes: int, dtype=jnp.float32):
+    """A[u, v] = w(u, v), +inf where no edge, 0 diagonal (path of length 0).
+
+    Parallel edges resolve to the min via scatter-min.
+    """
+    a = jnp.full((num_nodes, num_nodes), INF, dtype)
+    a = a.at[src, dst].min(w.astype(dtype))
+    return jnp.minimum(a, jnp.where(jnp.eye(num_nodes, dtype=bool), 0.0, INF))
+
+
+def minplus(d, a, *, k_block: int = 128):
+    """Min-plus product: out[.., i, j] = min_k d[.., i, k] + a[k, j].
+
+    Blocked over k with lax.scan so the broadcast intermediate is
+    [.., I, k_block, J] instead of [.., I, K, J].
+    """
+    k = a.shape[0]
+    kb = min(k_block, k)
+    nb = -(-k // kb)
+    pad = nb * kb - k
+    if pad:
+        d = jnp.concatenate([d, jnp.full((*d.shape[:-1], pad), INF, d.dtype)], -1)
+        a = jnp.concatenate([a, jnp.full((pad, a.shape[1]), INF, a.dtype)], 0)
+    d_blocks = jnp.moveaxis(d.reshape(*d.shape[:-1], nb, kb), -2, 0)  # [nb,..,kb]
+    a_blocks = a.reshape(nb, kb, a.shape[1])
+
+    def body(acc, blk):
+        db, ab = blk  # db [.., kb], ab [kb, J]
+        acc = jnp.minimum(acc, jnp.min(db[..., :, None] + ab, axis=-2))
+        return acc, None
+
+    init = jnp.full((*d.shape[:-2], d.shape[-2], a.shape[1]), INF, d.dtype)
+    out, _ = lax.scan(body, init, (d_blocks, a_blocks))
+    return out
+
+
+def apsp_minplus_squaring(a, *, k_block: int = 128):
+    """Full APSP of a dense adjacency by repeated min-plus squaring:
+    D <- D (x) D doubles the path length covered, so ceil(log2 V) squarings
+    reach the fixpoint — no negative cycles allowed (use after reweighting).
+
+    Returns (dist[V, V], squarings).
+    """
+    import math
+
+    v = a.shape[0]
+    steps = max(1, math.ceil(math.log2(max(v, 2))))
+
+    def body(d, _):
+        return minplus(d, d, k_block=k_block), None
+
+    d, _ = lax.scan(body, a, None, length=steps)
+    return d, steps
+
+
+def dense_fanout(a, sources, *, max_iter: int, k_block: int = 128):
+    """N-source fan-out on a dense adjacency (0 diagonal, +inf non-edges).
+
+    Two regimes, picked statically by source count:
+      - B >= V/2: min-plus squaring of the whole matrix (log2 V products of
+        cost V^3) then a row gather — cheaper than iterating when most rows
+        are wanted anyway.
+      - B <  V/2: iterate D <- D (x) A to fixpoint under while_loop
+        (diameter iterations of cost B*V^2).
+
+    Returns (dist[B, V], iterations, still_improving). Weights must be
+    non-negative (post-reweighting), so still_improving after ``max_iter``
+    means unconverged, never a negative cycle.
+    """
+    v = a.shape[0]
+    b = sources.shape[0]
+    if 2 * b >= v:
+        full, steps = apsp_minplus_squaring(a, k_block=k_block)
+        return full[sources, :], steps, jnp.bool_(False)
+
+    d0 = multi_source_init(sources, v, a.dtype)
+
+    def cond(state):
+        _, i, improving = state
+        return improving & (i < max_iter)
+
+    def body(state):
+        d, i, _ = state
+        nd = minplus(d, a, k_block=k_block)  # a's 0 diagonal keeps nd <= d
+        return nd, i + 1, jnp.any(nd < d)
+
+    return lax.while_loop(cond, body, (d0, jnp.int32(0), jnp.bool_(True)))

@@ -1,0 +1,17 @@
+"""Solver orchestration (SURVEY.md §2 #1)."""
+
+from paralleljohnson_tpu.solver.johnson import (
+    ConvergenceError,
+    NegativeCycleError,
+    ParallelJohnsonSolver,
+    SolveResult,
+    ValidationError,
+)
+
+__all__ = [
+    "ConvergenceError",
+    "NegativeCycleError",
+    "ParallelJohnsonSolver",
+    "SolveResult",
+    "ValidationError",
+]

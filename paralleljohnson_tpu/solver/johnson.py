@@ -1,0 +1,259 @@
+"""``ParallelJohnsonSolver`` — the solver orchestration layer.
+
+Rebuild of the reference's attested solver class (SURVEY.md §2 #1,
+BASELINE.json:5): Johnson's all-pairs shortest paths as
+
+  phase 1  Bellman-Ford from a virtual source  ->  potentials h(v)
+           (negative-cycle detection lives here)
+  reweight w'(u,v) = w(u,v) + h(u) - h(v)  >=  0
+  phase 2  N-source fan-out on w' (batched across sources)
+  phase 3  un-reweight d(u,v) = d'(u,v) - h(u) + h(v)
+
+The solver owns phase structure, batching, checkpoint/resume, and the
+edges-relaxed accounting; all numeric kernels are delegated to the
+configured :class:`~paralleljohnson_tpu.backends.Backend`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import numpy as np
+
+from paralleljohnson_tpu.backends import Backend, get_backend
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import CSRGraph, stack_graphs
+from paralleljohnson_tpu.utils.metrics import SolverStats, phase_timer
+
+
+class NegativeCycleError(ValueError):
+    """The graph contains a cycle of negative total weight; shortest paths
+    are undefined. Raised host-side from the device-computed flag."""
+
+
+class ConvergenceError(RuntimeError):
+    """A relaxation kernel hit its iteration cap (``max_iterations`` set
+    below the graph's convergence depth) while distances were still
+    improving. Distinct from a negative cycle: raise the cap and retry."""
+
+
+class ValidationError(AssertionError):
+    """config.validate=True cross-check against the scipy oracle failed."""
+
+
+@dataclasses.dataclass
+class SolveResult:
+    """APSP / fan-out result.
+
+    dist: [N_sources, V] distance rows (+inf unreachable); for full APSP
+      N_sources == V and row i is distances from vertex ``sources[i]``.
+    sources: the source vertex of each row.
+    potentials: Johnson potentials h(v) (zeros when no reweighting ran).
+    stats: per-phase wall-clock, iteration counts, edges-relaxed totals.
+    """
+
+    dist: np.ndarray
+    sources: np.ndarray
+    potentials: np.ndarray
+    stats: SolverStats
+
+    @property
+    def matrix(self) -> np.ndarray:
+        """Distance matrix ordered by source vertex id (full APSP only)."""
+        order = np.argsort(self.sources)
+        return self.dist[order]
+
+
+class ParallelJohnsonSolver:
+    """Orchestrates Johnson's algorithm over a pluggable backend."""
+
+    def __init__(
+        self,
+        config: SolverConfig | None = None,
+        backend: Backend | None = None,
+    ) -> None:
+        self.config = config or SolverConfig()
+        self.backend = backend or get_backend(self.config.backend, self.config)
+
+    # -- public API ---------------------------------------------------------
+
+    def solve(
+        self,
+        graph: CSRGraph,
+        sources: np.ndarray | None = None,
+    ) -> SolveResult:
+        """Full Johnson APSP (or the given source subset)."""
+        stats = SolverStats()
+        v = graph.num_nodes
+        sources = (
+            np.arange(v, dtype=np.int64)
+            if sources is None
+            else np.asarray(sources, np.int64)
+        )
+
+        with phase_timer(stats, "upload"):
+            dgraph = self.backend.upload(graph)
+
+        # Phase 1 — potentials. Skipped when no negative weights exist:
+        # h = 0 is already valid and the fan-out can run directly.
+        if graph.has_negative_weights:
+            with phase_timer(stats, "bellman_ford"):
+                bf = self.backend.bellman_ford(dgraph, source=None)
+            stats.accumulate(bf, phase="bellman_ford")
+            if bf.negative_cycle:
+                raise NegativeCycleError(
+                    "negative-weight cycle detected during reweighting"
+                )
+            if not bf.converged:
+                raise ConvergenceError(
+                    "Bellman-Ford hit max_iterations while still improving; "
+                    "raise SolverConfig.max_iterations (or leave it None)"
+                )
+            h = np.asarray(bf.dist)
+            with phase_timer(stats, "reweight"):
+                dgraph = self.backend.reweight(dgraph, h)
+        else:
+            h = np.zeros(v, graph.dtype)
+
+        # Phase 2 — batched fan-out over sources.
+        with phase_timer(stats, "fanout"):
+            dist = self._fanout(dgraph, sources, stats)
+
+        # Phase 3 — un-reweight: d(u,v) = d'(u,v) - h(u) + h(v).
+        with phase_timer(stats, "unreweight"):
+            if graph.has_negative_weights:
+                dist = dist - h[sources][:, None] + h[None, :]
+                # +inf - h + h must stay +inf; inf arithmetic already
+                # guarantees that, but mask anyway against inf-inf NaNs
+                # if h itself has +inf (unreachable-from-virtual never
+                # happens: virtual source reaches everything).
+        result = SolveResult(dist=dist, sources=sources, potentials=h, stats=stats)
+        if self.config.validate:
+            self._validate(graph, result)
+        return result
+
+    def sssp(self, graph: CSRGraph, source: int) -> SolveResult:
+        """Standalone Bellman-Ford SSSP (config BASELINE.json:8) — negative
+        weights allowed, no reweighting."""
+        stats = SolverStats()
+        with phase_timer(stats, "upload"):
+            dgraph = self.backend.upload(graph)
+        with phase_timer(stats, "bellman_ford"):
+            bf = self.backend.bellman_ford(dgraph, source=int(source))
+        stats.accumulate(bf, phase="bellman_ford")
+        if bf.negative_cycle:
+            raise NegativeCycleError("negative-weight cycle reachable from source")
+        if not bf.converged:
+            raise ConvergenceError(
+                "Bellman-Ford hit max_iterations while still improving"
+            )
+        return SolveResult(
+            dist=np.asarray(bf.dist)[None, :],
+            sources=np.array([source]),
+            potentials=np.zeros(graph.num_nodes, graph.dtype),
+            stats=stats,
+        )
+
+    def multi_source(self, graph: CSRGraph, sources: np.ndarray) -> SolveResult:
+        """Standalone batched N-source fan-out on a non-negative graph
+        (config BASELINE.json:9)."""
+        if graph.has_negative_weights:
+            raise ValueError(
+                "multi_source requires non-negative weights; use solve()"
+            )
+        stats = SolverStats()
+        sources = np.asarray(sources, np.int64)
+        with phase_timer(stats, "upload"):
+            dgraph = self.backend.upload(graph)
+        with phase_timer(stats, "fanout"):
+            dist = self._fanout(dgraph, sources, stats)
+        return SolveResult(
+            dist=dist,
+            sources=sources,
+            potentials=np.zeros(graph.num_nodes, graph.dtype),
+            stats=stats,
+        )
+
+    def solve_batch(self, graphs: list[CSRGraph]) -> list[SolveResult]:
+        """Many-small-graphs mode (config BASELINE.json:11): APSP for each
+        graph in one vectorized run when the backend supports it."""
+        stats = SolverStats()
+        try:
+            with phase_timer(stats, "batch_apsp"):
+                batch = stack_graphs(graphs)
+                res = self.backend.batch_apsp(batch)
+        except NotImplementedError:
+            return [self.solve(g) for g in graphs]
+        stats.accumulate(res, phase="batch_apsp")
+        if res.negative_cycle:
+            raise NegativeCycleError("negative cycle in at least one batch graph")
+        dist = np.asarray(res.dist)
+        out = []
+        for i, g in enumerate(graphs):
+            v = g.num_nodes
+            out.append(
+                SolveResult(
+                    dist=dist[i, :v, :v],
+                    sources=np.arange(v),
+                    potentials=np.zeros(v, g.dtype),
+                    stats=stats,
+                )
+            )
+        return out
+
+    # -- internals ----------------------------------------------------------
+
+    def _source_batches(self, sources: np.ndarray) -> list[np.ndarray]:
+        bs = self.config.source_batch_size or len(sources) or 1
+        return [sources[i : i + bs] for i in range(0, len(sources), bs)]
+
+    def _fanout(
+        self, dgraph: Any, sources: np.ndarray, stats: SolverStats
+    ) -> np.ndarray:
+        """Run phase 2 in source batches; optionally checkpoint each batch
+        (SURVEY.md §5 — the batch is the unit of recovery). Checkpoints are
+        keyed by graph content so a different/modified graph never resumes
+        stale rows."""
+        from paralleljohnson_tpu.utils.checkpoint import BatchCheckpointer
+
+        ckpt = None
+        if self.config.checkpoint_dir:
+            graph = self.backend.download_graph(dgraph)
+            ckpt = BatchCheckpointer(
+                self.config.checkpoint_dir, graph_key=graph
+            )
+        rows: list[np.ndarray] = []
+        for batch_idx, batch in enumerate(self._source_batches(sources)):
+            if ckpt is not None:
+                cached = ckpt.load(batch_idx, batch)
+                if cached is not None:
+                    rows.append(cached)
+                    stats.batches_resumed += 1
+                    continue
+            res = self.backend.multi_source(dgraph, batch)
+            stats.accumulate(res, phase="fanout")
+            if not res.converged:
+                raise ConvergenceError(
+                    "fan-out hit max_iterations while still improving"
+                )
+            row = np.asarray(res.dist)
+            if ckpt is not None:
+                ckpt.save(batch_idx, batch, row)
+            rows.append(row)
+        return rows[0] if len(rows) == 1 else np.concatenate(rows, axis=0)
+
+    def _validate(self, graph: CSRGraph, result: SolveResult) -> None:
+        """config.validate: cross-check against the scipy Johnson oracle."""
+        import scipy.sparse.csgraph as csgraph
+
+        dense = np.ma.masked_invalid(graph.to_dense().astype(np.float64))
+        oracle = csgraph.johnson(dense, directed=True)[result.sources]
+        if not np.allclose(result.dist, oracle, rtol=1e-4, atol=1e-4):
+            bad = ~np.isclose(result.dist, oracle, rtol=1e-4, atol=1e-4)
+            raise ValidationError(
+                f"solver disagrees with scipy oracle at {bad.sum()} of "
+                f"{bad.size} entries (max |err| = "
+                f"{np.nanmax(np.abs(np.where(bad, result.dist - oracle, 0))):g})"
+            )

@@ -1,0 +1,6 @@
+"""Instrumentation, checkpointing, and misc utilities (SURVEY.md §5)."""
+
+from paralleljohnson_tpu.utils.checkpoint import BatchCheckpointer
+from paralleljohnson_tpu.utils.metrics import SolverStats, phase_timer
+
+__all__ = ["BatchCheckpointer", "SolverStats", "phase_timer"]

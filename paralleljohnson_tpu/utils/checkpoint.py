@@ -1,0 +1,73 @@
+"""Checkpoint / resume for the N-source fan-out (SURVEY.md §5).
+
+The unit of recovery is the source batch: each completed batch of distance
+rows is written as an ``.npz`` keyed by batch index plus a hash of the
+sources it covers; resuming skips batches whose file exists and matches.
+Survives preemption mid-APSP (relevant for RMAT-22-scale runs on TPU pods).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from pathlib import Path
+
+import numpy as np
+
+
+def _sources_digest(sources: np.ndarray) -> str:
+    return hashlib.sha256(
+        np.ascontiguousarray(np.asarray(sources, np.int64)).tobytes()
+    ).hexdigest()[:16]
+
+
+def graph_digest(graph) -> str:
+    """Content hash of a CSRGraph (structure + weights): checkpoints from a
+    different or modified graph must never be resumed."""
+    h = hashlib.sha256()
+    for arr in (graph.indptr, graph.indices, graph.weights):
+        a = np.ascontiguousarray(arr)
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+class BatchCheckpointer:
+    def __init__(self, directory: str | Path, *, graph_key=None) -> None:
+        """``graph_key``: the CSRGraph (or a precomputed digest string) the
+        rows belong to; rows are stored under a per-graph subdirectory."""
+        self.dir = Path(directory)
+        if graph_key is not None:
+            digest = graph_key if isinstance(graph_key, str) else graph_digest(graph_key)
+            self.dir = self.dir / f"graph_{digest}"
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, batch_idx: int, sources: np.ndarray) -> Path:
+        return self.dir / f"rows_{batch_idx:06d}_{_sources_digest(sources)}.npz"
+
+    def save(self, batch_idx: int, sources: np.ndarray, rows: np.ndarray) -> Path:
+        path = self._path(batch_idx, sources)
+        tmp = path.with_suffix(".tmp.npz")
+        np.savez_compressed(tmp, sources=np.asarray(sources, np.int64), rows=rows)
+        tmp.rename(path)  # atomic publish: partial writes never count as done
+        return path
+
+    def load(self, batch_idx: int, sources: np.ndarray) -> np.ndarray | None:
+        """Rows for this batch, or None if absent/corrupt (recompute)."""
+        path = self._path(batch_idx, sources)
+        if not path.exists():
+            return None
+        try:
+            with np.load(path) as data:
+                if np.array_equal(data["sources"], np.asarray(sources, np.int64)):
+                    return data["rows"]
+        except Exception:
+            pass
+        return None
+
+    def completed_batches(self) -> list[int]:
+        return sorted(
+            int(p.name.split("_")[1])
+            for p in self.dir.glob("rows_*.npz")
+            # a crashed save leaves rows_*.tmp.npz — never published, not done
+            if not p.name.endswith(".tmp.npz")
+        )

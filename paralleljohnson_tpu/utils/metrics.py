@@ -1,0 +1,81 @@
+"""Instrumentation: per-phase wall-clock, iteration counts, and the attested
+edges-relaxed counters (SURVEY.md §2 #13, BASELINE.json:2
+"edges-relaxed/sec/chip")."""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from collections import defaultdict
+
+
+@dataclasses.dataclass
+class SolverStats:
+    """Accumulated per-solve instrumentation.
+
+    phase_seconds: wall-clock per named phase (upload / bellman_ford /
+      reweight / fanout / unreweight / batch_apsp).
+    edges_relaxed: total edge relaxations across phases.
+    edges_relaxed_by_phase / iterations_by_phase: breakdowns.
+    batches_resumed: source batches skipped via checkpoint resume.
+    """
+
+    phase_seconds: dict = dataclasses.field(
+        default_factory=lambda: defaultdict(float)
+    )
+    edges_relaxed: int = 0
+    edges_relaxed_by_phase: dict = dataclasses.field(
+        default_factory=lambda: defaultdict(int)
+    )
+    iterations_by_phase: dict = dataclasses.field(
+        default_factory=lambda: defaultdict(int)
+    )
+    batches_resumed: int = 0
+
+    def accumulate(self, result, phase: str) -> None:
+        """Fold one KernelResult into the totals."""
+        self.edges_relaxed += int(result.edges_relaxed)
+        self.edges_relaxed_by_phase[phase] += int(result.edges_relaxed)
+        self.iterations_by_phase[phase] += int(result.iterations)
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(self.phase_seconds.values())
+
+    def edges_relaxed_per_second(self) -> float:
+        """The headline metric (per chip: divide by mesh size at call site)."""
+        compute = sum(
+            s for k, s in self.phase_seconds.items()
+            if k in ("bellman_ford", "fanout", "batch_apsp")
+        )
+        return self.edges_relaxed / compute if compute > 0 else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "phase_seconds": dict(self.phase_seconds),
+            "edges_relaxed": self.edges_relaxed,
+            "edges_relaxed_by_phase": dict(self.edges_relaxed_by_phase),
+            "iterations_by_phase": dict(self.iterations_by_phase),
+            "batches_resumed": self.batches_resumed,
+            "total_seconds": self.total_seconds,
+            "edges_relaxed_per_sec": self.edges_relaxed_per_second(),
+        }
+
+
+@contextlib.contextmanager
+def phase_timer(stats: SolverStats, phase: str):
+    """Times a phase; also opens a ``jax.named_scope``-style profiler scope
+    when JAX is importable so device traces attribute kernels to phases
+    (SURVEY.md §5 tracing)."""
+    scope = contextlib.nullcontext()
+    try:
+        import jax
+
+        scope = jax.named_scope(phase)
+    except Exception:
+        pass
+    t0 = time.perf_counter()
+    with scope:
+        yield
+    stats.phase_seconds[phase] += time.perf_counter() - t0

@@ -1,0 +1,60 @@
+"""Test harness config (SURVEY.md §4).
+
+Tests run on a simulated 8-device CPU mesh — JAX's standard trick for
+exercising shard_map/collective paths without a TPU pod: the same code then
+runs unmodified on a real mesh. Must be set before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu.graphs import CSRGraph
+
+
+@pytest.fixture
+def tiny_graph() -> CSRGraph:
+    """5-vertex graph with negative edges, no negative cycle (CLRS-style)."""
+    edges = [
+        (0, 1, 3.0), (0, 2, 8.0), (0, 4, -4.0),
+        (1, 3, 1.0), (1, 4, 7.0),
+        (2, 1, 4.0),
+        (3, 0, 2.0), (3, 2, -5.0),
+        (4, 3, 6.0),
+    ]
+    s, d, w = zip(*edges)
+    return CSRGraph.from_edges(s, d, w, 5)
+
+
+@pytest.fixture
+def neg_cycle_graph() -> CSRGraph:
+    """Contains the negative cycle 1 -> 2 -> 3 -> 1 (total -1)."""
+    edges = [(0, 1, 1.0), (1, 2, 2.0), (2, 3, -4.0), (3, 1, 1.0)]
+    s, d, w = zip(*edges)
+    return CSRGraph.from_edges(s, d, w, 4)
+
+
+def oracle_apsp(graph: CSRGraph) -> np.ndarray:
+    """scipy Johnson oracle on the dense matrix (handles 0-weight edges and
+    negative weights exactly; fine at test scale)."""
+    import scipy.sparse.csgraph as csgraph
+
+    dense = graph.to_dense(fill=np.inf).astype(np.float64)
+    masked = np.ma.masked_invalid(dense)
+    return csgraph.johnson(masked, directed=True)
+
+
+def oracle_sssp(graph: CSRGraph, source: int) -> np.ndarray:
+    import scipy.sparse.csgraph as csgraph
+
+    dense = graph.to_dense(fill=np.inf).astype(np.float64)
+    masked = np.ma.masked_invalid(dense)
+    return csgraph.bellman_ford(masked, directed=True, indices=source)

@@ -1,0 +1,73 @@
+"""Checkpoint/resume tests (SURVEY.md §5 failure recovery)."""
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.graphs import erdos_renyi
+from paralleljohnson_tpu.utils.checkpoint import BatchCheckpointer
+
+
+def test_resume_skips_completed_batches(tmp_path):
+    g = erdos_renyi(48, 0.1, seed=2)
+    cfg = SolverConfig(backend="numpy", source_batch_size=16,
+                       checkpoint_dir=str(tmp_path))
+    r1 = ParallelJohnsonSolver(cfg).solve(g)
+    assert r1.stats.batches_resumed == 0
+    r2 = ParallelJohnsonSolver(cfg).solve(g)
+    assert r2.stats.batches_resumed == 3
+    np.testing.assert_array_equal(r1.matrix, r2.matrix)
+
+
+def test_checkpoint_keyed_by_graph_content(tmp_path):
+    """A different graph with identical V and sources must NOT resume."""
+    cfg = SolverConfig(backend="numpy", source_batch_size=16,
+                       checkpoint_dir=str(tmp_path))
+    g1 = erdos_renyi(48, 0.1, seed=2)
+    g2 = erdos_renyi(48, 0.1, seed=3)
+    r1 = ParallelJohnsonSolver(cfg).solve(g1)
+    r2 = ParallelJohnsonSolver(cfg).solve(g2)
+    assert r2.stats.batches_resumed == 0
+    assert not np.array_equal(r1.matrix, r2.matrix)
+    # same structure, one weight changed -> also a different graph
+    w = g1.weights.copy()
+    w[0] += 1.0
+    r3 = ParallelJohnsonSolver(cfg).solve(g1.with_weights(w))
+    assert r3.stats.batches_resumed == 0
+
+
+def test_partial_batch_recovery(tmp_path):
+    """Simulate preemption: only some batches done; resume completes rest."""
+    g = erdos_renyi(32, 0.15, seed=5)
+    cfg = SolverConfig(backend="numpy", source_batch_size=8,
+                       checkpoint_dir=str(tmp_path))
+    solver = ParallelJohnsonSolver(cfg)
+    full = solver.solve(g)
+    # wipe two of four batch files to fake a mid-run crash
+    files = sorted(tmp_path.rglob("rows_*.npz"))
+    assert len(files) == 4
+    files[1].unlink()
+    files[3].unlink()
+    resumed = ParallelJohnsonSolver(cfg).solve(g)
+    assert resumed.stats.batches_resumed == 2
+    np.testing.assert_array_equal(full.matrix, resumed.matrix)
+
+
+def test_corrupt_checkpoint_recomputed(tmp_path):
+    g = erdos_renyi(24, 0.15, seed=7)
+    cfg = SolverConfig(backend="numpy", source_batch_size=24,
+                       checkpoint_dir=str(tmp_path))
+    full = ParallelJohnsonSolver(cfg).solve(g)
+    f = next(tmp_path.rglob("rows_*.npz"))
+    f.write_bytes(b"garbage")  # fault injection: corrupted batch result
+    again = ParallelJohnsonSolver(cfg).solve(g)
+    assert again.stats.batches_resumed == 0
+    np.testing.assert_array_equal(full.matrix, again.matrix)
+
+
+def test_tmp_files_not_counted_done(tmp_path):
+    ck = BatchCheckpointer(tmp_path)
+    ck.save(0, np.array([0, 1]), np.zeros((2, 4)))
+    # fake a crashed save
+    (ck.dir / "rows_000001_deadbeef.tmp.npz").write_bytes(b"partial")
+    assert ck.completed_batches() == [0]

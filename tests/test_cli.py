@@ -1,0 +1,62 @@
+"""CLI tests (SURVEY.md §2 #15) — through the real argv surface."""
+
+import json
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu.cli import main
+
+
+def test_info(capsys):
+    assert main(["info"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "jax" in out["backends"] and "numpy" in out["backends"]
+    assert "dimacs" in out["loaders"]
+
+
+def test_solve_json(capsys):
+    assert main(["solve", "er:n=40,p=0.1,seed=1", "--backend", "numpy",
+                 "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["shape"] == [40, 40]
+    assert out["edges_relaxed"] > 0
+
+
+def test_solve_output_npz(tmp_path, capsys):
+    out_file = str(tmp_path / "d.npz")
+    assert main(["solve", "er:n=20,p=0.2,seed=2", "--backend", "numpy",
+                 "--output", out_file]) == 0
+    with np.load(out_file) as data:
+        assert data["dist"].shape == (20, 20)
+
+
+def test_solve_sources_subset(capsys):
+    assert main(["solve", "er:n=30,p=0.1,seed=3", "--backend", "numpy",
+                 "--sources", "0,5,9", "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["shape"] == [3, 30]
+
+
+def test_sssp(capsys):
+    assert main(["sssp", "dag:n=30,p=0.1,neg=0.4,seed=4", "--source", "0",
+                 "--backend", "numpy", "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["shape"] == [1, 30]
+
+
+def test_batch(capsys):
+    assert main(["batch", "4", "16", "0.2", "--backend", "numpy",
+                 "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["graphs"] == 4
+
+
+def test_negative_cycle_exit_code(tmp_path, capsys):
+    gr = tmp_path / "cycle.gr"
+    gr.write_text("p sp 3 3\na 1 2 1\na 2 3 -5\na 3 1 1\n")
+    assert main(["solve", str(gr), "--backend", "numpy"]) == 2
+    assert "negative" in capsys.readouterr().err
+
+
+def test_bad_graph_spec_exit_code(capsys):
+    assert main(["solve", "bogus.xyz", "--backend", "numpy"]) == 1
+    assert "error:" in capsys.readouterr().err

@@ -1,0 +1,97 @@
+"""max_iterations semantics: caps below convergence depth raise
+ConvergenceError — never a spurious NegativeCycleError, never silent
+wrong answers (code-review findings on the flag plumbing)."""
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import (
+    ConvergenceError,
+    NegativeCycleError,
+    ParallelJohnsonSolver,
+    SolverConfig,
+    ValidationError,
+)
+from paralleljohnson_tpu.graphs import CSRGraph
+
+
+def path_graph(n: int, weight: float = -1.0) -> CSRGraph:
+    """0 -> 1 -> ... -> n-1 (acyclic; negative weights allowed, no cycle)."""
+    return CSRGraph.from_edges(
+        np.arange(n - 1), np.arange(1, n), np.full(n - 1, weight), n
+    )
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_capped_iterations_raise_convergence_error(backend):
+    g = path_graph(12)
+    with pytest.raises(ConvergenceError):
+        ParallelJohnsonSolver(
+            SolverConfig(backend=backend, max_iterations=3)
+        ).solve(g)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_capped_iterations_sssp(backend):
+    g = path_graph(12)
+    with pytest.raises(ConvergenceError):
+        ParallelJohnsonSolver(
+            SolverConfig(backend=backend, max_iterations=3)
+        ).sssp(g, source=0)
+
+
+def test_capped_fanout_not_silent():
+    # Non-negative long path: BF phase is skipped, the cap bites in the
+    # jax sweep fan-out. (The numpy backend's heap Dijkstra is exact with
+    # no sweep count, so max_iterations rightly doesn't apply there.)
+    g = path_graph(12, weight=1.0)
+    with pytest.raises(ConvergenceError):
+        ParallelJohnsonSolver(
+            SolverConfig(backend="jax", max_iterations=3, dense_threshold=0)
+        ).solve(g)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_sufficient_iterations_fine(backend):
+    g = path_graph(12)
+    res = ParallelJohnsonSolver(
+        SolverConfig(backend=backend, max_iterations=20)
+    ).solve(g)
+    assert res.matrix[0, 11] == -11.0
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_true_negative_cycle_still_detected(backend, neg_cycle_graph):
+    with pytest.raises(NegativeCycleError):
+        ParallelJohnsonSolver(SolverConfig(backend=backend)).solve(
+            neg_cycle_graph
+        )
+
+
+def test_validate_knob_runs_oracle():
+    from paralleljohnson_tpu.graphs import erdos_renyi
+
+    g = erdos_renyi(40, 0.1, seed=3)
+    res = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", validate=True)
+    ).solve(g)
+    assert res.dist.shape == (40, 40)
+
+
+def test_validate_catches_bad_backend(monkeypatch):
+    """Break the backend deliberately; validate must catch it."""
+    from paralleljohnson_tpu.backends import get_backend
+    from paralleljohnson_tpu.graphs import erdos_renyi
+
+    g = erdos_renyi(30, 0.15, seed=4)
+    solver = ParallelJohnsonSolver(SolverConfig(backend="numpy", validate=True))
+    real = solver.backend.multi_source
+
+    def corrupted(dgraph, sources):
+        res = real(dgraph, sources)
+        res.dist = res.dist + 1.0  # systematically wrong distances
+        return res
+
+    monkeypatch.setattr(solver.backend, "multi_source", corrupted)
+    with pytest.raises(ValidationError):
+        solver.solve(g)

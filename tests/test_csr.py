@@ -1,0 +1,80 @@
+"""CSR core tests (SURVEY.md §7 step 1)."""
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu.graphs import CSRGraph, PAD_WEIGHT, stack_graphs
+
+
+def test_from_edges_roundtrip():
+    g = CSRGraph.from_edges([0, 1, 2], [1, 2, 0], [1.0, 2.0, 3.0], 3)
+    assert g.num_nodes == 3 and g.num_edges == 3
+    np.testing.assert_array_equal(g.src, [0, 1, 2])
+    np.testing.assert_array_equal(g.indices, [1, 2, 0])
+    np.testing.assert_allclose(g.weights, [1.0, 2.0, 3.0])
+
+
+def test_from_edges_sorts_and_dedupes_min_weight():
+    # Parallel edges 0->1 keep the minimum weight (shortest-path relevant).
+    g = CSRGraph.from_edges([1, 0, 0, 0], [0, 1, 1, 2], [9.0, 5.0, 2.0, 1.0], 3)
+    assert g.num_edges == 3
+    np.testing.assert_array_equal(g.src, [0, 0, 1])
+    np.testing.assert_array_equal(g.indices, [1, 2, 0])
+    np.testing.assert_allclose(g.weights, [2.0, 1.0, 9.0])
+
+
+def test_from_edges_no_dedupe():
+    g = CSRGraph.from_edges([0, 0], [1, 1], [5.0, 2.0], 2, dedupe=False)
+    assert g.num_edges == 2
+
+
+def test_empty_graph():
+    g = CSRGraph.from_edges([], [], [], 4)
+    assert g.num_nodes == 4 and g.num_edges == 0
+    assert not g.has_negative_weights
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        CSRGraph(indptr=np.array([0, 2]), indices=np.array([0]), weights=np.array([1.0]))
+    with pytest.raises(ValueError):
+        CSRGraph.from_edges([0], [5], [1.0], 2)
+
+
+def test_scipy_roundtrip(tiny_graph):
+    g2 = CSRGraph.from_scipy(tiny_graph.to_scipy())
+    np.testing.assert_array_equal(g2.indptr, tiny_graph.indptr)
+    np.testing.assert_array_equal(g2.indices, tiny_graph.indices)
+    np.testing.assert_allclose(g2.weights, tiny_graph.weights)
+
+
+def test_to_dense(tiny_graph):
+    dense = tiny_graph.to_dense()
+    assert dense[0, 4] == -4.0
+    assert np.isinf(dense[0, 3])
+
+
+def test_pad_edges_noop_edges():
+    g = CSRGraph.from_edges([0, 1], [1, 0], [1.0, 2.0], 2)
+    p = g.pad_edges(8)
+    assert p.num_edges == 8 and p.num_real_edges == 2
+    assert np.all(np.isinf(p.weights[2:]))
+    assert np.all(p.src[2:] == 0) and np.all(p.indices[2:] == 0)
+    # already-aligned graphs are returned as-is
+    assert g.pad_edges(2) is g
+
+
+def test_reweight_structure_preserved(tiny_graph):
+    g2 = tiny_graph.with_weights(np.abs(tiny_graph.weights))
+    assert not g2.has_negative_weights
+    np.testing.assert_array_equal(g2.indices, tiny_graph.indices)
+
+
+def test_stack_graphs():
+    g1 = CSRGraph.from_edges([0, 1], [1, 2], [1.0, 2.0], 3)
+    g2 = CSRGraph.from_edges([0], [1], [5.0], 2)
+    batch = stack_graphs([g1, g2])
+    assert batch["src"].shape == (2, 2)
+    assert batch["v_max"] == 3
+    np.testing.assert_array_equal(batch["num_nodes"], [3, 2])
+    assert batch["weights"][1, 1] == PAD_WEIGHT

@@ -1,0 +1,58 @@
+"""Dense min-plus path (ops.relax dense_*) — equivalence with the sparse
+sweep path and with the oracle, both fan-out regimes (iterate vs square)."""
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.graphs import erdos_renyi, random_dag
+
+from conftest import oracle_apsp
+
+
+def solve(g, sources=None, **kw):
+    return ParallelJohnsonSolver(SolverConfig(backend="jax", **kw)).solve(
+        g, sources=sources
+    )
+
+
+def test_dense_equals_sparse_full_apsp():
+    g = random_dag(60, 0.1, negative_fraction=0.4, seed=31)
+    dense = solve(g, dense_threshold=1024).matrix
+    sparse = solve(g, dense_threshold=0).matrix
+    np.testing.assert_allclose(dense, sparse, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(dense, oracle_apsp(g), rtol=1e-4, atol=1e-4)
+
+
+def test_dense_iterate_regime_small_source_count():
+    # B < V/2 exercises the while_loop minplus-iteration branch.
+    g = erdos_renyi(64, 0.08, seed=32)
+    sources = np.array([1, 7, 13])
+    dense = solve(g, sources=sources, dense_threshold=1024)
+    sparse = solve(g, sources=sources, dense_threshold=0)
+    np.testing.assert_allclose(dense.dist, sparse.dist, rtol=1e-5)
+    np.testing.assert_allclose(dense.dist, oracle_apsp(g)[sources], rtol=1e-4)
+
+
+def test_dense_squaring_regime_many_sources():
+    # B >= V/2 exercises the apsp_minplus_squaring branch.
+    g = erdos_renyi(40, 0.1, seed=33)
+    sources = np.arange(30)
+    dense = solve(g, sources=sources, dense_threshold=1024)
+    np.testing.assert_allclose(dense.dist, oracle_apsp(g)[sources], rtol=1e-4)
+
+
+def test_minplus_blocking_invariant():
+    """minplus must be exact regardless of k_block slicing."""
+    import jax.numpy as jnp
+
+    from paralleljohnson_tpu.ops.relax import minplus
+
+    rng = np.random.default_rng(0)
+    d = rng.uniform(0, 10, (5, 37)).astype(np.float32)
+    a = rng.uniform(0, 10, (37, 23)).astype(np.float32)
+    a[rng.random((37, 23)) < 0.5] = np.inf
+    want = np.min(d[:, :, None] + a[None, :, :], axis=1)
+    for kb in (1, 7, 37, 64):
+        got = np.asarray(minplus(jnp.asarray(d), jnp.asarray(a), k_block=kb))
+        np.testing.assert_allclose(got, want, rtol=1e-6)

@@ -1,0 +1,64 @@
+"""Generator tests (SURVEY.md §2 #10-#11)."""
+
+import numpy as np
+
+from paralleljohnson_tpu.graphs import erdos_renyi, random_dag, random_graph_batch, rmat
+
+
+def test_er_basic():
+    g = erdos_renyi(200, 0.05, seed=1)
+    assert g.num_nodes == 200
+    expected = 200 * 199 * 0.05
+    assert 0.6 * expected < g.num_edges < 1.4 * expected
+    assert not g.has_negative_weights
+    assert np.all(g.src != g.indices)  # no self-loops
+
+
+def test_er_deterministic():
+    a, b = erdos_renyi(100, 0.05, seed=7), erdos_renyi(100, 0.05, seed=7)
+    np.testing.assert_array_equal(a.indices, b.indices)
+    np.testing.assert_allclose(a.weights, b.weights)
+    c = erdos_renyi(100, 0.05, seed=8)
+    assert c.num_edges != a.num_edges or not np.array_equal(a.indices, c.indices)
+
+
+def test_er_negative_fraction():
+    g = erdos_renyi(100, 0.1, negative_fraction=0.5, seed=2)
+    neg = (g.weights < 0).mean()
+    assert 0.3 < neg < 0.7
+
+
+def test_random_dag_acyclic():
+    import networkx as nx
+
+    g = random_dag(60, 0.1, negative_fraction=0.5, seed=3)
+    assert g.has_negative_weights
+    dg = nx.DiGraph()
+    dg.add_edges_from(zip(g.src.tolist(), g.indices.tolist()))
+    assert nx.is_directed_acyclic_graph(dg)
+
+
+def test_rmat_shape_and_determinism():
+    g = rmat(8, edge_factor=8, seed=5)
+    assert g.num_nodes == 256
+    assert g.num_edges <= 8 * 256  # dedupe + self-loop removal only shrinks
+    assert g.num_edges > 4 * 256   # but not pathologically
+    g2 = rmat(8, edge_factor=8, seed=5)
+    np.testing.assert_array_equal(g.indices, g2.indices)
+
+
+def test_rmat_skew():
+    # Power-law: top-1% vertices should own well over 1% of out-edges.
+    g = rmat(10, edge_factor=16, seed=0, dedupe=False)
+    deg = np.diff(g.indptr)
+    top = np.sort(deg)[-len(deg) // 100 :].sum()
+    assert top / g.num_edges > 0.05
+
+
+def test_random_graph_batch():
+    graphs = random_graph_batch(5, 32, 0.1, seed=9)
+    assert len(graphs) == 5
+    assert all(g.num_nodes == 32 for g in graphs)
+    assert graphs[0].num_edges != graphs[1].num_edges or not np.array_equal(
+        graphs[0].indices, graphs[1].indices
+    )

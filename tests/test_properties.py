@@ -1,0 +1,84 @@
+"""Property-based tests (hypothesis) — SURVEY.md §4 invariants:
+triangle inequality, reweighted weights >= 0, d(v,v)=0, backend equivalence.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.graphs import CSRGraph, random_dag
+
+from conftest import oracle_apsp
+
+
+@st.composite
+def graphs(draw, max_nodes=24, negative=False):
+    n = draw(st.integers(2, max_nodes))
+    max_edges = n * (n - 1)
+    m = draw(st.integers(0, min(max_edges, 4 * n)))
+    pairs = draw(
+        st.lists(
+            st.tuples(st.integers(0, n - 1), st.integers(0, n - 1)),
+            min_size=m, max_size=m,
+        )
+    )
+    pairs = [(u, v) for u, v in pairs if u != v]
+    if negative:
+        # weights on a DAG ordering so negatives cannot form a cycle
+        ws = draw(st.lists(
+            st.floats(-5, 10, allow_nan=False, width=32),
+            min_size=len(pairs), max_size=len(pairs),
+        ))
+        pairs = [(min(u, v), max(u, v)) for u, v in pairs]
+    else:
+        ws = draw(st.lists(
+            st.floats(0, 10, allow_nan=False, width=32),
+            min_size=len(pairs), max_size=len(pairs),
+        ))
+    if not pairs:
+        return CSRGraph.from_edges([], [], [], n)
+    s, d = zip(*pairs)
+    return CSRGraph.from_edges(s, d, ws, n)
+
+
+@settings(max_examples=40, deadline=None)
+@given(graphs())
+def test_apsp_invariants_nonnegative(g):
+    res = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g)
+    m = res.matrix
+    v = g.num_nodes
+    np.testing.assert_allclose(np.diag(m), 0.0, atol=1e-6)
+    # triangle inequality d(i,k) <= d(i,j) + d(j,k) (inf-safe)
+    through = np.min(m[:, :, None] + m[None, :, :], axis=1)
+    assert np.all(m <= through + 1e-4)
+    assert np.all((m >= 0) | np.isinf(m))
+
+
+@settings(max_examples=25, deadline=None)
+@given(graphs(negative=True))
+def test_apsp_matches_oracle_negative_dag(g):
+    res = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g)
+    np.testing.assert_allclose(
+        res.matrix, oracle_apsp(g), rtol=1e-4, atol=1e-4
+    )
+
+
+@settings(max_examples=15, deadline=None)
+@given(graphs(negative=True), st.integers(0, 10**6))
+def test_jax_equals_numpy(g, seed):
+    a = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g).matrix
+    b = ParallelJohnsonSolver(SolverConfig(backend="jax")).solve(g).matrix
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_reweighted_nonnegative():
+    g = random_dag(40, 0.15, negative_fraction=0.6, seed=17)
+    from paralleljohnson_tpu.backends import get_backend
+
+    be = get_backend("numpy")
+    dg = be.upload(g)
+    bf = be.bellman_ford(dg, source=None)
+    assert not bf.negative_cycle
+    h = bf.dist
+    rw = be.download_graph(be.reweight(dg, h))
+    assert np.all(rw.weights >= 0)

@@ -1,0 +1,134 @@
+"""Solver orchestration tests against scipy/networkx oracles (SURVEY.md §4).
+
+Parametrized over backends: the plugin boundary makes "same input, every
+backend, same output" the core integration test.
+"""
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import (
+    NegativeCycleError,
+    ParallelJohnsonSolver,
+    SolverConfig,
+)
+from paralleljohnson_tpu.backends import available_backends
+from paralleljohnson_tpu.graphs import erdos_renyi, random_dag
+
+from conftest import oracle_apsp, oracle_sssp
+
+BACKENDS = [b for b in available_backends() if b != "cpp"] + (
+    ["cpp"] if "cpp" in available_backends() else []
+)
+
+
+def make_solver(backend: str, **kw) -> ParallelJohnsonSolver:
+    return ParallelJohnsonSolver(SolverConfig(backend=backend, **kw))
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_apsp_tiny_matches_oracle(backend, tiny_graph):
+    res = make_solver(backend).solve(tiny_graph)
+    np.testing.assert_allclose(res.matrix, oracle_apsp(tiny_graph), rtol=1e-5)
+    assert res.stats.edges_relaxed > 0
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_apsp_negative_dag_matches_oracle(backend):
+    g = random_dag(40, 0.15, negative_fraction=0.5, seed=11)
+    res = make_solver(backend).solve(g)
+    np.testing.assert_allclose(res.matrix, oracle_apsp(g), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_apsp_nonnegative_er_matches_oracle(backend):
+    g = erdos_renyi(80, 0.08, seed=4)
+    res = make_solver(backend).solve(g)
+    np.testing.assert_allclose(res.matrix, oracle_apsp(g), rtol=1e-5)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_negative_cycle_detected(backend, neg_cycle_graph):
+    with pytest.raises(NegativeCycleError):
+        make_solver(backend).solve(neg_cycle_graph)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_sssp_matches_oracle(backend, tiny_graph):
+    res = make_solver(backend).sssp(tiny_graph, source=0)
+    np.testing.assert_allclose(
+        res.dist[0], oracle_sssp(tiny_graph, 0), rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_sssp_unreachable_inf(backend):
+    from paralleljohnson_tpu.graphs import CSRGraph
+
+    g = CSRGraph.from_edges([0], [1], [2.0], 3)  # vertex 2 unreachable
+    res = make_solver(backend).sssp(g, source=0)
+    np.testing.assert_allclose(res.dist[0], [0.0, 2.0, np.inf])
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_sssp_negative_cycle(backend, neg_cycle_graph):
+    with pytest.raises(NegativeCycleError):
+        make_solver(backend).sssp(neg_cycle_graph, source=0)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_sssp_unreachable_negative_cycle_ok(backend):
+    from paralleljohnson_tpu.graphs import CSRGraph
+
+    # cycle 2<->3 negative, unreachable from source 0 (component {0,1})
+    g = CSRGraph.from_edges([0, 2, 3], [1, 3, 2], [1.0, -2.0, 1.0], 4)
+    res = make_solver(backend).sssp(g, source=0)
+    np.testing.assert_allclose(res.dist[0], [0.0, 1.0, np.inf, np.inf])
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_multi_source_subset(backend):
+    g = erdos_renyi(60, 0.1, seed=6)
+    sources = np.array([3, 17, 42])
+    res = make_solver(backend).multi_source(g, sources)
+    oracle = oracle_apsp(g)
+    np.testing.assert_allclose(res.dist, oracle[sources], rtol=1e-5)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_multi_source_rejects_negative(backend, tiny_graph):
+    with pytest.raises(ValueError, match="non-negative"):
+        make_solver(backend).multi_source(tiny_graph, np.array([0]))
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_source_batching_equivalent(backend):
+    g = erdos_renyi(50, 0.1, seed=8)
+    full = make_solver(backend).solve(g)
+    batched = make_solver(backend, source_batch_size=7).solve(g)
+    np.testing.assert_allclose(full.matrix, batched.matrix, rtol=1e-6)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_solve_source_subset(backend):
+    g = random_dag(30, 0.2, negative_fraction=0.4, seed=13)
+    sources = np.array([5, 1, 20])
+    res = make_solver(backend).solve(g, sources=sources)
+    oracle = oracle_apsp(g)
+    np.testing.assert_allclose(res.dist, oracle[sources], rtol=1e-5, atol=1e-5)
+
+
+def test_backend_equivalence_pairwise():
+    g = random_dag(50, 0.12, negative_fraction=0.4, seed=21)
+    results = {b: make_solver(b).solve(g).matrix for b in BACKENDS}
+    ref = results["numpy"]
+    for name, mat in results.items():
+        np.testing.assert_allclose(mat, ref, rtol=1e-5, atol=1e-5, err_msg=name)
+
+
+def test_stats_populated(tiny_graph):
+    res = make_solver("numpy").solve(tiny_graph)
+    d = res.stats.as_dict()
+    assert d["edges_relaxed"] > 0
+    assert "bellman_ford" in d["phase_seconds"]
+    assert d["edges_relaxed_per_sec"] >= 0
