@@ -167,11 +167,33 @@ class JaxBackend(Backend):
             edges_relaxed=iters * dgraph.num_real_edges,
         )
 
+    def _mesh(self):
+        """The fan-out mesh: >1 device shards sources; 1 device = local."""
+        from paralleljohnson_tpu.parallel import make_mesh
+
+        cached = getattr(self, "_mesh_cache", None)
+        if cached is None:
+            cached = make_mesh(self.config.mesh_shape)
+            self._mesh_cache = cached
+        return cached
+
     def multi_source(self, dgraph: JaxDeviceGraph, sources: np.ndarray) -> KernelResult:
         v = dgraph.num_nodes
         sources = jnp.asarray(sources, jnp.int32)
         max_iter = self.config.max_iterations or v
-        if v <= self.config.dense_threshold:
+        mesh = self._mesh()
+        if mesh.devices.size > 1:
+            from paralleljohnson_tpu.parallel import sharded_fanout
+
+            chunk = _edge_chunk_for(
+                max(1, sources.shape[0] // mesh.devices.size),
+                dgraph.src.shape[0],
+            )
+            dist, iters, improving = sharded_fanout(
+                mesh, sources, dgraph.src, dgraph.dst, dgraph.weights,
+                num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+            )
+        elif v <= self.config.dense_threshold:
             dist, iters, improving = _dense_fanout_kernel(
                 sources, dgraph.src, dgraph.dst, dgraph.weights,
                 num_nodes=v, max_iter=max_iter,

@@ -98,8 +98,13 @@ def bellman_ford_sweeps(
         nd = relax_sweep(d, src, dst, w, edge_chunk=edge_chunk)
         return nd, i + 1, jnp.any(nd < d)
 
+    # Derive the initial flag from dist0 (always True: a source entry is
+    # finite) instead of a literal True: under shard_map the carry must
+    # have the same varying-manual-axes type as the body output, and a
+    # constant would be unvarying while any(nd < d) varies.
+    improving0 = jnp.any(jnp.isfinite(dist0))
     dist, iters, improving = lax.while_loop(
-        cond, body, (dist0, jnp.int32(0), jnp.bool_(True))
+        cond, body, (dist0, jnp.int32(0), improving0)
     )
     return dist, iters, improving
 

@@ -1,0 +1,5 @@
+"""Mesh/sharding layer: source parallelism + ICI collectives."""
+
+from paralleljohnson_tpu.parallel.mesh import make_mesh, sharded_fanout
+
+__all__ = ["make_mesh", "sharded_fanout"]

@@ -1,0 +1,122 @@
+"""Source-parallelism across the TPU mesh (SURVEY.md §2 parallelism table).
+
+The attested multi-chip design (BASELINE.json:5): source batches sharded
+across the device mesh, CSR replicated per chip, and one ICI ``all_gather``
+of per-source distance rows assembling the distance matrix. Implemented as
+a 1-D ``Mesh`` over a ``"sources"`` axis + ``shard_map``:
+
+  - in_specs: distance-row sources split on "sources"; CSR buffers
+    replicated (P(None)) — each chip relaxes its own rows against the whole
+    edge list, so the sweep needs NO cross-chip traffic at all.
+  - The single collective is the final tiled ``all_gather`` of rows over
+    ICI, plus scalar ``pmax`` reductions for the iteration count and the
+    still-improving flag.
+
+The same code runs on a real TPU mesh and on the CPU-simulated 8-device
+mesh used in CI (``--xla_force_host_platform_device_count=8``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from paralleljohnson_tpu.ops import relax
+
+
+def make_mesh(mesh_shape: tuple[int, ...] | None = None) -> Mesh:
+    """1-D device mesh over the ``"sources"`` axis.
+
+    ``mesh_shape=None`` uses every visible device; ``(n,)`` uses the first
+    n. Johnson's fan-out has a single parallel dimension (sources), so the
+    mesh is 1-D by design — no model/pipeline axis exists in this domain
+    (SURVEY.md §2: TP/PP/EP are N/A).
+    """
+    devices = np.asarray(jax.devices())
+    if mesh_shape is not None:
+        n = int(np.prod(mesh_shape))
+        if n > devices.size:
+            raise ValueError(
+                f"mesh_shape {mesh_shape} needs {n} devices; "
+                f"only {devices.size} visible"
+            )
+        devices = devices[:n]
+    return Mesh(devices, axis_names=("sources",))
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_fanout_fn(mesh: Mesh, num_nodes: int, max_iter: int,
+                       edge_chunk: int, replicate: bool):
+    """Build + cache the jitted sharded fan-out for one (mesh, graph-shape)
+    combo. Cached on function identity so jit's own trace cache works.
+
+    ``replicate=False`` (default): rows come back as a global array sharded
+    on "sources" — shard_map stitches shards, nothing is duplicated in HBM,
+    and the gather to assemble the full matrix happens wherever the result
+    is next consumed (host fetch or downstream op).
+    ``replicate=True``: issues the explicit tiled ``all_gather`` over ICI
+    inside the kernel so every chip holds the whole matrix (the literal
+    attested design). Needs check_vma=False: the vma type system cannot
+    infer that a tiled all_gather output is replicated.
+    """
+
+    def shard_body(srcs, s, t, wt):
+        d0 = relax.multi_source_init(srcs, num_nodes, dtype=wt.dtype)
+        d, iters, improving = relax.bellman_ford_sweeps(
+            d0, s, t, wt, max_iter=max_iter, edge_chunk=edge_chunk
+        )
+        if replicate:
+            d = jax.lax.all_gather(d, "sources", axis=0, tiled=True)
+        iters = jax.lax.pmax(iters, "sources")
+        improving = jax.lax.pmax(improving.astype(jnp.int32), "sources")
+        return d, iters, improving
+
+    mapped = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P("sources"), P(None), P(None), P(None)),
+        out_specs=(P(None) if replicate else P("sources"), P(), P()),
+        check_vma=not replicate,
+    )
+    return jax.jit(mapped)
+
+
+def sharded_fanout(
+    mesh: Mesh,
+    sources,
+    src,
+    dst,
+    w,
+    *,
+    num_nodes: int,
+    max_iter: int,
+    edge_chunk: int = 1 << 20,
+    replicate: bool = False,
+):
+    """N-source fan-out with sources sharded over ``mesh``.
+
+    Pads the source batch to a multiple of the mesh size (padding rows
+    solve from vertex 0 and are dropped), runs the per-shard sweep, and
+    gathers rows (explicit ICI all_gather when ``replicate=True``, output-
+    sharding assembly otherwise). Returns (dist[B, V], iterations,
+    still_improving).
+    """
+    n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    sources = jnp.asarray(sources, jnp.int32)
+    b = sources.shape[0]
+    pad = (-b) % n
+    if pad:
+        sources = jnp.concatenate([sources, jnp.zeros(pad, jnp.int32)])
+    fn = _sharded_fanout_fn(mesh, num_nodes, max_iter, int(edge_chunk),
+                            bool(replicate))
+    d, iters, improving = fn(sources, src, dst, w)
+    return d[:b], iters, improving.astype(bool)

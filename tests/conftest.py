@@ -7,12 +7,21 @@ runs unmodified on a real mesh. Must be set before jax imports.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Overwrite, not setdefault: the environment presets JAX_PLATFORMS=axon
+# (the real TPU) and its sitecustomize imports jax at interpreter start, so
+# the env var alone is read too early to help — force the platform through
+# jax.config as well. The CPU client itself initializes lazily, so
+# XLA_FLAGS set here is still picked up at first device use.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -11,9 +11,11 @@ from conftest import oracle_apsp
 
 
 def solve(g, sources=None, **kw):
-    return ParallelJohnsonSolver(SolverConfig(backend="jax", **kw)).solve(
-        g, sources=sources
-    )
+    # mesh_shape=(1,): pin the local path — the 8-device test mesh would
+    # otherwise route to the sharded fan-out (covered in test_sharding.py).
+    return ParallelJohnsonSolver(
+        SolverConfig(backend="jax", mesh_shape=(1,), **kw)
+    ).solve(g, sources=sources)
 
 
 def test_dense_equals_sparse_full_apsp():

@@ -1,0 +1,93 @@
+"""Sharded fan-out tests on the simulated 8-device CPU mesh (SURVEY.md §4).
+
+conftest.py forces --xla_force_host_platform_device_count=8, so these
+exercise the real shard_map + ICI-all-gather code path that runs unmodified
+on a TPU pod mesh.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.graphs import erdos_renyi, random_dag
+from paralleljohnson_tpu.parallel import make_mesh, sharded_fanout
+
+from conftest import oracle_apsp
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device simulated mesh"
+)
+
+
+def test_eight_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_make_mesh_shapes():
+    assert make_mesh().devices.size == 8
+    assert make_mesh((4,)).devices.size == 4
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh((16,))
+
+
+def test_sharded_fanout_matches_oracle():
+    import jax.numpy as jnp
+
+    g = erdos_renyi(64, 0.08, seed=41)
+    mesh = make_mesh()
+    dist, iters, improving = sharded_fanout(
+        mesh,
+        np.arange(64),
+        jnp.asarray(g.src), jnp.asarray(g.indices), jnp.asarray(g.weights),
+        num_nodes=64, max_iter=64,
+    )
+    assert not bool(improving)
+    assert int(iters) > 0
+    np.testing.assert_allclose(np.asarray(dist), oracle_apsp(g), rtol=1e-5)
+
+
+def test_sharded_fanout_ragged_batch():
+    """Source counts not divisible by the mesh size get padded + sliced."""
+    import jax.numpy as jnp
+
+    g = erdos_renyi(40, 0.1, seed=42)
+    mesh = make_mesh()
+    sources = np.array([1, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31])  # 11 % 8 != 0
+    dist, _, _ = sharded_fanout(
+        mesh, sources,
+        jnp.asarray(g.src), jnp.asarray(g.indices), jnp.asarray(g.weights),
+        num_nodes=40, max_iter=40,
+    )
+    assert dist.shape == (11, 40)
+    np.testing.assert_allclose(np.asarray(dist), oracle_apsp(g)[sources], rtol=1e-5)
+
+
+def test_solver_uses_mesh_end_to_end():
+    """Full Johnson through the public API on the 8-way mesh, negative
+    weights included; equals the numpy reference backend."""
+    g = random_dag(56, 0.12, negative_fraction=0.4, seed=43)
+    sharded = ParallelJohnsonSolver(
+        SolverConfig(backend="jax")  # mesh_shape=None -> all 8 devices
+    ).solve(g)
+    reference = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g)
+    np.testing.assert_allclose(
+        sharded.matrix, reference.matrix, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_mesh_subset_and_batching():
+    g = erdos_renyi(48, 0.1, seed=44)
+    res = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", mesh_shape=(4,), source_batch_size=16)
+    ).solve(g)
+    np.testing.assert_allclose(res.matrix, oracle_apsp(g), rtol=1e-5)
+
+
+def test_sharded_equals_local():
+    g = erdos_renyi(52, 0.1, seed=45)
+    sharded = ParallelJohnsonSolver(SolverConfig(backend="jax")).solve(g)
+    local = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", mesh_shape=(1,), dense_threshold=0)
+    ).solve(g)
+    np.testing.assert_allclose(sharded.matrix, local.matrix, rtol=1e-6)
