@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Driver benchmark: prints ONE JSON line
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+Headline config (BASELINE.json:2 metric "edges-relaxed/sec/chip"): the
+batched N-source fan-out — Johnson phase 2, the dominant hot loop
+(SURVEY.md §3.1) — on an R-MAT power-law graph, run on the real TPU via
+the JaxBackend. ``vs_baseline`` is the wall-clock speedup over the
+scipy heap-Dijkstra path on the same graph + sources (the CPU reference
+stand-in; the reference publishes no numbers, BASELINE.json:13).
+
+Env knobs: PJ_BENCH_SCALE (default 16), PJ_BENCH_SOURCES (128),
+PJ_BENCH_REPEATS (3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+    scale = int(os.environ.get("PJ_BENCH_SCALE", "10" if smoke else "16"))
+    n_sources = int(os.environ.get("PJ_BENCH_SOURCES", "16" if smoke else "128"))
+    repeats = int(os.environ.get("PJ_BENCH_REPEATS", "1" if smoke else "3"))
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from paralleljohnson_tpu.backends import get_backend
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.graphs import rmat
+
+    g = rmat(scale, 16, seed=42)
+    rng = np.random.default_rng(0)
+    sources = np.sort(
+        rng.choice(g.num_nodes, size=n_sources, replace=False)
+    ).astype(np.int64)
+
+    backend = get_backend("jax", SolverConfig())
+    dgraph = backend.upload(g)
+    res = backend.multi_source(dgraph, sources)  # compile + warm caches
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        res = backend.multi_source(dgraph, sources)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    edges_per_sec = res.edges_relaxed / dt
+
+    # CPU baseline: scipy heap Dijkstra (the reference's algorithmic shape)
+    # on the identical graph + sources.
+    import scipy.sparse as sp
+    import scipy.sparse.csgraph as csgraph
+
+    mat = sp.csr_matrix(
+        (g.weights.astype(np.float64), g.indices, g.indptr),
+        shape=(g.num_nodes, g.num_nodes),
+    )
+    t0 = time.perf_counter()
+    ref = csgraph.dijkstra(mat, directed=True, indices=sources)
+    t_ref = time.perf_counter() - t0
+
+    ok = np.allclose(np.asarray(res.dist), ref, rtol=1e-3, atol=1e-2)
+    if not ok:
+        print("WARNING: TPU result mismatch vs scipy oracle", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"edges_relaxed_per_sec_per_chip[rmat{scale}x{n_sources}src]",
+                "value": round(edges_per_sec, 1),
+                "unit": "edges/s",
+                "vs_baseline": round(t_ref / dt, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

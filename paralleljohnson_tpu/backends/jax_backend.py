@@ -185,8 +185,11 @@ class JaxBackend(Backend):
         if mesh.devices.size > 1:
             from paralleljohnson_tpu.parallel import sharded_fanout
 
+            # Ceil: sharded_fanout pads the batch up to a mesh multiple, so
+            # each shard solves ceil(B / n) rows — floor would undersize the
+            # memory budget by up to 2x.
             chunk = _edge_chunk_for(
-                max(1, sources.shape[0] // mesh.devices.size),
+                -(-sources.shape[0] // mesh.devices.size),
                 dgraph.src.shape[0],
             )
             dist, iters, improving = sharded_fanout(

@@ -29,7 +29,10 @@ class SolverConfig:
       max_iterations: cap on relaxation sweeps; ``None`` = |V| (the
         Bellman-Ford bound).
       dense_threshold: graphs with V <= threshold use the dense min-plus
-        (MXU-friendly) path instead of the sparse CSR sweep.
+        (MXU-friendly) path instead of the sparse CSR sweep. Precedence:
+        a multi-device mesh routes the fan-out to the sharded sparse path
+        regardless — the dense path is single-chip; set mesh_shape=(1,)
+        to force it on a multi-device host.
       edge_pad_multiple: pad E to this multiple for stable jit shapes.
       checkpoint_dir: if set, per-source-batch distance rows are saved here
         and resumed after preemption (SURVEY.md §5 checkpoint/resume).

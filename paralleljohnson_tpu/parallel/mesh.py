@@ -115,7 +115,11 @@ def sharded_fanout(
     b = sources.shape[0]
     pad = (-b) % n
     if pad:
-        sources = jnp.concatenate([sources, jnp.zeros(pad, jnp.int32)])
+        # Pad with a duplicate of a real source, not vertex 0: padding rows
+        # participate in the pmax'd still-improving flag, and an arbitrary
+        # vertex 0 row could need more sweeps than every requested source,
+        # turning a converged fan-out into a spurious ConvergenceError.
+        sources = jnp.concatenate([sources, jnp.full(pad, sources[0], jnp.int32)])
     fn = _sharded_fanout_fn(mesh, num_nodes, max_iter, int(edge_chunk),
                             bool(replicate))
     d, iters, improving = fn(sources, src, dst, w)
