@@ -49,7 +49,10 @@ def main() -> None:
         res = backend.multi_source(dgraph, sources)
         times.append(time.perf_counter() - t0)
     dt = min(times)
-    edges_per_sec = res.edges_relaxed / dt
+    # edges_relaxed is aggregate across the mesh; the attested metric is
+    # per-chip (BASELINE.json:2), so divide by the devices actually used.
+    n_chips = int(backend._mesh().devices.size)
+    edges_per_sec = res.edges_relaxed / dt / n_chips
 
     # CPU baseline: scipy heap Dijkstra (the reference's algorithmic shape)
     # on the identical graph + sources.

@@ -1,0 +1,185 @@
+// Native CPU/OpenMP kernels — the rebuild of the reference's attested
+// native component (SURVEY.md §2 #6: "C/C++ + OpenMP shared-memory parallel
+// kernels: parallel-for over edges (Bellman-Ford iterations) and over
+// sources (Dijkstra fan-out)", BASELINE.json:5 "CPU/OpenMP path").
+//
+// This is the comparison baseline the TPU backend's >=10x target is
+// measured against, not a stand-in: edge relaxation is a lock-free
+// atomic-min sweep parallel over edges, and the fan-out is heap Dijkstra
+// parallel over sources. Both count edge relaxations for the attested
+// edges-relaxed/sec/chip metric (BASELINE.json:2).
+//
+// Memory-model notes (the part TSan cares about):
+//   - dist[] updates go through __atomic_compare_exchange with relaxed
+//     ordering. Distances only ever decrease, and the fixpoint of a
+//     monotone min-relaxation is unique, so a stale read can only delay
+//     convergence by a sweep, never corrupt the result.
+//   - The per-sweep "improved" flag is an OpenMP || reduction.
+//   - Dijkstra threads share nothing but read-only CSR arrays and disjoint
+//     output rows.
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <queue>
+#include <utility>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+template <typename T>
+inline bool atomic_fetch_min(T *addr, T val) {
+  // Lock-free min via CAS on the value's object representation. Returns
+  // true iff this call lowered *addr. NaN never occurs (weights are
+  // finite or +inf and +inf + finite stays +inf).
+  T cur;
+  __atomic_load(addr, &cur, __ATOMIC_RELAXED);
+  while (val < cur) {
+    if (__atomic_compare_exchange(addr, &cur, &val, /*weak=*/true,
+                                  __ATOMIC_RELAXED, __ATOMIC_RELAXED))
+      return true;
+  }
+  return false;
+}
+
+// One Bellman-Ford relaxation sweep over the COO edge list, parallel over
+// edges. Returns whether any distance improved.
+template <typename T>
+bool relax_sweep(int64_t num_edges, const int32_t *src, const int32_t *dst,
+                 const T *w, T *dist) {
+  bool improved = false;
+#pragma omp parallel for schedule(static) reduction(|| : improved)
+  for (int64_t i = 0; i < num_edges; ++i) {
+    T du;
+    __atomic_load(&dist[src[i]], &du, __ATOMIC_RELAXED);
+    if (!std::isfinite(du)) continue;  // inf + w never relaxes anything
+    const T cand = du + w[i];
+    T dv;
+    __atomic_load(&dist[dst[i]], &dv, __ATOMIC_RELAXED);
+    if (cand < dv) improved |= atomic_fetch_min(&dist[dst[i]], cand);
+  }
+  return improved;
+}
+
+// Binary-heap Dijkstra from one source on non-negative CSR weights.
+// Writes the full distance row; returns edges scanned (the edges-relaxed
+// count convention for heap Dijkstra: out-edges of settled vertices).
+template <typename T>
+int64_t dijkstra_row(int32_t num_nodes, const int32_t *indptr,
+                     const int32_t *indices, const T *w, int32_t source,
+                     T *dist) {
+  const T inf = std::numeric_limits<T>::infinity();
+  for (int32_t v = 0; v < num_nodes; ++v) dist[v] = inf;
+  dist[source] = T(0);
+
+  using Item = std::pair<T, int32_t>;  // (distance, vertex), min-heap
+  std::priority_queue<Item, std::vector<Item>, std::greater<Item>> heap;
+  heap.emplace(T(0), source);
+  int64_t scanned = 0;
+  while (!heap.empty()) {
+    const auto [d, u] = heap.top();
+    heap.pop();
+    if (d > dist[u]) continue;  // lazy deletion: stale entry
+    for (int32_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+      ++scanned;
+      const T nd = d + w[e];
+      const int32_t v = indices[e];
+      if (nd < dist[v]) {
+        dist[v] = nd;
+        heap.emplace(nd, v);
+      }
+    }
+  }
+  return scanned;
+}
+
+template <typename T>
+int32_t bellman_ford_impl(int32_t num_nodes, int64_t num_edges,
+                          const int32_t *src, const int32_t *dst, const T *w,
+                          T *dist, int32_t max_iter, int32_t *iterations,
+                          int64_t *edges_relaxed) {
+  int32_t iters = 0;
+  bool improving = num_nodes > 0;
+  while (improving && iters < max_iter) {
+    improving = relax_sweep(num_edges, src, dst, w, dist);
+    ++iters;
+  }
+  *iterations = iters;
+  // Sweep convention (matches every other backend): each sweep scans all E.
+  *edges_relaxed = static_cast<int64_t>(iters) * num_edges;
+  return improving ? 1 : 0;  // still improving at cap = caller's flag
+}
+
+template <typename T>
+void dijkstra_fanout_impl(int32_t num_nodes, const int32_t *indptr,
+                          const int32_t *indices, const T *w,
+                          int32_t num_sources, const int32_t *sources,
+                          T *dist_out, int64_t *edges_relaxed) {
+  int64_t total = 0;
+#pragma omp parallel for schedule(dynamic, 1) reduction(+ : total)
+  for (int32_t b = 0; b < num_sources; ++b) {
+    total += dijkstra_row(num_nodes, indptr, indices, w, sources[b],
+                          dist_out + static_cast<int64_t>(b) * num_nodes);
+  }
+  *edges_relaxed = total;
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t pj_version() { return 1; }
+
+int32_t pj_num_threads() {
+#ifdef _OPENMP
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+// Bellman-Ford over a COO edge list. `dist` is in-out: the caller seeds it
+// (all-zero for the Johnson virtual source, +inf except source otherwise).
+// Returns 1 if a sweep at the iteration cap was still improving (negative
+// cycle when max_iter >= V), else 0.
+int32_t pj_bellman_ford_f32(int32_t num_nodes, int64_t num_edges,
+                            const int32_t *src, const int32_t *dst,
+                            const float *w, float *dist, int32_t max_iter,
+                            int32_t *iterations, int64_t *edges_relaxed) {
+  return bellman_ford_impl(num_nodes, num_edges, src, dst, w, dist, max_iter,
+                           iterations, edges_relaxed);
+}
+
+int32_t pj_bellman_ford_f64(int32_t num_nodes, int64_t num_edges,
+                            const int32_t *src, const int32_t *dst,
+                            const double *w, double *dist, int32_t max_iter,
+                            int32_t *iterations, int64_t *edges_relaxed) {
+  return bellman_ford_impl(num_nodes, num_edges, src, dst, w, dist, max_iter,
+                           iterations, edges_relaxed);
+}
+
+// N-source heap-Dijkstra fan-out on non-negative CSR weights, parallel over
+// sources. dist_out is [num_sources, num_nodes] row-major.
+void pj_dijkstra_fanout_f32(int32_t num_nodes, const int32_t *indptr,
+                            const int32_t *indices, const float *w,
+                            int32_t num_sources, const int32_t *sources,
+                            float *dist_out, int64_t *edges_relaxed) {
+  dijkstra_fanout_impl(num_nodes, indptr, indices, w, num_sources, sources,
+                       dist_out, edges_relaxed);
+}
+
+void pj_dijkstra_fanout_f64(int32_t num_nodes, const int32_t *indptr,
+                            const int32_t *indices, const double *w,
+                            int32_t num_sources, const int32_t *sources,
+                            double *dist_out, int64_t *edges_relaxed) {
+  dijkstra_fanout_impl(num_nodes, indptr, indices, w, num_sources, sources,
+                       dist_out, edges_relaxed);
+}
+
+}  // extern "C"

@@ -105,7 +105,7 @@ def sharded_fanout(
     """N-source fan-out with sources sharded over ``mesh``.
 
     Pads the source batch to a multiple of the mesh size (padding rows
-    solve from vertex 0 and are dropped), runs the per-shard sweep, and
+    duplicate ``sources[0]`` and are dropped), runs the per-shard sweep, and
     gathers rows (explicit ICI all_gather when ``replicate=True``, output-
     sharding assembly otherwise). Returns (dist[B, V], iterations,
     still_improving).

@@ -1,0 +1,103 @@
+"""Native C++/OpenMP backend tests (SURVEY.md §4 backend-equivalence).
+
+The cpp backend must match the numpy reference backend (itself
+oracle-anchored) bit-for-bit on f64 and to float tolerance on f32.
+"""
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu.backends import available_backends, get_backend
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import erdos_renyi
+from paralleljohnson_tpu.solver import (
+    NegativeCycleError,
+    ParallelJohnsonSolver,
+)
+from tests.conftest import oracle_apsp, oracle_sssp
+
+pytestmark = pytest.mark.skipif(
+    "cpp" not in available_backends(), reason="native library not buildable"
+)
+
+
+def test_library_loads_and_reports_threads():
+    from paralleljohnson_tpu.native import load_library
+
+    lib = load_library()
+    assert lib.pj_version() == 1
+    assert lib.pj_num_threads() >= 1
+
+
+def test_bellman_ford_matches_oracle(tiny_graph):
+    backend = get_backend("cpp", SolverConfig(precision="f64"))
+    dg = backend.upload(tiny_graph)
+    res = backend.bellman_ford(dg, source=0)
+    np.testing.assert_allclose(res.dist, oracle_sssp(tiny_graph, 0))
+    assert not res.negative_cycle
+    assert res.converged
+    assert res.iterations >= 1
+    assert res.edges_relaxed == res.iterations * tiny_graph.num_edges
+
+
+def test_virtual_source_potentials(tiny_graph):
+    backend = get_backend("cpp", SolverConfig(precision="f64"))
+    res = backend.bellman_ford(backend.upload(tiny_graph), source=None)
+    # Virtual-source distances are all <= 0 and finite.
+    assert np.all(np.isfinite(res.dist))
+    assert np.all(res.dist <= 0)
+
+
+def test_negative_cycle_flag(neg_cycle_graph):
+    backend = get_backend("cpp", SolverConfig(precision="f64"))
+    res = backend.bellman_ford(backend.upload(neg_cycle_graph), source=0)
+    assert res.negative_cycle
+
+
+def test_dijkstra_fanout_matches_numpy_backend():
+    g = erdos_renyi(200, 0.05, seed=3, weight_range=(0.1, 9.0))
+    sources = np.arange(0, 200, 7)
+    cfg = SolverConfig(precision="f64")
+    cpp = get_backend("cpp", cfg)
+    ref = get_backend("numpy", cfg)
+    d_cpp = cpp.multi_source(cpp.upload(g), sources)
+    d_ref = ref.multi_source(ref.upload(g), sources)
+    np.testing.assert_array_equal(d_cpp.dist, d_ref.dist)
+    assert d_cpp.edges_relaxed > 0
+
+
+def test_full_johnson_solve_vs_oracle():
+    # seed 1 at this range has 43 negative edges and no negative cycle
+    g = erdos_renyi(120, 0.06, seed=1, weight_range=(-0.5, 8.0))
+    assert g.has_negative_weights
+    solver = ParallelJohnsonSolver(SolverConfig(backend="cpp", precision="f64"))
+    res = solver.solve(g)
+    np.testing.assert_allclose(res.matrix, oracle_apsp(g), atol=1e-9)
+
+
+def test_solver_raises_on_negative_cycle(neg_cycle_graph):
+    solver = ParallelJohnsonSolver(SolverConfig(backend="cpp", precision="f64"))
+    with pytest.raises(NegativeCycleError):
+        solver.solve(neg_cycle_graph)
+
+
+def test_f32_close_to_f64():
+    g = erdos_renyi(150, 0.05, seed=5, weight_range=(0.5, 4.0))
+    sources = np.arange(32)
+    r32 = get_backend("cpp", SolverConfig(precision="f32"))
+    r64 = get_backend("cpp", SolverConfig(precision="f64"))
+    d32 = r32.multi_source(r32.upload(g), sources).dist
+    d64 = r64.multi_source(r64.upload(g), sources).dist
+    np.testing.assert_allclose(d32, d64, rtol=1e-5, atol=1e-5)
+
+
+def test_cpp_equals_jax_backend_on_reweighted_graph():
+    """The core plugin-boundary contract: same input, every backend, same
+    output (SURVEY.md §4)."""
+    g = erdos_renyi(100, 0.08, seed=9, weight_range=(0.0, 5.0))
+    sources = np.arange(0, 100, 3)
+    cpp = get_backend("cpp", SolverConfig(precision="f32"))
+    jaxb = get_backend("jax", SolverConfig(precision="f32"))
+    d_cpp = cpp.multi_source(cpp.upload(g), sources).dist
+    d_jax = np.asarray(jaxb.multi_source(jaxb.upload(g), sources).dist)
+    np.testing.assert_allclose(d_cpp, d_jax, rtol=1e-5, atol=1e-5)
