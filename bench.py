@@ -30,6 +30,9 @@ def main() -> None:
     repeats = int(os.environ.get("PJ_BENCH_REPEATS", "1" if smoke else "3"))
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from paralleljohnson_tpu.utils.platform import honor_cpu_platform_request
+
+    honor_cpu_platform_request()
     from paralleljohnson_tpu.backends import get_backend
     from paralleljohnson_tpu.config import SolverConfig
     from paralleljohnson_tpu.graphs import rmat

@@ -62,10 +62,28 @@ def _fanout_kernel(
 _reweight_kernel = jax.jit(relax.reweight_weights)
 
 
-@functools.partial(jax.jit, static_argnames=("num_nodes", "max_iter"))
-def _dense_fanout_kernel(sources, src, dst, w, *, num_nodes: int, max_iter: int):
+def _minplus_impl(use_pallas: bool, interpret: bool):
+    """The min-plus product impl for dense kernels: the Pallas/Mosaic tile
+    kernel (SURVEY.md §7 step 6) or None (the XLA blocked fallback)."""
+    if not use_pallas:
+        return None
+    from paralleljohnson_tpu.ops.pallas_kernels import minplus_pallas
+
+    return functools.partial(minplus_pallas, interpret=interpret)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_nodes", "max_iter", "use_pallas", "interpret"),
+)
+def _dense_fanout_kernel(
+    sources, src, dst, w, *, num_nodes: int, max_iter: int,
+    use_pallas: bool = False, interpret: bool = False,
+):
     a = relax.dense_adjacency(src, dst, w, num_nodes, dtype=w.dtype)
-    return relax.dense_fanout(a, sources, max_iter=max_iter)
+    return relax.dense_fanout(
+        a, sources, max_iter=max_iter, mp=_minplus_impl(use_pallas, interpret)
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("num_nodes", "graph_chunk"))
@@ -167,6 +185,15 @@ class JaxBackend(Backend):
             edges_relaxed=iters * dgraph.num_real_edges,
         )
 
+    def _pallas_mode(self) -> tuple[bool, bool]:
+        """(use_pallas, interpret): "auto" = compiled Pallas on TPU only;
+        True forces it anywhere (interpret-mode off-TPU, for CI)."""
+        flag = self.config.use_pallas
+        on_tpu = jax.default_backend() == "tpu"
+        if flag == "auto":
+            return on_tpu, False
+        return bool(flag), bool(flag) and not on_tpu
+
     def _mesh(self):
         """The fan-out mesh: >1 device shards sources; 1 device = local."""
         from paralleljohnson_tpu.parallel import make_mesh
@@ -197,9 +224,11 @@ class JaxBackend(Backend):
                 num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
             )
         elif v <= self.config.dense_threshold:
+            use_pallas, interpret = self._pallas_mode()
             dist, iters, improving = _dense_fanout_kernel(
                 sources, dgraph.src, dgraph.dst, dgraph.weights,
                 num_nodes=v, max_iter=max_iter,
+                use_pallas=use_pallas, interpret=interpret,
             )
         else:
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])

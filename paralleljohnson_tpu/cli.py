@@ -27,12 +27,19 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="sources per device batch")
     p.add_argument("--max-iterations", type=int, default=None)
     p.add_argument("--dense-threshold", type=int, default=1024)
+    p.add_argument("--use-pallas", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="Pallas dense kernels: auto (TPU only) / force / off")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--validate", action="store_true",
                    help="cross-check against the scipy oracle (slow)")
     p.add_argument("--output", default=None, help="write result .npz here")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit one machine-readable JSON line")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler (Perfetto/XProf) trace here")
+    p.add_argument("--log-stats", action="store_true",
+                   help="emit a structured JSON stats line to stderr")
 
 
 def _config(args) -> "SolverConfig":
@@ -44,12 +51,17 @@ def _config(args) -> "SolverConfig":
         source_batch_size=args.batch_size,
         max_iterations=args.max_iterations,
         dense_threshold=args.dense_threshold,
+        use_pallas={"auto": "auto", "true": True, "false": False}[args.use_pallas],
         checkpoint_dir=args.checkpoint_dir,
         validate=args.validate,
     )
 
 
 def _report(res, args) -> None:
+    if getattr(args, "log_stats", False):
+        from paralleljohnson_tpu.utils.profiling import log_stats
+
+        log_stats(res.stats, label=args.command)
     finite = float(np.isfinite(res.dist).mean())
     payload = {
         "shape": list(res.dist.shape),
@@ -104,6 +116,10 @@ def main(argv: list[str] | None = None) -> int:
 
     args = parser.parse_args(argv)
 
+    from paralleljohnson_tpu.utils.platform import honor_cpu_platform_request
+
+    honor_cpu_platform_request()
+
     from paralleljohnson_tpu import (
         NegativeCycleError,
         ParallelJohnsonSolver,
@@ -124,6 +140,8 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(info, indent=None if args.as_json else 2))
         return 0
 
+    from paralleljohnson_tpu.utils.profiling import device_trace
+
     try:
         if args.command == "solve":
             g = load_graph(args.graph)
@@ -132,17 +150,26 @@ def main(argv: list[str] | None = None) -> int:
                 sources = np.array([int(s) for s in args.sources.split(",")])
             elif args.num_sources is not None:
                 sources = np.arange(args.num_sources)
-            res = ParallelJohnsonSolver(_config(args)).solve(g, sources=sources)
+            with device_trace(args.profile):
+                res = ParallelJohnsonSolver(_config(args)).solve(
+                    g, sources=sources
+                )
             _report(res, args)
         elif args.command == "sssp":
             g = load_graph(args.graph)
-            res = ParallelJohnsonSolver(_config(args)).sssp(g, args.source)
+            with device_trace(args.profile):
+                res = ParallelJohnsonSolver(_config(args)).sssp(g, args.source)
             _report(res, args)
         elif args.command == "batch":
             graphs = random_graph_batch(args.count, args.nodes, args.p,
                                         seed=args.seed)
-            results = ParallelJohnsonSolver(_config(args)).solve_batch(graphs)
+            with device_trace(args.profile):
+                results = ParallelJohnsonSolver(_config(args)).solve_batch(graphs)
             stats = results[0].stats
+            if args.log_stats:
+                from paralleljohnson_tpu.utils.profiling import log_stats
+
+                log_stats(stats, label="batch")
             payload = {"graphs": len(results),
                        "matrix_shape": list(results[0].dist.shape),
                        **stats.as_dict()}

@@ -34,6 +34,8 @@ class SolverConfig:
         regardless — the dense path is single-chip; set mesh_shape=(1,)
         to force it on a multi-device host.
       edge_pad_multiple: pad E to this multiple for stable jit shapes.
+      use_pallas: ``"auto"`` (Pallas dense kernels on TPU, XLA elsewhere),
+        ``True`` (force, interpret-mode off-TPU — tests), or ``False``.
       checkpoint_dir: if set, per-source-batch distance rows are saved here
         and resumed after preemption (SURVEY.md §5 checkpoint/resume).
       validate: cross-check results against the scipy oracle (slow; tests).
@@ -46,6 +48,7 @@ class SolverConfig:
     max_iterations: int | None = None
     dense_threshold: int = 1024
     edge_pad_multiple: int = 512
+    use_pallas: bool | str = "auto"
     checkpoint_dir: str | None = None
     validate: bool = False
 
@@ -56,3 +59,7 @@ class SolverConfig:
     def __post_init__(self) -> None:
         if self.precision not in ("f32", "f64"):
             raise ValueError(f"precision must be f32/f64, got {self.precision!r}")
+        if self.use_pallas not in (True, False, "auto"):
+            raise ValueError(
+                f"use_pallas must be True/False/'auto', got {self.use_pallas!r}"
+            )

@@ -1,0 +1,136 @@
+"""Pallas/Mosaic TPU kernels (SURVEY.md §7 step 6).
+
+The reference's native tier is C/C++ + OpenMP compute kernels (SURVEY.md §2
+#6); the TPU-native equivalent tier is hand-written Pallas kernels compiled
+by Mosaic for the chip. The hot dense primitive here is the **min-plus
+(tropical) product** — the inner op of the dense fan-out and of min-plus
+matrix squaring (``ops.relax.dense_fanout`` / ``apsp_minplus_squaring``):
+
+    out[i, j] = min_k d[i, k] + a[k, j]
+
+MXU note: the systolic array computes sum-of-products only, and the usual
+log-space trick for mapping min-plus onto matmul is numerically unusable
+(inf arithmetic + exp underflow destroy distances), so the correct unit for
+a tropical product on TPU is the VPU. What Pallas buys over the XLA
+broadcast formulation is explicit memory discipline: the output tile is
+pinned in VMEM across the whole k sweep while d/a tiles stream HBM->VMEM
+double-buffered by the pipeline — the blockwise-streaming pattern the XLA
+version can only approximate with lax.scan over materialized [I, kb, J]
+intermediates.
+
+All kernels take ``interpret=`` so CI without a TPU runs them in Python
+semantics (the race/aliasing check attested for native kernels — SURVEY.md
+§5 "race detection": TSan for the C++ backend, interpret mode for Pallas).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+INF = jnp.inf
+
+# f32 VPU tile is (8, 128): k is swept in 8-row sub-blocks so the broadcast
+# intermediate [bi, 8, bj] stays a few hundred KB of VMEM.
+_K_SUB = 8
+
+
+def _minplus_kernel(d_ref, a_ref, o_ref, *, k_sub: int):
+    """One (i, j, k) grid step: fold d[bi, bk] (x) a[bk, bj] into o[bi, bj].
+
+    Grid order puts k innermost, so o_ref revisits: initialize at k==0,
+    min-accumulate after. The fori_loop sweeps the k-block in ``k_sub``
+    sub-slabs to bound the [bi, k_sub, bj] broadcast intermediate.
+    """
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        o_ref[:] = jnp.full_like(o_ref, INF)
+
+    d_blk = d_ref[:]
+    a_blk = a_ref[:]
+    bi, bk = d_blk.shape
+    bj = a_blk.shape[1]
+
+    def body(s, acc):
+        ds = jax.lax.dynamic_slice(d_blk, (0, s * k_sub), (bi, k_sub))
+        as_ = jax.lax.dynamic_slice(a_blk, (s * k_sub, 0), (k_sub, bj))
+        cand = jnp.min(ds[:, :, None] + as_[None, :, :], axis=1)
+        return jnp.minimum(acc, cand)
+
+    o_ref[:] = jax.lax.fori_loop(0, bk // k_sub, body, o_ref[:])
+
+
+def _pad_to(x, rows: int, cols: int):
+    pr, pc = rows - x.shape[0], cols - x.shape[1]
+    if pr or pc:
+        x = jnp.pad(x, ((0, pr), (0, pc)), constant_values=INF)
+    return x
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_i", "block_j", "block_k", "interpret"),
+)
+def minplus_pallas(
+    d,
+    a,
+    *,
+    block_i: int = 256,
+    block_j: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+):
+    """Tropical product out[i, j] = min_k d[i, k] + a[k, j], Pallas-tiled.
+
+    d: [I, K], a: [K, J] (f32). +inf entries (non-edges / padding) are the
+    semiring identity and flow through untouched. Shapes are padded up to
+    the block grid with +inf and sliced back, so any I/K/J works.
+    """
+    i, k = d.shape
+    k2, j = a.shape
+    assert k == k2, (d.shape, a.shape)
+    # Block sizes are rounded up to hardware granularity: bi is a sublane
+    # dim (8 for f32); bj and bk are lane dims of their blocks (128) — bk
+    # is the minor axis of the d block, and a multiple of 128 is also a
+    # multiple of _K_SUB, so the fori_loop never drops remainder k-rows.
+    bi = _round_up(min(block_i, i), _K_SUB)
+    bj = _round_up(min(block_j, j), 128)
+    bk = _round_up(min(block_k, k), 128)
+    ip, kp, jp = _round_up(i, bi), _round_up(k, bk), _round_up(j, bj)
+    d = _pad_to(d, ip, kp)
+    a = _pad_to(a, kp, jp)
+
+    out = pl.pallas_call(
+        functools.partial(_minplus_kernel, k_sub=_K_SUB),
+        grid=(ip // bi, jp // bj, kp // bk),
+        in_specs=[
+            pl.BlockSpec((bi, bk), lambda gi, gj, gk: (gi, gk)),
+            pl.BlockSpec((bk, bj), lambda gi, gj, gk: (gk, gj)),
+        ],
+        out_specs=pl.BlockSpec((bi, bj), lambda gi, gj, gk: (gi, gj)),
+        out_shape=jax.ShapeDtypeStruct((ip, jp), d.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(d, a)
+    return out[:i, :j]
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+# -- sparse frontier sweep ---------------------------------------------------
+#
+# The CSR edge sweep (gather on src, scatter-min on dst) stays on the XLA
+# path (ops.relax.relax_sweep): arbitrary-index scatter inside a Pallas TPU
+# kernel serializes on the VPU lane permute network and loses to XLA's
+# deterministic segment_min lowering. Profiling note kept here so the
+# decision is revisitable (SURVEY.md §7 "only move the inner loop to Pallas
+# where profiling shows wins").

@@ -164,26 +164,29 @@ def minplus(d, a, *, k_block: int = 128):
     return out
 
 
-def apsp_minplus_squaring(a, *, k_block: int = 128):
+def apsp_minplus_squaring(a, *, k_block: int = 128, mp=None):
     """Full APSP of a dense adjacency by repeated min-plus squaring:
     D <- D (x) D doubles the path length covered, so ceil(log2 V) squarings
     reach the fixpoint — no negative cycles allowed (use after reweighting).
 
+    ``mp``: the min-plus product impl — defaults to the XLA ``minplus``;
+    the jax backend passes the Pallas kernel here on TPU.
     Returns (dist[V, V], squarings).
     """
     import math
 
+    mp = mp or functools.partial(minplus, k_block=k_block)
     v = a.shape[0]
     steps = max(1, math.ceil(math.log2(max(v, 2))))
 
     def body(d, _):
-        return minplus(d, d, k_block=k_block), None
+        return mp(d, d), None
 
     d, _ = lax.scan(body, a, None, length=steps)
     return d, steps
 
 
-def dense_fanout(a, sources, *, max_iter: int, k_block: int = 128):
+def dense_fanout(a, sources, *, max_iter: int, k_block: int = 128, mp=None):
     """N-source fan-out on a dense adjacency (0 diagonal, +inf non-edges).
 
     Two regimes, picked statically by source count:
@@ -197,10 +200,11 @@ def dense_fanout(a, sources, *, max_iter: int, k_block: int = 128):
     non-negative (post-reweighting), so still_improving after ``max_iter``
     means unconverged, never a negative cycle.
     """
+    mp = mp or functools.partial(minplus, k_block=k_block)
     v = a.shape[0]
     b = sources.shape[0]
     if 2 * b >= v:
-        full, steps = apsp_minplus_squaring(a, k_block=k_block)
+        full, steps = apsp_minplus_squaring(a, mp=mp)
         return full[sources, :], steps, jnp.bool_(False)
 
     d0 = multi_source_init(sources, v, a.dtype)
@@ -211,7 +215,7 @@ def dense_fanout(a, sources, *, max_iter: int, k_block: int = 128):
 
     def body(state):
         d, i, _ = state
-        nd = minplus(d, a, k_block=k_block)  # a's 0 diagonal keeps nd <= d
+        nd = mp(d, a)  # a's 0 diagonal keeps nd <= d
         return nd, i + 1, jnp.any(nd < d)
 
     return lax.while_loop(cond, body, (d0, jnp.int32(0), jnp.bool_(True)))

@@ -47,19 +47,38 @@ class BatchCheckpointer:
     def save(self, batch_idx: int, sources: np.ndarray, rows: np.ndarray) -> Path:
         path = self._path(batch_idx, sources)
         tmp = path.with_suffix(".tmp.npz")
-        np.savez_compressed(tmp, sources=np.asarray(sources, np.int64), rows=rows)
+        np.savez_compressed(
+            tmp,
+            sources=np.asarray(sources, np.int64),
+            rows=rows,
+            rows_sha=np.frombuffer(
+                hashlib.sha256(
+                    np.ascontiguousarray(rows).tobytes()
+                ).digest(),
+                np.uint8,
+            ),
+        )
         tmp.rename(path)  # atomic publish: partial writes never count as done
         return path
 
     def load(self, batch_idx: int, sources: np.ndarray) -> np.ndarray | None:
-        """Rows for this batch, or None if absent/corrupt (recompute)."""
+        """Rows for this batch, or None if absent/corrupt/tampered
+        (recompute — fault detection per SURVEY.md §5: a bit-flipped batch
+        result must be caught, not propagated into the APSP matrix)."""
         path = self._path(batch_idx, sources)
         if not path.exists():
             return None
         try:
             with np.load(path) as data:
-                if np.array_equal(data["sources"], np.asarray(sources, np.int64)):
-                    return data["rows"]
+                if not np.array_equal(data["sources"], np.asarray(sources, np.int64)):
+                    return None
+                rows = data["rows"]
+                if "rows_sha" not in data.files:
+                    return rows  # pre-checksum format: sources matched
+                want = data["rows_sha"].tobytes()
+                got = hashlib.sha256(np.ascontiguousarray(rows).tobytes()).digest()
+                if got == want:
+                    return rows
         except Exception:
             pass
         return None

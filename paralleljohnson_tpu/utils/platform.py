@@ -1,0 +1,24 @@
+"""Platform selection workaround, shared by CLI / bench entry points.
+
+A TPU PJRT plugin may monkeypatch jax's backend selection so that even
+``JAX_PLATFORMS=cpu`` initializes the TPU client (observed with the axon
+plugin: ``get_backend`` is wrapped and dials the device lease). The config
+update below is what actually routes to CPU; ``tests/conftest.py`` performs
+the same dance inline because it must also set ``XLA_FLAGS`` before jax's
+first import.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_cpu_platform_request() -> bool:
+    """If the environment asks for CPU (``JAX_PLATFORMS=cpu``), force jax's
+    platform config to cpu. Returns True iff the override was applied."""
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return False
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return True

@@ -1,0 +1,59 @@
+"""Tracing / profiling (SURVEY.md §5).
+
+Two mechanisms:
+  - :func:`device_trace` — a ``jax.profiler`` trace (Perfetto/XProf
+    protobufs under ``<dir>/plugins/profile``) around any region; each
+    solver phase is already wrapped in ``jax.named_scope`` by
+    ``utils.metrics.phase_timer``, so kernels inside the trace are
+    attributable to bellman_ford / fanout / reweight / upload.
+  - structured phase logs — :func:`log_stats` emits one JSON line per
+    solve with per-phase wall-clock, iterations-to-fixpoint, edges-relaxed
+    (the attested counter, BASELINE.json:2), and negative-cycle flags, to
+    stderr or a file (observability without a trace viewer).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import time
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str | None):
+    """Profile the enclosed region with ``jax.profiler.trace`` when
+    ``log_dir`` is set; no-op otherwise (so call sites need no branching)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named trace scope for ad-hoc regions (phases already get one via
+    ``phase_timer``)."""
+    import jax
+
+    return jax.named_scope(name)
+
+
+def log_stats(stats, *, label: str = "solve", stream=None, extra=None) -> dict:
+    """Emit one structured JSON log line for a completed solve.
+
+    Returns the payload dict (tests assert on it; callers may ship it to
+    any log sink). ``stream=None`` writes to stderr.
+    """
+    payload = {
+        "event": "pjtpu." + label,
+        "ts": time.time(),
+        **stats.as_dict(),
+    }
+    if extra:
+        payload.update(extra)
+    out = stream if stream is not None else sys.stderr
+    print(json.dumps(payload), file=out, flush=True)
+    return payload

@@ -71,3 +71,32 @@ def test_tmp_files_not_counted_done(tmp_path):
     # fake a crashed save
     (ck.dir / "rows_000001_deadbeef.tmp.npz").write_bytes(b"partial")
     assert ck.completed_batches() == [0]
+
+
+def test_tampered_rows_detected(tmp_path):
+    """Fault injection: a checkpoint whose rows were silently altered (valid
+    npz, matching sources, wrong content) is rejected via the rows checksum
+    and recomputed instead of being folded into the APSP matrix."""
+    g = erdos_renyi(24, 0.15, seed=9)
+    cfg = SolverConfig(backend="numpy", source_batch_size=24,
+                       checkpoint_dir=str(tmp_path))
+    clean = ParallelJohnsonSolver(cfg).solve(g)
+    f = next(tmp_path.rglob("rows_*.npz"))
+    with np.load(f) as data:
+        payload = {k: data[k] for k in data.files}
+    payload["rows"] = payload["rows"] + 1.0  # bit-flip analogue, stale sha
+    np.savez_compressed(f, **payload)
+    again = ParallelJohnsonSolver(cfg).solve(g)
+    assert again.stats.batches_resumed == 0
+    np.testing.assert_array_equal(clean.matrix, again.matrix)
+
+
+def test_legacy_checkpoint_without_checksum_resumes(tmp_path):
+    """Checkpoints from the pre-checksum format (no rows_sha) still load."""
+    ck = BatchCheckpointer(tmp_path)
+    sources = np.array([0, 1, 2])
+    rows = np.arange(12, dtype=np.float32).reshape(3, 4)
+    path = ck._path(0, sources)
+    np.savez_compressed(path, sources=sources.astype(np.int64), rows=rows)
+    loaded = ck.load(0, sources)
+    np.testing.assert_array_equal(loaded, rows)

@@ -60,3 +60,34 @@ def test_negative_cycle_exit_code(tmp_path, capsys):
 def test_bad_graph_spec_exit_code(capsys):
     assert main(["solve", "bogus.xyz", "--backend", "numpy"]) == 1
     assert "error:" in capsys.readouterr().err
+
+
+def test_cli_profile_and_log_stats(tmp_path, capsys):
+    """--profile writes a device trace; --log-stats emits one JSON line."""
+    import json
+
+    from paralleljohnson_tpu.cli import main
+
+    trace_dir = tmp_path / "trace"
+    rc = main(["solve", "er:n=24,p=0.2,seed=1", "--backend", "jax",
+               "--profile", str(trace_dir), "--log-stats", "--json"])
+    assert rc == 0
+    out, err = capsys.readouterr()
+    assert json.loads(out.strip().splitlines()[-1])["edges_relaxed"] > 0
+    stats_line = json.loads(err.strip().splitlines()[-1])
+    assert stats_line["event"] == "pjtpu.solve"
+    assert stats_line["edges_relaxed"] > 0
+    # jax.profiler lays traces under plugins/profile/<run>/
+    assert any(trace_dir.rglob("*.xplane.pb")) or any(trace_dir.iterdir())
+
+
+def test_cli_use_pallas_flag(capsys):
+    import json
+
+    from paralleljohnson_tpu.cli import main
+
+    rc = main(["solve", "er:n=24,p=0.2,seed=4", "--backend", "jax",
+               "--use-pallas", "true", "--json", "--validate"])
+    assert rc == 0
+    out, _ = capsys.readouterr()
+    assert json.loads(out.strip().splitlines()[-1])["finite_fraction"] > 0

@@ -1,0 +1,119 @@
+"""Pallas kernel tests — interpret mode (SURVEY.md §5 race detection:
+``interpret=True`` runs the kernel in Python semantics to catch indexing/
+aliasing bugs without a TPU; the identical kernel compiles via Mosaic on
+chip)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from paralleljohnson_tpu.ops import relax
+from paralleljohnson_tpu.ops.pallas_kernels import minplus_pallas
+
+
+def _rand_minplus_operands(rng, i, k, j, inf_frac=0.3):
+    d = rng.random((i, k)).astype(np.float32)
+    a = rng.random((k, j)).astype(np.float32)
+    d[rng.random((i, k)) < inf_frac] = np.inf
+    a[rng.random((k, j)) < inf_frac] = np.inf
+    return d, a
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [(5, 7, 9), (8, 128, 128), (128, 128, 128), (100, 300, 50), (1, 1, 1)],
+)
+def test_minplus_pallas_matches_xla(shape):
+    i, k, j = shape
+    rng = np.random.default_rng(sum(shape))
+    d, a = _rand_minplus_operands(rng, i, k, j)
+    want = np.asarray(relax.minplus(jnp.asarray(d), jnp.asarray(a)))
+    got = np.asarray(
+        minplus_pallas(jnp.asarray(d), jnp.asarray(a), interpret=True)
+    )
+    assert got.shape == want.shape == (i, j)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_minplus_pallas_all_inf_rows():
+    # +inf is the semiring identity: an unreachable row stays unreachable.
+    d = np.full((4, 16), np.inf, np.float32)
+    a = np.zeros((16, 16), np.float32)
+    out = np.asarray(minplus_pallas(jnp.asarray(d), jnp.asarray(a), interpret=True))
+    assert np.isinf(out).all()
+
+
+def test_minplus_pallas_blocking_invariance():
+    rng = np.random.default_rng(3)
+    d, a = _rand_minplus_operands(rng, 48, 96, 72)
+    ref = np.asarray(minplus_pallas(jnp.asarray(d), jnp.asarray(a), interpret=True))
+    small = np.asarray(
+        minplus_pallas(
+            jnp.asarray(d), jnp.asarray(a),
+            block_i=16, block_j=128, block_k=16, interpret=True,
+        )
+    )
+    np.testing.assert_array_equal(ref, small)
+
+
+def test_dense_fanout_with_pallas_mp():
+    """dense_fanout with the Pallas product matches the scipy oracle."""
+    import functools
+    import scipy.sparse.csgraph as csgraph
+
+    from paralleljohnson_tpu.graphs import erdos_renyi
+
+    g = erdos_renyi(40, 0.15, seed=11)
+    a = relax.dense_adjacency(
+        jnp.asarray(g.src, jnp.int32),
+        jnp.asarray(g.indices, jnp.int32),
+        jnp.asarray(g.weights, jnp.float32),
+        g.num_nodes,
+    )
+    sources = jnp.arange(8, dtype=jnp.int32)
+    mp = functools.partial(minplus_pallas, interpret=True)
+    dist, iters, improving = relax.dense_fanout(
+        a, sources, max_iter=g.num_nodes, mp=mp
+    )
+    dense = np.ma.masked_invalid(g.to_dense().astype(np.float64))
+    oracle = csgraph.dijkstra(dense, directed=True, indices=np.arange(8))
+    np.testing.assert_allclose(np.asarray(dist), oracle, rtol=1e-5, atol=1e-5)
+    assert not bool(improving)
+
+
+def test_jax_backend_pallas_flag():
+    """use_pallas=True routes the dense fan-out through the Pallas product
+    (interpret mode off-TPU) and still matches the oracle."""
+    import scipy.sparse.csgraph as csgraph
+
+    from paralleljohnson_tpu.backends import get_backend
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.graphs import erdos_renyi
+
+    g = erdos_renyi(48, 0.12, seed=5)
+    cfg = SolverConfig(use_pallas=True, dense_threshold=1024, mesh_shape=(1,))
+    backend = get_backend("jax", cfg)
+    dgraph = backend.upload(g)
+    sources = np.arange(g.num_nodes)
+    res = backend.multi_source(dgraph, sources)
+    dense = np.ma.masked_invalid(g.to_dense().astype(np.float64))
+    oracle = csgraph.dijkstra(dense, directed=True)
+    np.testing.assert_allclose(res.dist, oracle, rtol=1e-5, atol=1e-5)
+
+
+def test_minplus_pallas_odd_block_k():
+    """block_k not a multiple of the k sub-slab must not drop k-rows."""
+    rng = np.random.default_rng(17)
+    d, a = _rand_minplus_operands(rng, 16, 20, 16)
+    want = np.asarray(relax.minplus(jnp.asarray(d), jnp.asarray(a)))
+    got = np.asarray(
+        minplus_pallas(jnp.asarray(d), jnp.asarray(a), block_k=12, interpret=True)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_use_pallas_config_validation():
+    from paralleljohnson_tpu.config import SolverConfig
+
+    with pytest.raises(ValueError, match="use_pallas"):
+        SolverConfig(use_pallas="false")
