@@ -1,0 +1,270 @@
+"""Benchmark harness for the five attested configs (SURVEY.md §2 #14,
+BASELINE.json:6-12).
+
+Each config is a callable returning a result record; the harness times the
+solve, folds in the attested edges-relaxed counters (BASELINE.json:2
+"edges-relaxed/sec/chip"), and emits one JSON line per run. ``pjtpu bench``
+is the CLI front end; ``update_baseline_md`` rewrites the measured-numbers
+table in BASELINE.md.
+
+Dataset stand-ins (zero-egress environment — the public files cannot be
+downloaded): DIMACS-NY road graph -> ``grid2d`` lattice with matching node
+count/diameter profile and safe negative weights; SNAP ego-Facebook ->
+R-MAT scale-12 power-law graph with matching node/edge counts. Swap in the
+real files via ``dimacs:<path>`` / ``snap:<path>`` specs when present.
+
+Presets scale every config: ``smoke`` (CI, seconds), ``mini`` (single-chip
+sanity), ``full`` (the attested benchmark sizes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Callable
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class BenchRecord:
+    config: str
+    backend: str
+    preset: str
+    wall_s: float
+    edges_relaxed: int
+    edges_relaxed_per_sec: float
+    n_chips: int
+    detail: dict
+
+    def as_json_line(self) -> str:
+        d = dataclasses.asdict(self)
+        d["edges_relaxed_per_sec_per_chip"] = (
+            self.edges_relaxed_per_sec / max(self.n_chips, 1)
+        )
+        return json.dumps(d)
+
+
+# -- sizing tables -----------------------------------------------------------
+
+_PRESETS = ("smoke", "mini", "full")
+
+_SIZES = {
+    #                 smoke            mini              full (attested)
+    "er1k_apsp":     dict(n=64,        mini_n=256,       full_n=1000),
+    "dimacs_ny_bf":  dict(rows=24,     mini_rows=96,     full_rows=515),
+    "ego_fb_nsource": dict(scale=8,    mini_scale=10,    full_scale=12,
+                          sources=16,  mini_sources=64,  full_sources=512),
+    "rmat_apsp":     dict(scale=8,     mini_scale=12,    full_scale=20,
+                          sources=8,   mini_sources=32,  full_sources=128),
+    "batch_small":   dict(count=32,    mini_count=512,   full_count=10000),
+}
+
+
+def _sz(config: str, key: str, preset: str):
+    table = _SIZES[config]
+    if preset == "smoke":
+        return table[key]
+    return table[f"{preset}_{key}"]
+
+
+def _n_chips() -> int:
+    import jax
+
+    return max(1, len(jax.devices()))
+
+
+def _platform() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def _solver(backend: str, **cfg_overrides):
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+    return ParallelJohnsonSolver(SolverConfig(backend=backend, **cfg_overrides))
+
+
+# -- the five configs --------------------------------------------------------
+
+
+def bench_er1k_apsp(backend: str, preset: str) -> BenchRecord:
+    """Config 1 (BASELINE.json:7): Johnson APSP on an ER graph
+    (full: 1k nodes, p=0.01) — the correctness-scale reference config."""
+    from paralleljohnson_tpu.graphs import erdos_renyi
+
+    n = _sz("er1k_apsp", "n", preset)
+    g = erdos_renyi(n, 0.01 if n >= 256 else 0.1, seed=42)
+    solver = _solver(backend)
+    solver.solve(g)  # warm compile caches
+    t0 = time.perf_counter()
+    res = solver.solve(g)
+    wall = time.perf_counter() - t0
+    return BenchRecord(
+        "er1k_apsp", backend, preset, wall,
+        res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
+        {"nodes": g.num_nodes, "edges": g.num_real_edges,
+         "finite_frac": float(np.isfinite(res.dist).mean())},
+    )
+
+
+def bench_dimacs_ny_bf(backend: str, preset: str) -> BenchRecord:
+    """Config 2 (BASELINE.json:8): standalone Bellman-Ford SSSP on a
+    negative-weight road graph (high-diameter sweep stress). Stand-in:
+    ``grid2d`` lattice (see module docstring)."""
+    from paralleljohnson_tpu.graphs import grid2d
+
+    rows = _sz("dimacs_ny_bf", "rows", preset)
+    g = grid2d(rows, rows, negative_fraction=0.2, seed=7)
+    solver = _solver(backend)
+    solver.sssp(g, 0)  # warm
+    t0 = time.perf_counter()
+    res = solver.sssp(g, 0)
+    wall = time.perf_counter() - t0
+    return BenchRecord(
+        "dimacs_ny_bf", backend, preset, wall,
+        res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
+        {"nodes": g.num_nodes, "edges": g.num_real_edges,
+         "sweeps": res.stats.iterations_by_phase.get("bellman_ford", 0),
+         "reached_frac": float(np.isfinite(res.dist).mean())},
+    )
+
+
+def bench_ego_fb_nsource(backend: str, preset: str) -> BenchRecord:
+    """Config 3 (BASELINE.json:9): batched N-source fan-out on a
+    non-negative power-law graph (ego-Facebook profile). Stand-in: R-MAT
+    (see module docstring)."""
+    from paralleljohnson_tpu.graphs import rmat
+
+    scale = _sz("ego_fb_nsource", "scale", preset)
+    n_sources = _sz("ego_fb_nsource", "sources", preset)
+    g = rmat(scale, 16, seed=3)
+    rng = np.random.default_rng(0)
+    sources = np.sort(rng.choice(g.num_nodes, size=min(n_sources, g.num_nodes),
+                                 replace=False))
+    solver = _solver(backend)
+    solver.multi_source(g, sources)  # warm
+    t0 = time.perf_counter()
+    res = solver.multi_source(g, sources)
+    wall = time.perf_counter() - t0
+    return BenchRecord(
+        "ego_fb_nsource", backend, preset, wall,
+        res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
+        {"nodes": g.num_nodes, "edges": g.num_real_edges,
+         "sources": len(sources)},
+    )
+
+
+def bench_rmat_apsp(backend: str, preset: str) -> BenchRecord:
+    """Config 4 (BASELINE.json:10): Johnson APSP on R-MAT (full: scale 20;
+    scale 22 via PJ_BENCH_RMAT_SCALE). The full distance matrix is not
+    materializable at scale 22 (~70 PB, SURVEY.md §7); per the attested
+    metric the harness solves a source subset and reduces rows to a
+    checksum — rows stream through, never accumulate."""
+    import os
+
+    from paralleljohnson_tpu.graphs import rmat
+
+    scale = int(os.environ.get("PJ_BENCH_RMAT_SCALE", 0)) or _sz(
+        "rmat_apsp", "scale", preset)
+    n_sources = _sz("rmat_apsp", "sources", preset)
+    g = rmat(scale, 16, seed=42)
+    rng = np.random.default_rng(1)
+    sources = np.sort(rng.choice(g.num_nodes, size=n_sources, replace=False))
+    solver = _solver(backend)
+    small = sources[: max(2, n_sources // 8)]
+    solver.solve(g, sources=small)  # warm at reduced batch
+    t0 = time.perf_counter()
+    res = solver.solve(g, sources=sources)
+    wall = time.perf_counter() - t0
+    checksum = float(np.where(np.isfinite(res.dist), res.dist, 0.0).sum())
+    return BenchRecord(
+        "rmat_apsp", backend, preset, wall,
+        res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
+        {"scale": scale, "nodes": g.num_nodes, "edges": g.num_real_edges,
+         "sources": n_sources, "rows_checksum": checksum},
+    )
+
+
+def bench_batch_small(backend: str, preset: str) -> BenchRecord:
+    """Config 5 (BASELINE.json:11): many-small-graphs vmapped APSP
+    (full: 10k random 256-node graphs)."""
+    from paralleljohnson_tpu.graphs import random_graph_batch
+
+    count = _sz("batch_small", "count", preset)
+    nodes = 64 if preset == "smoke" else 256
+    graphs = random_graph_batch(count, nodes, 8.0 / nodes, seed=0)
+    solver = _solver(backend)
+    solver.solve_batch(graphs[: max(2, count // 16)])  # warm
+    t0 = time.perf_counter()
+    results = solver.solve_batch(graphs)
+    wall = time.perf_counter() - t0
+    stats = results[0].stats
+    return BenchRecord(
+        "batch_small", backend, preset, wall,
+        stats.edges_relaxed, stats.edges_relaxed / wall, _n_chips(),
+        {"graphs": count, "nodes_each": nodes},
+    )
+
+
+CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
+    "er1k_apsp": bench_er1k_apsp,
+    "dimacs_ny_bf": bench_dimacs_ny_bf,
+    "ego_fb_nsource": bench_ego_fb_nsource,
+    "rmat_apsp": bench_rmat_apsp,
+    "batch_small": bench_batch_small,
+}
+
+
+def run(
+    names: list[str] | None = None,
+    *,
+    backend: str = "jax",
+    preset: str = "mini",
+) -> list[BenchRecord]:
+    if preset not in _PRESETS:
+        raise ValueError(f"preset must be one of {_PRESETS}, got {preset!r}")
+    records = []
+    for name in names or list(CONFIGS):
+        rec = CONFIGS[name](backend, preset)
+        rec.detail["platform"] = _platform()
+        records.append(rec)
+    return records
+
+
+# -- BASELINE.md maintenance -------------------------------------------------
+
+_MARKER_BEGIN = "<!-- bench:begin -->"
+_MARKER_END = "<!-- bench:end -->"
+
+
+def update_baseline_md(records: list[BenchRecord], path: str) -> None:
+    """Rewrite the measured-numbers block (between the bench markers) of
+    BASELINE.md with the given records, newest run wins per
+    (config, backend, preset)."""
+    from pathlib import Path
+
+    p = Path(path)
+    text = p.read_text() if p.exists() else "# BASELINE\n"
+    lines = [
+        "| config | backend | preset | wall s | edges relaxed | edges/s/chip | detail |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in records:
+        per_chip = r.edges_relaxed_per_sec / max(r.n_chips, 1)
+        lines.append(
+            f"| {r.config} | {r.backend} | {r.preset} | {r.wall_s:.3f} "
+            f"| {r.edges_relaxed:,} | {per_chip:,.0f} "
+            f"| {json.dumps(r.detail, sort_keys=True)} |"
+        )
+    block = f"{_MARKER_BEGIN}\n" + "\n".join(lines) + f"\n{_MARKER_END}"
+    if _MARKER_BEGIN in text and _MARKER_END in text:
+        head, rest = text.split(_MARKER_BEGIN, 1)
+        _, tail = rest.split(_MARKER_END, 1)
+        text = head + block + tail
+    else:
+        text = text.rstrip() + "\n\n## Measured results (ours)\n\n" + block + "\n"
+    p.write_text(text)

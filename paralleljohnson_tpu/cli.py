@@ -111,6 +111,15 @@ def main(argv: list[str] | None = None) -> int:
     p_batch.add_argument("--seed", type=int, default=0)
     _add_common(p_batch)
 
+    p_bench = sub.add_parser("bench", help="attested benchmark configs")
+    p_bench.add_argument("configs", nargs="*",
+                         help="subset of configs (default: all five)")
+    p_bench.add_argument("--backend", default="jax")
+    p_bench.add_argument("--preset", default="mini",
+                         choices=["smoke", "mini", "full"])
+    p_bench.add_argument("--update-baseline", default=None, metavar="MD",
+                         help="rewrite the measured table in this BASELINE.md")
+
     p_info = sub.add_parser("info", help="environment / plugin summary")
     p_info.add_argument("--json", action="store_true", dest="as_json")
 
@@ -127,6 +136,18 @@ def main(argv: list[str] | None = None) -> int:
         load_graph,
     )
     from paralleljohnson_tpu.graphs import available_loaders, random_graph_batch
+
+    if args.command == "bench":
+        from paralleljohnson_tpu import benchmarks
+
+        records = benchmarks.run(
+            args.configs or None, backend=args.backend, preset=args.preset
+        )
+        for r in records:
+            print(r.as_json_line())
+        if args.update_baseline:
+            benchmarks.update_baseline_md(records, args.update_baseline)
+        return 0
 
     if args.command == "info":
         import jax

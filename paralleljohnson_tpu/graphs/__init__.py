@@ -3,6 +3,7 @@
 from paralleljohnson_tpu.graphs.csr import CSRGraph, PAD_WEIGHT, stack_graphs
 from paralleljohnson_tpu.graphs.generators import (
     erdos_renyi,
+    grid2d,
     random_dag,
     random_graph_batch,
     rmat,
@@ -19,6 +20,7 @@ __all__ = [
     "PAD_WEIGHT",
     "available_loaders",
     "erdos_renyi",
+    "grid2d",
     "load_dimacs",
     "load_graph",
     "load_snap",

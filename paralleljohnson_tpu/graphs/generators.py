@@ -125,3 +125,39 @@ def random_graph_batch(
                     dtype=dtype)
         for i in range(batch)
     ]
+
+
+def grid2d(
+    rows: int,
+    cols: int,
+    *,
+    weight_range: tuple[float, float] = (1.0, 10.0),
+    negative_fraction: float = 0.0,
+    seed: int = 0,
+    dtype=np.float32,
+) -> CSRGraph:
+    """Road-network-like graph: a 2-D lattice with bidirectional edges and
+    O(rows+cols) diameter — the high-diameter stress profile of the DIMACS
+    road graphs (BASELINE.json:8 "DIMACS-NY"), which cannot be downloaded
+    in this zero-egress environment; benchmarks use this as the documented
+    stand-in (DIMACS-NY: 264k nodes / 733k arcs / diameter ~700; a 515x515
+    grid matches the node count and stresses the same sweep-count regime).
+
+    ``negative_fraction`` negates weights only on lexicographically forward
+    edges (u < v), which cannot close a cycle by themselves, keeping the
+    graph free of negative cycles for any fraction.
+    """
+    rng = np.random.default_rng(seed)
+    n = rows * cols
+    idx = np.arange(n, dtype=np.int64).reshape(rows, cols)
+    right = np.stack([idx[:, :-1].ravel(), idx[:, 1:].ravel()])
+    down = np.stack([idx[:-1, :].ravel(), idx[1:, :].ravel()])
+    fwd = np.concatenate([right, down], axis=1)
+    src = np.concatenate([fwd[0], fwd[1]])
+    dst = np.concatenate([fwd[1], fwd[0]])
+    w = rng.uniform(*weight_range, size=src.shape[0]).astype(dtype)
+    if negative_fraction > 0:
+        forward = src < dst
+        neg = (rng.random(src.shape[0]) < negative_fraction) & forward
+        w = np.where(neg, -0.1 * w, w).astype(dtype)
+    return CSRGraph.from_edges(src, dst, w, n, dtype=dtype)

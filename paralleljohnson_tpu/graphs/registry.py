@@ -9,6 +9,7 @@ file extension. Built-in schemes:
   - ``er:n=1000,p=0.01[,neg=0.2][,seed=0]``      — Erdős–Rényi
   - ``dag:n=1000,p=0.01[,neg=0.3][,seed=0]``     — acyclic ER (safe negatives)
   - ``rmat:scale=20[,ef=16][,seed=0]``           — R-MAT
+  - ``grid:rows=512,cols=512[,neg=0.2][,seed=0]`` — road-like 2-D lattice
 """
 
 from __future__ import annotations
@@ -73,11 +74,21 @@ def _rmat_loader(rest: str) -> CSRGraph:
     )
 
 
+def _grid_loader(rest: str) -> CSRGraph:
+    kw = _parse_kwargs(rest)
+    return generators.grid2d(
+        int(kw["rows"]), int(kw["cols"]),
+        negative_fraction=float(kw.get("neg", 0.0)),
+        seed=int(kw.get("seed", 0)),
+    )
+
+
 register_loader("dimacs", loaders.load_dimacs)
 register_loader("snap", loaders.load_snap)
 register_loader("er", _er_loader)
 register_loader("dag", _dag_loader)
 register_loader("rmat", _rmat_loader)
+register_loader("grid", _grid_loader)
 
 
 def load_graph(spec: str | Path) -> CSRGraph:

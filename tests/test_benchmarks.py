@@ -1,0 +1,48 @@
+"""Benchmark-harness smoke tests (SURVEY.md §4: each attested config at
+miniature scale, shape/convergence only)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import benchmarks
+
+
+@pytest.mark.parametrize("name", sorted(benchmarks.CONFIGS))
+def test_config_smoke(name):
+    (rec,) = benchmarks.run([name], backend="jax", preset="smoke")
+    assert rec.config == name
+    assert rec.wall_s > 0
+    assert rec.edges_relaxed > 0
+    line = json.loads(rec.as_json_line())
+    assert line["edges_relaxed_per_sec_per_chip"] > 0
+
+
+def test_unknown_preset_rejected():
+    with pytest.raises(ValueError, match="preset"):
+        benchmarks.run(["er1k_apsp"], preset="huge")
+
+
+def test_update_baseline_md(tmp_path):
+    (rec,) = benchmarks.run(["er1k_apsp"], backend="numpy", preset="smoke")
+    md = tmp_path / "BASELINE.md"
+    md.write_text("# BASELINE\n\nheader text\n")
+    benchmarks.update_baseline_md([rec], str(md))
+    text = md.read_text()
+    assert "er1k_apsp" in text and "header text" in text
+    # idempotent: re-running replaces the block, not appends
+    benchmarks.update_baseline_md([rec], str(md))
+    assert md.read_text().count("er1k_apsp") == text.count("er1k_apsp")
+
+
+def test_cli_bench_subcommand(capsys, tmp_path):
+    from paralleljohnson_tpu.cli import main
+
+    md = tmp_path / "B.md"
+    rc = main(["bench", "er1k_apsp", "--backend", "numpy",
+               "--preset", "smoke", "--update-baseline", str(md)])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1])["config"] == "er1k_apsp"
+    assert "er1k_apsp" in md.read_text()

@@ -62,3 +62,31 @@ def test_random_graph_batch():
     assert graphs[0].num_edges != graphs[1].num_edges or not np.array_equal(
         graphs[0].indices, graphs[1].indices
     )
+
+
+def test_grid2d_shape_and_no_negative_cycle():
+    from paralleljohnson_tpu.graphs.generators import grid2d
+
+    g = grid2d(6, 5, negative_fraction=0.3, seed=1)
+    assert g.num_nodes == 30
+    # 2 * (rows*(cols-1) + (rows-1)*cols) directed edges
+    assert g.num_real_edges == 2 * (6 * 4 + 5 * 5)
+    assert g.has_negative_weights
+    # Johnson must succeed (no negative cycle by construction)
+    from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+
+    res = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g)
+    import numpy as np
+    assert np.isfinite(res.matrix).all()
+
+
+def test_grid2d_diameter_scales():
+    """The lattice has O(rows+cols) hop diameter (road-graph stress)."""
+    import numpy as np
+
+    from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+    from paralleljohnson_tpu.graphs.generators import grid2d
+
+    g = grid2d(8, 8, seed=0)
+    res = ParallelJohnsonSolver(SolverConfig(backend="jax")).sssp(g, 0)
+    assert res.stats.iterations_by_phase["bellman_ford"] >= 8
