@@ -20,10 +20,13 @@ from paralleljohnson_tpu.solver import (
     ValidationError,
 )
 from paralleljohnson_tpu.backends import Backend, available_backends, get_backend
+from paralleljohnson_tpu.utils.paths import path_weight, reconstruct_path
 
 __version__ = "0.1.0"
 
 __all__ = [
+    "path_weight",
+    "reconstruct_path",
     "Backend",
     "CSRGraph",
     "ConvergenceError",

@@ -48,6 +48,7 @@ class KernelResult:
     iterations: int = 0
     edges_relaxed: int = 0
     converged: bool = True
+    pred: np.ndarray | None = None  # predecessor vertices, -1 = none
 
 
 class Backend(abc.ABC):
@@ -83,6 +84,18 @@ class Backend(abc.ABC):
     def multi_source(self, dgraph: Any, sources: np.ndarray) -> KernelResult:
         """N-source shortest paths on a non-negative graph ("Dijkstra
         fan-out"). Returns dist[B, V] in the order of ``sources``."""
+
+    # -- optional capabilities ----------------------------------------------
+
+    def bellman_ford_pred(self, dgraph: Any, source: int | None) -> KernelResult:
+        """Like :meth:`bellman_ford` but fills ``KernelResult.pred`` with the
+        shortest-path tree (−1 at the source / unreached). Optional."""
+        raise NotImplementedError(f"{self.name} does not track predecessors")
+
+    def multi_source_pred(self, dgraph: Any, sources: np.ndarray) -> KernelResult:
+        """Like :meth:`multi_source` but fills ``KernelResult.pred`` [B, V].
+        Optional."""
+        raise NotImplementedError(f"{self.name} does not track predecessors")
 
     # -- optional fast paths (defaults compose the kernels host-side) -------
 

@@ -62,6 +62,25 @@ def _fanout_kernel(
 _reweight_kernel = jax.jit(relax.reweight_weights)
 
 
+@functools.partial(jax.jit, static_argnames=("max_iter", "edge_chunk"))
+def _bf_pred_kernel(dist0, src, dst, w, *, max_iter: int, edge_chunk: int):
+    return relax.bellman_ford_sweeps_pred(
+        dist0, src, dst, w, max_iter=max_iter, edge_chunk=edge_chunk
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_nodes", "max_iter", "edge_chunk")
+)
+def _fanout_pred_kernel(
+    sources, src, dst, w, *, num_nodes: int, max_iter: int, edge_chunk: int
+):
+    dist0 = relax.multi_source_init(sources, num_nodes, dtype=w.dtype)
+    return relax.bellman_ford_sweeps_pred(
+        dist0, src, dst, w, max_iter=max_iter, edge_chunk=edge_chunk
+    )
+
+
 def _minplus_impl(use_pallas: bool, interpret: bool):
     """The min-plus product impl for dense kernels: the Pallas/Mosaic tile
     kernel (SURVEY.md §7 step 6) or None (the XLA blocked fallback)."""
@@ -183,6 +202,67 @@ class JaxBackend(Backend):
             converged=not improving,
             iterations=iters,
             edges_relaxed=iters * dgraph.num_real_edges,
+        )
+
+    def bellman_ford_pred(self, dgraph: JaxDeviceGraph, source: int | None) -> KernelResult:
+        if source is None:
+            # Same contract as the numpy backend: the virtual-source pass
+            # computes potentials, not paths — there is no tree to report.
+            raise NotImplementedError(
+                "virtual-source Bellman-Ford has no predecessor tree"
+            )
+        v = dgraph.num_nodes
+        dist0 = jnp.full(v, jnp.inf, self._dtype).at[source].set(0.0)
+        max_iter = self.config.max_iterations or v
+        chunk = _edge_chunk_for(1, dgraph.src.shape[0])
+        dist, pred, iters, improving = _bf_pred_kernel(
+            dist0, dgraph.src, dgraph.dst, dgraph.weights,
+            max_iter=max_iter, edge_chunk=chunk,
+        )
+        iters = int(iters)
+        improving = bool(improving)
+        return KernelResult(
+            dist=np.asarray(dist),
+            pred=np.asarray(pred),
+            negative_cycle=improving and max_iter >= v,
+            converged=not improving,
+            iterations=iters,
+            edges_relaxed=iters * dgraph.num_real_edges,
+        )
+
+    def multi_source_pred(self, dgraph: JaxDeviceGraph, sources: np.ndarray) -> KernelResult:
+        """Fan-out with predecessor tracking. Always the sparse sweep path
+        (the dense min-plus kernels do not carry argmins); sources are
+        sharded across the mesh exactly as in :meth:`multi_source`."""
+        v = dgraph.num_nodes
+        sources = jnp.asarray(sources, jnp.int32)
+        max_iter = self.config.max_iterations or v
+        mesh = self._mesh()
+        if mesh.devices.size > 1:
+            from paralleljohnson_tpu.parallel import sharded_fanout
+
+            chunk = _edge_chunk_for(
+                -(-sources.shape[0] // mesh.devices.size),
+                dgraph.src.shape[0],
+            )
+            dist, iters, improving, pred = sharded_fanout(
+                mesh, sources, dgraph.src, dgraph.dst, dgraph.weights,
+                num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                with_pred=True,
+            )
+        else:
+            chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
+            dist, pred, iters, improving = _fanout_pred_kernel(
+                sources, dgraph.src, dgraph.dst, dgraph.weights,
+                num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+            )
+        iters = int(iters)
+        return KernelResult(
+            dist=np.asarray(dist),
+            pred=np.asarray(pred),
+            converged=not bool(improving),
+            iterations=iters,
+            edges_relaxed=iters * dgraph.num_real_edges * int(sources.shape[0]),
         )
 
     def _pallas_mode(self) -> tuple[bool, bool]:

@@ -65,6 +65,14 @@ class NumpyBackend(Backend):
         )
 
     def multi_source(self, dgraph: CSRGraph, sources: np.ndarray) -> KernelResult:
+        return self._multi_source(dgraph, sources, with_pred=False)
+
+    def multi_source_pred(self, dgraph: CSRGraph, sources: np.ndarray) -> KernelResult:
+        return self._multi_source(dgraph, sources, with_pred=True)
+
+    def _multi_source(
+        self, dgraph: CSRGraph, sources: np.ndarray, *, with_pred: bool
+    ) -> KernelResult:
         g = dgraph
         if g.has_negative_weights:
             raise ValueError("multi_source requires non-negative weights")
@@ -74,12 +82,50 @@ class NumpyBackend(Backend):
         sources = np.asarray(sources, np.int64)
         # Explicitly-stored zeros in a sparse csgraph input are true
         # zero-weight edges (reweighted tree edges are exactly 0).
-        dist = csgraph.dijkstra(mat, directed=True, indices=sources)
+        pred = None
+        if with_pred:
+            dist, pred = csgraph.dijkstra(
+                mat, directed=True, indices=sources, return_predecessors=True
+            )
+            # scipy's "no predecessor" sentinel is -9999; normalize to -1.
+            pred = np.where(pred < 0, -1, pred).astype(np.int32)
+        else:
+            dist = csgraph.dijkstra(mat, directed=True, indices=sources)
         # Heap Dijkstra scans each settled vertex's out-edges once: <= E per
         # source (the conventional count for this kernel).
         return KernelResult(
             dist=dist.astype(g.dtype),
+            pred=pred,
             edges_relaxed=int(len(sources)) * g.num_edges,
+        )
+
+    def bellman_ford_pred(self, dgraph: CSRGraph, source: int | None) -> KernelResult:
+        """Predecessor-tracking SSSP via the scipy Bellman-Ford (real
+        sources only; the virtual-source variant has no tree to report)."""
+        if source is None:
+            raise NotImplementedError(
+                "virtual-source Bellman-Ford has no predecessor tree"
+            )
+        g = dgraph
+        mat = sp.csr_matrix(
+            (g.weights, g.indices, g.indptr), shape=(g.num_nodes, g.num_nodes)
+        )
+        try:
+            dist, pred = csgraph.bellman_ford(
+                mat, directed=True, indices=source, return_predecessors=True
+            )
+        except csgraph.NegativeCycleError:
+            return KernelResult(
+                dist=np.full(g.num_nodes, np.nan, g.dtype),
+                negative_cycle=True, converged=False,
+                iterations=g.num_nodes, edges_relaxed=g.num_nodes * g.num_edges,
+            )
+        pred = np.where(pred < 0, -1, pred).astype(np.int32)
+        return KernelResult(
+            dist=dist.astype(g.dtype),
+            pred=pred,
+            iterations=g.num_nodes,
+            edges_relaxed=g.num_nodes * g.num_edges,
         )
 
 

@@ -202,10 +202,13 @@ def bench_batch_small(backend: str, preset: str) -> BenchRecord:
     t0 = time.perf_counter()
     results = solver.solve_batch(graphs)
     wall = time.perf_counter() - t0
-    stats = results[0].stats
+    # The vectorized path shares ONE stats object across results; the
+    # per-graph fallback (backends without batch_apsp) gives each result
+    # its own — sum over distinct objects so both report the whole batch.
+    edges = sum(s.edges_relaxed for s in {id(r.stats): r.stats for r in results}.values())
     return BenchRecord(
         "batch_small", backend, preset, wall,
-        stats.edges_relaxed, stats.edges_relaxed / wall, _n_chips(),
+        edges, edges / wall, _n_chips(),
         {"graphs": count, "nodes_each": nodes},
     )
 
@@ -227,8 +230,14 @@ def run(
 ) -> list[BenchRecord]:
     if preset not in _PRESETS:
         raise ValueError(f"preset must be one of {_PRESETS}, got {preset!r}")
+    names = names or list(CONFIGS)
+    unknown = [n for n in names if n not in CONFIGS]
+    if unknown:
+        raise ValueError(
+            f"unknown config(s) {unknown}; available: {sorted(CONFIGS)}"
+        )
     records = []
-    for name in names or list(CONFIGS):
+    for name in names:
         rec = CONFIGS[name](backend, preset)
         rec.detail["platform"] = _platform()
         records.append(rec)
@@ -241,25 +250,40 @@ _MARKER_BEGIN = "<!-- bench:begin -->"
 _MARKER_END = "<!-- bench:end -->"
 
 
+def _parse_bench_rows(text: str) -> dict[tuple[str, str, str], str]:
+    """Existing bench-block rows keyed by (config, backend, preset)."""
+    rows: dict[tuple[str, str, str], str] = {}
+    if _MARKER_BEGIN not in text or _MARKER_END not in text:
+        return rows
+    block = text.split(_MARKER_BEGIN, 1)[1].split(_MARKER_END, 1)[0]
+    for line in block.strip().splitlines():
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) >= 3 and cells[0] not in ("config", "---"):
+            rows[(cells[0], cells[1], cells[2])] = line.rstrip()
+    return rows
+
+
 def update_baseline_md(records: list[BenchRecord], path: str) -> None:
     """Rewrite the measured-numbers block (between the bench markers) of
-    BASELINE.md with the given records, newest run wins per
-    (config, backend, preset)."""
+    BASELINE.md, merging with existing rows: newest run wins per
+    (config, backend, preset), other rows are preserved."""
     from pathlib import Path
 
     p = Path(path)
     text = p.read_text() if p.exists() else "# BASELINE\n"
-    lines = [
-        "| config | backend | preset | wall s | edges relaxed | edges/s/chip | detail |",
-        "|---|---|---|---|---|---|---|",
-    ]
+    rows = _parse_bench_rows(text)
     for r in records:
         per_chip = r.edges_relaxed_per_sec / max(r.n_chips, 1)
-        lines.append(
+        rows[(r.config, r.backend, r.preset)] = (
             f"| {r.config} | {r.backend} | {r.preset} | {r.wall_s:.3f} "
             f"| {r.edges_relaxed:,} | {per_chip:,.0f} "
             f"| {json.dumps(r.detail, sort_keys=True)} |"
         )
+    lines = [
+        "| config | backend | preset | wall s | edges relaxed | edges/s/chip | detail |",
+        "|---|---|---|---|---|---|---|",
+        *(rows[k] for k in sorted(rows)),
+    ]
     block = f"{_MARKER_BEGIN}\n" + "\n".join(lines) + f"\n{_MARKER_END}"
     if _MARKER_BEGIN in text and _MARKER_END in text:
         head, rest = text.split(_MARKER_BEGIN, 1)

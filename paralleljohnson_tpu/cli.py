@@ -31,6 +31,8 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    choices=["auto", "true", "false"],
                    help="Pallas dense kernels: auto (TPU only) / force / off")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--predecessors", action="store_true",
+                   help="also compute shortest-path trees (saved to --output)")
     p.add_argument("--validate", action="store_true",
                    help="cross-check against the scipy oracle (slow)")
     p.add_argument("--output", default=None, help="write result .npz here")
@@ -69,8 +71,11 @@ def _report(res, args) -> None:
         **res.stats.as_dict(),
     }
     if args.output:
-        np.savez_compressed(args.output, dist=res.dist, sources=res.sources,
-                            potentials=res.potentials)
+        arrays = dict(dist=res.dist, sources=res.sources,
+                      potentials=res.potentials)
+        if res.predecessors is not None:
+            arrays["predecessors"] = res.predecessors
+        np.savez_compressed(args.output, **arrays)
         payload["output"] = args.output
     if args.as_json:
         print(json.dumps(payload))
@@ -173,15 +178,21 @@ def main(argv: list[str] | None = None) -> int:
                 sources = np.arange(args.num_sources)
             with device_trace(args.profile):
                 res = ParallelJohnsonSolver(_config(args)).solve(
-                    g, sources=sources
+                    g, sources=sources, predecessors=args.predecessors
                 )
             _report(res, args)
         elif args.command == "sssp":
             g = load_graph(args.graph)
             with device_trace(args.profile):
-                res = ParallelJohnsonSolver(_config(args)).sssp(g, args.source)
+                res = ParallelJohnsonSolver(_config(args)).sssp(
+                    g, args.source, predecessors=args.predecessors
+                )
             _report(res, args)
         elif args.command == "batch":
+            if args.predecessors:
+                print("error: batch mode does not support --predecessors",
+                      file=sys.stderr)
+                return 1
             graphs = random_graph_batch(args.count, args.nodes, args.p,
                                         seed=args.seed)
             with device_trace(args.profile):

@@ -143,9 +143,11 @@ def grid2d(
     stand-in (DIMACS-NY: 264k nodes / 733k arcs / diameter ~700; a 515x515
     grid matches the node count and stresses the same sweep-count regime).
 
-    ``negative_fraction`` negates weights only on lexicographically forward
-    edges (u < v), which cannot close a cycle by themselves, keeping the
-    graph free of negative cycles for any fraction.
+    ``negative_fraction`` of the *forward* edges (right/down, u < v) get a
+    negative weight drawn from (−0.99·w_min, 0). Any lattice cycle takes
+    equally many forward and backward steps, and every backward edge costs
+    at least w_min, so a cycle's weight is ≥ k·(w_min − 0.99·w_min) > 0 —
+    strictly no negative cycles for any fraction and any weight_range.
     """
     rng = np.random.default_rng(seed)
     n = rows * cols
@@ -159,5 +161,6 @@ def grid2d(
     if negative_fraction > 0:
         forward = src < dst
         neg = (rng.random(src.shape[0]) < negative_fraction) & forward
-        w = np.where(neg, -0.1 * w, w).astype(dtype)
+        neg_w = -0.99 * weight_range[0] * rng.random(src.shape[0])
+        w = np.where(neg, neg_w, w).astype(dtype)
     return CSRGraph.from_edges(src, dst, w, n, dtype=dtype)

@@ -17,6 +17,14 @@ Design notes (TPU-first):
     the O(V^2) formulation beats gather/scatter, and min-plus matrix
     squaring gives log2(diameter) convergence for batched small-graph APSP.
 
+Measured dead end (2026-07-29, don't re-try): alternating the chunk scan
+direction per sweep (forward/backward Gauss-Seidel) does NOT cut sweep
+counts on road-like grids — within-chunk relaxation is Jacobi, so multi-hop
+propagation only happens at chunk boundaries; on a 96x96 grid finer chunks
++ alternation gave 220 -> 204 sweeps at best and often regressed. Sweep
+count ~ graph diameter is inherent to this formulation; the dense-squaring
+path (log2 V) is the escape hatch where V allows.
+
 All functions are shape-polymorphic pure functions, safe under jit/vmap/
 shard_map; the wrappers in ``jax_backend`` own jit caching.
 """
@@ -107,6 +115,85 @@ def bellman_ford_sweeps(
         cond, body, (dist0, jnp.int32(0), improving0)
     )
     return dist, iters, improving
+
+
+# Plain int, NOT jnp.int32(-1): a module-level jnp scalar would build a
+# device array at import time and initialize the backend before the caller
+# can pick a platform (and eagerly grabs the TPU on import).
+NO_PRED = -1
+
+
+def relax_sweep_pred(dist, pred, src, dst, w, *, edge_chunk: int = 1 << 20):
+    """Like :func:`relax_sweep` but also maintains predecessors.
+
+    pred[b, v] is the source vertex of the edge that last improved
+    dist[b, v] (−1 for "no predecessor": the source itself and unreached
+    vertices). Ties (several edges achieving the chunk minimum) break to
+    the smallest source id, so results are deterministic.
+
+    Costs one extra gather + segment_min per chunk over the plain sweep —
+    which is why predecessor tracking is opt-in.
+    """
+    squeeze = dist.ndim == 1
+    if squeeze:
+        dist, pred = dist[None, :], pred[None, :]
+    b, v = dist.shape
+    csrc, cdst, cw = _chunk_edges(src, dst, w, min(edge_chunk, src.shape[0] or 1))
+    row_offset = jnp.arange(b, dtype=jnp.int32)[:, None] * v  # [B,1]
+    imax = jnp.int32(jnp.iinfo(jnp.int32).max)
+
+    def body(carry, chunk):
+        d, p = carry
+        s, t, wt = chunk
+        cand = d[:, s] + wt[None, :]              # [B, Ec]
+        seg = (row_offset + t[None, :]).ravel()
+        upd = jax.ops.segment_min(
+            cand.ravel(), seg, num_segments=b * v, indices_are_sorted=False
+        ).reshape(b, v)
+        improved = upd < d
+        # Second pass: among edges matching the winning value, pick the
+        # smallest source id (deterministic tie-break).
+        win = cand == upd[:, t]                   # [B, Ec] winners mask
+        cand_src = jnp.where(win, s[None, :], imax)
+        winner = jax.ops.segment_min(
+            cand_src.ravel(), seg, num_segments=b * v, indices_are_sorted=False
+        ).reshape(b, v)
+        p = jnp.where(improved, winner, p)
+        return (jnp.minimum(d, upd), p), None
+
+    (dist, pred), _ = lax.scan(body, (dist, pred), (csrc, cdst, cw))
+    if squeeze:
+        return dist[0], pred[0]
+    return dist, pred
+
+
+def bellman_ford_sweeps_pred(
+    dist0, src, dst, w, *, max_iter: int, edge_chunk: int = 1 << 20
+):
+    """Predecessor-tracking variant of :func:`bellman_ford_sweeps`.
+
+    Returns (dist, pred, iterations, still_improving); pred is −1 at
+    sources/unreached vertices.
+    """
+    # Derive pred0 from dist0 rather than a constant fill: under shard_map
+    # the while_loop carry must have the same varying-manual-axes type as
+    # the body output (same reason as improving0 below).
+    pred0 = (jnp.isfinite(dist0)).astype(jnp.int32) * 0 + NO_PRED
+
+    def cond(state):
+        _, _, i, improving = state
+        return improving & (i < max_iter)
+
+    def body(state):
+        d, p, i, _ = state
+        nd, np_ = relax_sweep_pred(d, p, src, dst, w, edge_chunk=edge_chunk)
+        return nd, np_, i + 1, jnp.any(nd < d)
+
+    improving0 = jnp.any(jnp.isfinite(dist0))
+    dist, pred, iters, improving = lax.while_loop(
+        cond, body, (dist0, pred0, jnp.int32(0), improving0)
+    )
+    return dist, pred, iters, improving
 
 
 def multi_source_init(sources, num_nodes: int, dtype=jnp.float32):

@@ -55,7 +55,8 @@ def make_mesh(mesh_shape: tuple[int, ...] | None = None) -> Mesh:
 
 @functools.lru_cache(maxsize=32)
 def _sharded_fanout_fn(mesh: Mesh, num_nodes: int, max_iter: int,
-                       edge_chunk: int, replicate: bool):
+                       edge_chunk: int, replicate: bool,
+                       with_pred: bool = False):
     """Build + cache the jitted sharded fan-out for one (mesh, graph-shape)
     combo. Cached on function identity so jit's own trace cache works.
 
@@ -71,20 +72,32 @@ def _sharded_fanout_fn(mesh: Mesh, num_nodes: int, max_iter: int,
 
     def shard_body(srcs, s, t, wt):
         d0 = relax.multi_source_init(srcs, num_nodes, dtype=wt.dtype)
-        d, iters, improving = relax.bellman_ford_sweeps(
-            d0, s, t, wt, max_iter=max_iter, edge_chunk=edge_chunk
-        )
+        if with_pred:
+            d, pred, iters, improving = relax.bellman_ford_sweeps_pred(
+                d0, s, t, wt, max_iter=max_iter, edge_chunk=edge_chunk
+            )
+        else:
+            d, iters, improving = relax.bellman_ford_sweeps(
+                d0, s, t, wt, max_iter=max_iter, edge_chunk=edge_chunk
+            )
         if replicate:
             d = jax.lax.all_gather(d, "sources", axis=0, tiled=True)
         iters = jax.lax.pmax(iters, "sources")
         improving = jax.lax.pmax(improving.astype(jnp.int32), "sources")
+        if with_pred:
+            return d, iters, improving, pred
         return d, iters, improving
 
+    dist_spec = P(None) if replicate else P("sources")
+    out_specs = (
+        (dist_spec, P(), P(), P("sources")) if with_pred
+        else (dist_spec, P(), P())
+    )
     mapped = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P("sources"), P(None), P(None), P(None)),
-        out_specs=(P(None) if replicate else P("sources"), P(), P()),
+        out_specs=out_specs,
         check_vma=not replicate,
     )
     return jax.jit(mapped)
@@ -101,6 +114,7 @@ def sharded_fanout(
     max_iter: int,
     edge_chunk: int = 1 << 20,
     replicate: bool = False,
+    with_pred: bool = False,
 ):
     """N-source fan-out with sources sharded over ``mesh``.
 
@@ -108,7 +122,8 @@ def sharded_fanout(
     duplicate ``sources[0]`` and are dropped), runs the per-shard sweep, and
     gathers rows (explicit ICI all_gather when ``replicate=True``, output-
     sharding assembly otherwise). Returns (dist[B, V], iterations,
-    still_improving).
+    still_improving), plus pred[B, V] appended when ``with_pred=True``
+    (predecessor rows stay sharded on "sources" like the distance rows).
     """
     n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     sources = jnp.asarray(sources, jnp.int32)
@@ -121,6 +136,9 @@ def sharded_fanout(
         # turning a converged fan-out into a spurious ConvergenceError.
         sources = jnp.concatenate([sources, jnp.full(pad, sources[0], jnp.int32)])
     fn = _sharded_fanout_fn(mesh, num_nodes, max_iter, int(edge_chunk),
-                            bool(replicate))
+                            bool(replicate), bool(with_pred))
+    if with_pred:
+        d, iters, improving, pred = fn(sources, src, dst, w)
+        return d[:b], iters, improving.astype(bool), pred[:b]
     d, iters, improving = fn(sources, src, dst, w)
     return d[:b], iters, improving.astype(bool)

@@ -52,18 +52,35 @@ class SolveResult:
     sources: the source vertex of each row.
     potentials: Johnson potentials h(v) (zeros when no reweighting ran).
     stats: per-phase wall-clock, iteration counts, edges-relaxed totals.
+    predecessors: [N_sources, V] shortest-path-tree rows (−1 = source /
+      unreachable) when the solve ran with ``predecessors=True``, else None.
+      Valid for the ORIGINAL weights: Johnson reweighting preserves
+      shortest paths, so the tree computed on w' is the tree on w.
     """
 
     dist: np.ndarray
     sources: np.ndarray
     potentials: np.ndarray
     stats: SolverStats
+    predecessors: np.ndarray | None = None
 
     @property
     def matrix(self) -> np.ndarray:
         """Distance matrix ordered by source vertex id (full APSP only)."""
         order = np.argsort(self.sources)
         return self.dist[order]
+
+    def path(self, source: int, target: int) -> list[int]:
+        """Vertex sequence of a shortest ``source -> target`` path (empty if
+        unreachable). Requires a ``predecessors=True`` solve."""
+        if self.predecessors is None:
+            raise ValueError("solve was run without predecessors=True")
+        from paralleljohnson_tpu.utils.paths import reconstruct_path
+
+        rows = np.flatnonzero(self.sources == source)
+        if rows.size == 0:
+            raise ValueError(f"vertex {source} was not a solve source")
+        return reconstruct_path(self.predecessors[rows[0]], source, target)
 
 
 class ParallelJohnsonSolver:
@@ -83,8 +100,15 @@ class ParallelJohnsonSolver:
         self,
         graph: CSRGraph,
         sources: np.ndarray | None = None,
+        *,
+        predecessors: bool = False,
     ) -> SolveResult:
-        """Full Johnson APSP (or the given source subset)."""
+        """Full Johnson APSP (or the given source subset).
+
+        ``predecessors=True`` also returns shortest-path trees (see
+        :attr:`SolveResult.predecessors`) at the cost of an extra scatter
+        pass per sweep; requires backend support.
+        """
         stats = SolverStats()
         v = graph.num_nodes
         sources = (
@@ -119,7 +143,9 @@ class ParallelJohnsonSolver:
 
         # Phase 2 — batched fan-out over sources.
         with phase_timer(stats, "fanout"):
-            dist = self._fanout(dgraph, sources, stats)
+            dist, pred = self._fanout(
+                dgraph, sources, stats, with_pred=predecessors
+            )
 
         # Phase 3 — un-reweight: d(u,v) = d'(u,v) - h(u) + h(v).
         with phase_timer(stats, "unreweight"):
@@ -129,19 +155,25 @@ class ParallelJohnsonSolver:
                 # guarantees that, but mask anyway against inf-inf NaNs
                 # if h itself has +inf (unreachable-from-virtual never
                 # happens: virtual source reaches everything).
-        result = SolveResult(dist=dist, sources=sources, potentials=h, stats=stats)
+        result = SolveResult(dist=dist, sources=sources, potentials=h,
+                             stats=stats, predecessors=pred)
         if self.config.validate:
             self._validate(graph, result)
         return result
 
-    def sssp(self, graph: CSRGraph, source: int) -> SolveResult:
+    def sssp(
+        self, graph: CSRGraph, source: int, *, predecessors: bool = False
+    ) -> SolveResult:
         """Standalone Bellman-Ford SSSP (config BASELINE.json:8) — negative
         weights allowed, no reweighting."""
         stats = SolverStats()
         with phase_timer(stats, "upload"):
             dgraph = self.backend.upload(graph)
         with phase_timer(stats, "bellman_ford"):
-            bf = self.backend.bellman_ford(dgraph, source=int(source))
+            if predecessors:
+                bf = self.backend.bellman_ford_pred(dgraph, source=int(source))
+            else:
+                bf = self.backend.bellman_ford(dgraph, source=int(source))
         stats.accumulate(bf, phase="bellman_ford")
         if bf.negative_cycle:
             raise NegativeCycleError("negative-weight cycle reachable from source")
@@ -154,9 +186,16 @@ class ParallelJohnsonSolver:
             sources=np.array([source]),
             potentials=np.zeros(graph.num_nodes, graph.dtype),
             stats=stats,
+            predecessors=None if bf.pred is None else np.asarray(bf.pred)[None, :],
         )
 
-    def multi_source(self, graph: CSRGraph, sources: np.ndarray) -> SolveResult:
+    def multi_source(
+        self,
+        graph: CSRGraph,
+        sources: np.ndarray,
+        *,
+        predecessors: bool = False,
+    ) -> SolveResult:
         """Standalone batched N-source fan-out on a non-negative graph
         (config BASELINE.json:9)."""
         if graph.has_negative_weights:
@@ -168,12 +207,15 @@ class ParallelJohnsonSolver:
         with phase_timer(stats, "upload"):
             dgraph = self.backend.upload(graph)
         with phase_timer(stats, "fanout"):
-            dist = self._fanout(dgraph, sources, stats)
+            dist, pred = self._fanout(
+                dgraph, sources, stats, with_pred=predecessors
+            )
         return SolveResult(
             dist=dist,
             sources=sources,
             potentials=np.zeros(graph.num_nodes, graph.dtype),
             stats=stats,
+            predecessors=pred,
         )
 
     def solve_batch(self, graphs: list[CSRGraph]) -> list[SolveResult]:
@@ -210,12 +252,17 @@ class ParallelJohnsonSolver:
         return [sources[i : i + bs] for i in range(0, len(sources), bs)]
 
     def _fanout(
-        self, dgraph: Any, sources: np.ndarray, stats: SolverStats
-    ) -> np.ndarray:
+        self,
+        dgraph: Any,
+        sources: np.ndarray,
+        stats: SolverStats,
+        *,
+        with_pred: bool = False,
+    ) -> tuple[np.ndarray, np.ndarray | None]:
         """Run phase 2 in source batches; optionally checkpoint each batch
         (SURVEY.md §5 — the batch is the unit of recovery). Checkpoints are
         keyed by graph content so a different/modified graph never resumes
-        stale rows."""
+        stale rows. Returns (dist rows, predecessor rows or None)."""
         from paralleljohnson_tpu.utils.checkpoint import BatchCheckpointer
 
         ckpt = None
@@ -225,24 +272,38 @@ class ParallelJohnsonSolver:
                 self.config.checkpoint_dir, graph_key=graph
             )
         rows: list[np.ndarray] = []
+        preds: list[np.ndarray] = []
         for batch_idx, batch in enumerate(self._source_batches(sources)):
             if ckpt is not None:
-                cached = ckpt.load(batch_idx, batch)
+                cached = ckpt.load(batch_idx, batch, with_pred=with_pred)
                 if cached is not None:
-                    rows.append(cached)
+                    row, pred = cached
+                    rows.append(row)
+                    if with_pred:
+                        preds.append(pred)
                     stats.batches_resumed += 1
                     continue
-            res = self.backend.multi_source(dgraph, batch)
+            if with_pred:
+                res = self.backend.multi_source_pred(dgraph, batch)
+            else:
+                res = self.backend.multi_source(dgraph, batch)
             stats.accumulate(res, phase="fanout")
             if not res.converged:
                 raise ConvergenceError(
                     "fan-out hit max_iterations while still improving"
                 )
             row = np.asarray(res.dist)
+            pred = None if res.pred is None else np.asarray(res.pred)
             if ckpt is not None:
-                ckpt.save(batch_idx, batch, row)
+                ckpt.save(batch_idx, batch, row, pred=pred)
             rows.append(row)
-        return rows[0] if len(rows) == 1 else np.concatenate(rows, axis=0)
+            if with_pred:
+                preds.append(pred)
+        dist = rows[0] if len(rows) == 1 else np.concatenate(rows, axis=0)
+        if not with_pred:
+            return dist, None
+        pred = preds[0] if len(preds) == 1 else np.concatenate(preds, axis=0)
+        return dist, pred
 
     def _validate(self, graph: CSRGraph, result: SolveResult) -> None:
         """config.validate: cross-check against the scipy Johnson oracle."""

@@ -44,27 +44,42 @@ class BatchCheckpointer:
     def _path(self, batch_idx: int, sources: np.ndarray) -> Path:
         return self.dir / f"rows_{batch_idx:06d}_{_sources_digest(sources)}.npz"
 
-    def save(self, batch_idx: int, sources: np.ndarray, rows: np.ndarray) -> Path:
+    @staticmethod
+    def _sha(arr: np.ndarray) -> np.ndarray:
+        return np.frombuffer(
+            hashlib.sha256(np.ascontiguousarray(arr).tobytes()).digest(),
+            np.uint8,
+        )
+
+    def save(
+        self,
+        batch_idx: int,
+        sources: np.ndarray,
+        rows: np.ndarray,
+        *,
+        pred: np.ndarray | None = None,
+    ) -> Path:
         path = self._path(batch_idx, sources)
         tmp = path.with_suffix(".tmp.npz")
-        np.savez_compressed(
-            tmp,
+        payload = dict(
             sources=np.asarray(sources, np.int64),
             rows=rows,
-            rows_sha=np.frombuffer(
-                hashlib.sha256(
-                    np.ascontiguousarray(rows).tobytes()
-                ).digest(),
-                np.uint8,
-            ),
+            rows_sha=self._sha(rows),
         )
+        if pred is not None:
+            payload.update(pred=pred, pred_sha=self._sha(pred))
+        np.savez_compressed(tmp, **payload)
         tmp.rename(path)  # atomic publish: partial writes never count as done
         return path
 
-    def load(self, batch_idx: int, sources: np.ndarray) -> np.ndarray | None:
-        """Rows for this batch, or None if absent/corrupt/tampered
-        (recompute — fault detection per SURVEY.md §5: a bit-flipped batch
-        result must be caught, not propagated into the APSP matrix)."""
+    def load(
+        self, batch_idx: int, sources: np.ndarray, *, with_pred: bool = False
+    ) -> tuple[np.ndarray, np.ndarray | None] | None:
+        """(rows, pred-or-None) for this batch, or None if absent/corrupt/
+        tampered (recompute — fault detection per SURVEY.md §5: a
+        bit-flipped batch result must be caught, not propagated into the
+        APSP matrix). ``with_pred=True`` additionally requires a valid
+        predecessor array — a rows-only checkpoint is treated as missing."""
         path = self._path(batch_idx, sources)
         if not path.exists():
             return None
@@ -73,12 +88,18 @@ class BatchCheckpointer:
                 if not np.array_equal(data["sources"], np.asarray(sources, np.int64)):
                     return None
                 rows = data["rows"]
-                if "rows_sha" not in data.files:
-                    return rows  # pre-checksum format: sources matched
-                want = data["rows_sha"].tobytes()
-                got = hashlib.sha256(np.ascontiguousarray(rows).tobytes()).digest()
-                if got == want:
-                    return rows
+                if "rows_sha" in data.files and not np.array_equal(
+                    self._sha(rows), data["rows_sha"]
+                ):
+                    return None
+                if not with_pred:
+                    return rows, None
+                if "pred" not in data.files:
+                    return None
+                pred = data["pred"]
+                if not np.array_equal(self._sha(pred), data["pred_sha"]):
+                    return None
+                return rows, pred
         except Exception:
             pass
         return None

@@ -1,0 +1,51 @@
+"""Shortest-path reconstruction from predecessor arrays.
+
+Predecessor convention across the framework: ``pred[b, v]`` is the vertex
+preceding ``v`` on a shortest path from ``sources[b]``; ``-1`` means "no
+predecessor" (the source itself, or ``v`` unreachable).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NO_PRED = -1
+
+
+def reconstruct_path(pred_row: np.ndarray, source: int, target: int) -> list[int]:
+    """Walk ``pred_row`` back from ``target`` to ``source``.
+
+    Returns the vertex sequence ``[source, ..., target]``; an empty list if
+    ``target`` is unreachable. Raises ValueError on a malformed array (walk
+    longer than |V| — a cycle, which a correct shortest-path tree cannot
+    contain).
+    """
+    if target == source:
+        return [source]
+    if pred_row[target] == NO_PRED:
+        return []
+    path = [int(target)]
+    v = int(target)
+    for _ in range(len(pred_row)):
+        v = int(pred_row[v])
+        path.append(v)
+        if v == source:
+            return path[::-1]
+        if pred_row[v] == NO_PRED:
+            break
+    raise ValueError(
+        f"predecessor array does not trace back from {target} to {source}"
+    )
+
+
+def path_weight(graph, path: list[int]) -> float:
+    """Total weight of ``path`` in ``graph`` (CSRGraph); +inf if any hop is
+    not an edge. Parallel edges contribute their minimum weight."""
+    total = 0.0
+    for u, v in zip(path, path[1:]):
+        row = slice(graph.indptr[u], graph.indptr[u + 1])
+        hits = graph.indices[row] == v
+        if not hits.any():
+            return float("inf")
+        total += float(graph.weights[row][hits].min())
+    return total

@@ -46,3 +46,31 @@ def test_cli_bench_subcommand(capsys, tmp_path):
     out = capsys.readouterr().out.strip().splitlines()
     assert json.loads(out[-1])["config"] == "er1k_apsp"
     assert "er1k_apsp" in md.read_text()
+
+
+def test_unknown_config_rejected():
+    with pytest.raises(ValueError, match="unknown config"):
+        benchmarks.run(["er1k_aspp"])
+
+
+def test_update_baseline_merges(tmp_path):
+    """Rows from earlier runs survive; the matching key is replaced."""
+    (r1,) = benchmarks.run(["er1k_apsp"], backend="numpy", preset="smoke")
+    (r2,) = benchmarks.run(["dimacs_ny_bf"], backend="numpy", preset="smoke")
+    md = tmp_path / "B.md"
+    benchmarks.update_baseline_md([r1], str(md))
+    benchmarks.update_baseline_md([r2], str(md))
+    text = md.read_text()
+    assert "er1k_apsp" in text and "dimacs_ny_bf" in text
+    benchmarks.update_baseline_md([r1], str(md))  # replace, not duplicate
+    assert md.read_text().count("er1k_apsp") == 1
+
+
+def test_batch_small_counts_whole_batch_on_fallback():
+    """Backends without batch_apsp (per-graph fallback) must still report
+    edges for the whole batch, not just the first graph."""
+    rec = benchmarks.bench_batch_small("numpy", "smoke")
+    assert rec.detail["graphs"] == 32
+    # 32 graphs of 64 nodes: far more than a single graph could relax
+    single_upper = 64 * 64 * 64 * 3  # V sweeps x E-ish x slack
+    assert rec.edges_relaxed > single_upper

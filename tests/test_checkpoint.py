@@ -98,5 +98,6 @@ def test_legacy_checkpoint_without_checksum_resumes(tmp_path):
     rows = np.arange(12, dtype=np.float32).reshape(3, 4)
     path = ck._path(0, sources)
     np.savez_compressed(path, sources=sources.astype(np.int64), rows=rows)
-    loaded = ck.load(0, sources)
+    loaded, pred = ck.load(0, sources)
     np.testing.assert_array_equal(loaded, rows)
+    assert pred is None

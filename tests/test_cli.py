@@ -91,3 +91,25 @@ def test_cli_use_pallas_flag(capsys):
     assert rc == 0
     out, _ = capsys.readouterr()
     assert json.loads(out.strip().splitlines()[-1])["finite_fraction"] > 0
+
+
+def test_cli_predecessors_output(tmp_path, capsys):
+    import numpy as np
+
+    from paralleljohnson_tpu.cli import main
+
+    out = tmp_path / "res.npz"
+    rc = main(["solve", "er:n=24,p=0.2,seed=2", "--backend", "jax",
+               "--predecessors", "--output", str(out), "--json"])
+    assert rc == 0
+    with np.load(out) as data:
+        assert data["predecessors"].shape == data["dist"].shape
+
+
+def test_cli_batch_predecessors_rejected(capsys):
+    from paralleljohnson_tpu.cli import main
+
+    rc = main(["batch", "4", "16", "0.2", "--backend", "numpy",
+               "--predecessors"])
+    assert rc == 1
+    assert "--predecessors" in capsys.readouterr().err

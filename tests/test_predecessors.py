@@ -1,0 +1,137 @@
+"""Predecessor / path-reconstruction tests (jax + numpy backends; the
+reconstructed path's edge-weight sum must equal the reported distance —
+robust to shortest-path ties, unlike comparing predecessor arrays)."""
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import (
+    ParallelJohnsonSolver,
+    SolverConfig,
+    path_weight,
+    reconstruct_path,
+)
+from paralleljohnson_tpu.graphs import erdos_renyi, random_dag
+
+
+def _check_paths(graph, res, n_targets=25, rng=None):
+    rng = rng or np.random.default_rng(0)
+    v = graph.num_nodes
+    for i, s in enumerate(res.sources):
+        for t in rng.choice(v, size=min(n_targets, v), replace=False):
+            t = int(t)
+            d = res.dist[i, t]
+            p = reconstruct_path(res.predecessors[i], int(s), t)
+            if np.isinf(d):
+                assert p == [] or t == s
+                continue
+            assert p[0] == s and p[-1] == t
+            assert path_weight(graph, p) == pytest.approx(float(d), rel=1e-4, abs=1e-4)
+
+
+@pytest.mark.parametrize("backend", ["jax", "numpy"])
+def test_multi_source_predecessors(backend):
+    g = erdos_renyi(60, 0.08, seed=2)
+    cfg = SolverConfig(backend=backend, mesh_shape=(1,))
+    res = ParallelJohnsonSolver(cfg).multi_source(
+        g, np.arange(12), predecessors=True
+    )
+    assert res.predecessors.shape == res.dist.shape
+    _check_paths(g, res)
+
+
+def test_johnson_predecessors_negative_weights():
+    """Reweighting preserves shortest paths, so trees computed on w' must
+    price out correctly under the ORIGINAL w."""
+    g = random_dag(50, 0.1, negative_fraction=0.4, seed=3)
+    cfg = SolverConfig(backend="jax", mesh_shape=(1,))
+    res = ParallelJohnsonSolver(cfg).solve(g, predecessors=True)
+    _check_paths(g, res)
+
+
+def test_sharded_predecessors_match_local():
+    g = erdos_renyi(48, 0.1, seed=5)
+    sources = np.arange(16)
+    local = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", mesh_shape=(1,))
+    ).multi_source(g, sources, predecessors=True)
+    sharded = ParallelJohnsonSolver(
+        SolverConfig(backend="jax")  # all 8 CPU-sim devices
+    ).multi_source(g, sources, predecessors=True)
+    np.testing.assert_allclose(sharded.dist, local.dist, rtol=1e-6)
+    _check_paths(g, sharded)
+
+
+def test_sssp_predecessors():
+    g = random_dag(40, 0.12, negative_fraction=0.3, seed=9)
+    for backend in ("jax", "numpy"):
+        res = ParallelJohnsonSolver(
+            SolverConfig(backend=backend, mesh_shape=(1,))
+        ).sssp(g, 0, predecessors=True)
+        _check_paths(g, res)
+
+
+def test_result_path_api():
+    g = erdos_renyi(30, 0.15, seed=1)
+    res = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", mesh_shape=(1,))
+    ).solve(g, sources=np.array([4]), predecessors=True)
+    finite = np.flatnonzero(np.isfinite(res.dist[0]))
+    t = int(finite[-1])
+    p = res.path(4, t)
+    assert p[0] == 4 and p[-1] == t
+    with pytest.raises(ValueError, match="not a solve source"):
+        res.path(5, t)
+
+
+def test_checkpoint_roundtrip_with_predecessors(tmp_path):
+    g = erdos_renyi(40, 0.1, seed=7)
+    cfg = SolverConfig(backend="jax", mesh_shape=(1,), source_batch_size=10,
+                       checkpoint_dir=str(tmp_path))
+    r1 = ParallelJohnsonSolver(cfg).multi_source(
+        g, np.arange(20), predecessors=True)
+    r2 = ParallelJohnsonSolver(cfg).multi_source(
+        g, np.arange(20), predecessors=True)
+    assert r2.stats.batches_resumed == 2
+    np.testing.assert_array_equal(r1.predecessors, r2.predecessors)
+    # a rows-only (no-pred) checkpoint must NOT satisfy a pred request
+    cfg2 = SolverConfig(backend="jax", mesh_shape=(1,), source_batch_size=10,
+                        checkpoint_dir=str(tmp_path / "plain"))
+    ParallelJohnsonSolver(cfg2).multi_source(g, np.arange(20))
+    r3 = ParallelJohnsonSolver(cfg2).multi_source(
+        g, np.arange(20), predecessors=True)
+    assert r3.stats.batches_resumed == 0
+    np.testing.assert_array_equal(r1.predecessors, r3.predecessors)
+
+
+def test_cpp_backend_predecessors_not_supported():
+    from paralleljohnson_tpu.backends import get_backend
+
+    g = erdos_renyi(20, 0.2, seed=0)
+    backend = get_backend("cpp", SolverConfig(backend="cpp"))
+    dg = backend.upload(g)
+    with pytest.raises(NotImplementedError):
+        backend.multi_source_pred(dg, np.arange(4))
+
+
+def test_virtual_source_pred_rejected_everywhere():
+    from paralleljohnson_tpu.backends import get_backend
+
+    g = erdos_renyi(16, 0.2, seed=0)
+    for name in ("jax", "numpy"):
+        backend = get_backend(name, SolverConfig(backend=name, mesh_shape=(1,))
+                              if name == "jax" else SolverConfig(backend=name))
+        dg = backend.upload(g)
+        with pytest.raises(NotImplementedError):
+            backend.bellman_ford_pred(dg, None)
+
+
+def test_grid2d_no_negative_cycle_any_range():
+    from paralleljohnson_tpu.graphs import grid2d
+
+    for wr in [(1.0, 20.0), (0.5, 100.0)]:
+        g = grid2d(8, 8, weight_range=wr, negative_fraction=0.6, seed=0)
+        res = ParallelJohnsonSolver(
+            SolverConfig(backend="numpy")
+        ).solve(g)  # raises NegativeCycleError if the guarantee is broken
+        assert np.isfinite(res.matrix).all()
