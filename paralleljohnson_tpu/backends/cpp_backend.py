@@ -92,6 +92,14 @@ class CppBackend(Backend):
         )
 
     def multi_source(self, dgraph: CSRGraph, sources: np.ndarray) -> KernelResult:
+        return self._multi_source(dgraph, sources, with_pred=False)
+
+    def multi_source_pred(self, dgraph: CSRGraph, sources: np.ndarray) -> KernelResult:
+        return self._multi_source(dgraph, sources, with_pred=True)
+
+    def _multi_source(
+        self, dgraph: CSRGraph, sources: np.ndarray, *, with_pred: bool
+    ) -> KernelResult:
         g = dgraph
         if g.has_negative_weights:
             raise ValueError("multi_source requires non-negative weights")
@@ -100,6 +108,22 @@ class CppBackend(Backend):
         b = len(srcs)
         dist = np.empty((b, v), self._dtype)
         relaxed = ctypes.c_int64(0)
+        if with_pred:
+            pred = np.empty((b, v), np.int32)
+            fn = getattr(_LIB, f"pj_dijkstra_fanout_pred_{self._suffix}")
+            fn(
+                np.int32(v),
+                _ptr(g.indptr, ctypes.c_int32),
+                _ptr(g.indices, ctypes.c_int32),
+                _ptr(g.weights, self._ctype),
+                np.int32(b),
+                _ptr(srcs, ctypes.c_int32),
+                _ptr(dist, self._ctype),
+                _ptr(pred, ctypes.c_int32),
+                ctypes.byref(relaxed),
+            )
+            return KernelResult(dist=dist, pred=pred,
+                                edges_relaxed=int(relaxed.value))
         fn = getattr(_LIB, f"pj_dijkstra_fanout_{self._suffix}")
         fn(
             np.int32(v),
@@ -112,6 +136,32 @@ class CppBackend(Backend):
             ctypes.byref(relaxed),
         )
         return KernelResult(dist=dist, edges_relaxed=int(relaxed.value))
+
+    def bellman_ford_pred(self, dgraph: CSRGraph, source: int | None) -> KernelResult:
+        """SSSP with the shortest-path tree: the converged Bellman-Ford
+        distances plus a native tight-edge BFS extraction pass."""
+        if source is None:
+            raise NotImplementedError(
+                "virtual-source Bellman-Ford has no predecessor tree"
+            )
+        res = self.bellman_ford(dgraph, source)
+        if res.negative_cycle or not res.converged:
+            return res
+        g = dgraph
+        pred = np.empty(g.num_nodes, np.int32)
+        dist = np.ascontiguousarray(res.dist, self._dtype)
+        fn = getattr(_LIB, f"pj_extract_predecessors_{self._suffix}")
+        fn(
+            np.int32(g.num_nodes),
+            _ptr(g.indptr, ctypes.c_int32),
+            _ptr(g.indices, ctypes.c_int32),
+            _ptr(g.weights, self._ctype),
+            _ptr(dist, self._ctype),
+            np.int32(source),
+            _ptr(pred, ctypes.c_int32),
+        )
+        res.pred = pred
+        return res
 
 
 register_backend("cpp", CppBackend)

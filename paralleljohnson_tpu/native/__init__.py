@@ -88,5 +88,11 @@ def load_library() -> ctypes.CDLL:
         dj = getattr(lib, f"pj_dijkstra_fanout_{suffix}")
         dj.restype = None
         dj.argtypes = [i32, p_i32, p_i32, p_t, i32, p_i32, p_t, p_i64]
+        djp = getattr(lib, f"pj_dijkstra_fanout_pred_{suffix}")
+        djp.restype = None
+        djp.argtypes = [i32, p_i32, p_i32, p_t, i32, p_i32, p_t, p_i32, p_i64]
+        ex = getattr(lib, f"pj_extract_predecessors_{suffix}")
+        ex.restype = None
+        ex.argtypes = [i32, p_i32, p_i32, p_t, p_t, i32, p_i32]
     _lib = lib
     return lib

@@ -68,15 +68,19 @@ bool relax_sweep(int64_t num_edges, const int32_t *src, const int32_t *dst,
 }
 
 // Binary-heap Dijkstra from one source on non-negative CSR weights.
-// Writes the full distance row; returns edges scanned (the edges-relaxed
-// count convention for heap Dijkstra: out-edges of settled vertices).
+// Writes the full distance row (and the predecessor row when `pred` is
+// non-null; -1 = source/unreachable); returns edges scanned (the
+// edges-relaxed count convention for heap Dijkstra: out-edges of settled
+// vertices).
 template <typename T>
 int64_t dijkstra_row(int32_t num_nodes, const int32_t *indptr,
                      const int32_t *indices, const T *w, int32_t source,
-                     T *dist) {
+                     T *dist, int32_t *pred = nullptr) {
   const T inf = std::numeric_limits<T>::infinity();
   for (int32_t v = 0; v < num_nodes; ++v) dist[v] = inf;
   dist[source] = T(0);
+  if (pred)
+    for (int32_t v = 0; v < num_nodes; ++v) pred[v] = -1;
 
   using Item = std::pair<T, int32_t>;  // (distance, vertex), min-heap
   std::priority_queue<Item, std::vector<Item>, std::greater<Item>> heap;
@@ -92,11 +96,46 @@ int64_t dijkstra_row(int32_t num_nodes, const int32_t *indptr,
       const int32_t v = indices[e];
       if (nd < dist[v]) {
         dist[v] = nd;
+        if (pred) pred[v] = u;
         heap.emplace(nd, v);
       }
     }
   }
   return scanned;
+}
+
+// Post-fixpoint predecessor extraction for Bellman-Ford: BFS from the
+// source over "tight" edges (dist[u] + w == dist[v] — exact: dist[v] was
+// stored as that very sum for its winning edge). Every shortest path
+// consists of tight edges, so the BFS reaches every finite-distance vertex,
+// and first-discovery assignment makes the result a proper tree — a
+// parallel per-edge equality scan could instead pick edges of a zero-weight
+// cycle and loop path reconstruction. Runs AFTER the sweeps, so it needs no
+// racy paired atomics on (dist, pred); CSR order makes it deterministic.
+// O(V + E) sequential — noise next to the O(V * E) sweep phase.
+template <typename T>
+void extract_predecessors(int32_t num_nodes, const int32_t *indptr,
+                          const int32_t *indices, const T *w, const T *dist,
+                          int32_t source, int32_t *pred) {
+  for (int32_t v = 0; v < num_nodes; ++v) pred[v] = -1;
+  std::vector<int32_t> queue;
+  std::vector<uint8_t> seen(num_nodes, 0);
+  queue.reserve(num_nodes);
+  queue.push_back(source);
+  seen[source] = 1;
+  for (size_t qi = 0; qi < queue.size(); ++qi) {
+    const int32_t u = queue[qi];
+    const T du = dist[u];
+    for (int32_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+      const int32_t v = indices[e];
+      if (seen[v]) continue;
+      if (du + w[e] == dist[v]) {
+        pred[v] = u;
+        seen[v] = 1;
+        queue.push_back(v);
+      }
+    }
+  }
 }
 
 template <typename T>
@@ -120,12 +159,15 @@ template <typename T>
 void dijkstra_fanout_impl(int32_t num_nodes, const int32_t *indptr,
                           const int32_t *indices, const T *w,
                           int32_t num_sources, const int32_t *sources,
-                          T *dist_out, int64_t *edges_relaxed) {
+                          T *dist_out, int64_t *edges_relaxed,
+                          int32_t *pred_out = nullptr) {
   int64_t total = 0;
 #pragma omp parallel for schedule(dynamic, 1) reduction(+ : total)
   for (int32_t b = 0; b < num_sources; ++b) {
+    const int64_t off = static_cast<int64_t>(b) * num_nodes;
     total += dijkstra_row(num_nodes, indptr, indices, w, sources[b],
-                          dist_out + static_cast<int64_t>(b) * num_nodes);
+                          dist_out + off,
+                          pred_out ? pred_out + off : nullptr);
   }
   *edges_relaxed = total;
 }
@@ -180,6 +222,42 @@ void pj_dijkstra_fanout_f64(int32_t num_nodes, const int32_t *indptr,
                             double *dist_out, int64_t *edges_relaxed) {
   dijkstra_fanout_impl(num_nodes, indptr, indices, w, num_sources, sources,
                        dist_out, edges_relaxed);
+}
+
+// Predecessor-tracking fan-out: pred_out is [num_sources, num_nodes]
+// row-major, -1 = source/unreachable.
+void pj_dijkstra_fanout_pred_f32(int32_t num_nodes, const int32_t *indptr,
+                                 const int32_t *indices, const float *w,
+                                 int32_t num_sources, const int32_t *sources,
+                                 float *dist_out, int32_t *pred_out,
+                                 int64_t *edges_relaxed) {
+  dijkstra_fanout_impl(num_nodes, indptr, indices, w, num_sources, sources,
+                       dist_out, edges_relaxed, pred_out);
+}
+
+void pj_dijkstra_fanout_pred_f64(int32_t num_nodes, const int32_t *indptr,
+                                 const int32_t *indices, const double *w,
+                                 int32_t num_sources, const int32_t *sources,
+                                 double *dist_out, int32_t *pred_out,
+                                 int64_t *edges_relaxed) {
+  dijkstra_fanout_impl(num_nodes, indptr, indices, w, num_sources, sources,
+                       dist_out, edges_relaxed, pred_out);
+}
+
+// Shortest-path-tree extraction after a converged Bellman-Ford: BFS over
+// tight edges of the CSR graph (see extract_predecessors).
+void pj_extract_predecessors_f32(int32_t num_nodes, const int32_t *indptr,
+                                 const int32_t *indices, const float *w,
+                                 const float *dist, int32_t source,
+                                 int32_t *pred) {
+  extract_predecessors(num_nodes, indptr, indices, w, dist, source, pred);
+}
+
+void pj_extract_predecessors_f64(int32_t num_nodes, const int32_t *indptr,
+                                 const int32_t *indices, const double *w,
+                                 const double *dist, int32_t source,
+                                 int32_t *pred) {
+  extract_predecessors(num_nodes, indptr, indices, w, dist, source, pred);
 }
 
 }  // extern "C"

@@ -117,10 +117,10 @@ def bellman_ford_sweeps(
     return dist, iters, improving
 
 
-# Plain int, NOT jnp.int32(-1): a module-level jnp scalar would build a
-# device array at import time and initialize the backend before the caller
-# can pick a platform (and eagerly grabs the TPU on import).
-NO_PRED = -1
+# Shared -1 sentinel (plain int, NOT jnp.int32: a module-level jnp scalar
+# would build a device array at import time and initialize the backend
+# before the caller can pick a platform). utils.paths has no JAX imports.
+from paralleljohnson_tpu.utils.paths import NO_PRED  # noqa: E402
 
 
 def relax_sweep_pred(dist, pred, src, dst, w, *, edge_chunk: int = 1 << 20):

@@ -29,7 +29,7 @@ def _check_paths(graph, res, n_targets=25, rng=None):
             assert path_weight(graph, p) == pytest.approx(float(d), rel=1e-4, abs=1e-4)
 
 
-@pytest.mark.parametrize("backend", ["jax", "numpy"])
+@pytest.mark.parametrize("backend", ["jax", "numpy", "cpp"])
 def test_multi_source_predecessors(backend):
     g = erdos_renyi(60, 0.08, seed=2)
     cfg = SolverConfig(backend=backend, mesh_shape=(1,))
@@ -104,21 +104,46 @@ def test_checkpoint_roundtrip_with_predecessors(tmp_path):
     np.testing.assert_array_equal(r1.predecessors, r3.predecessors)
 
 
-def test_cpp_backend_predecessors_not_supported():
-    from paralleljohnson_tpu.backends import get_backend
+def test_cpp_sssp_predecessors_negative_weights():
+    """Native tight-edge BFS extraction on a negative-weight DAG."""
+    g = random_dag(45, 0.12, negative_fraction=0.4, seed=11)
+    res = ParallelJohnsonSolver(
+        SolverConfig(backend="cpp")
+    ).sssp(g, 0, predecessors=True)
+    _check_paths(g, res)
 
-    g = erdos_renyi(20, 0.2, seed=0)
-    backend = get_backend("cpp", SolverConfig(backend="cpp"))
-    dg = backend.upload(g)
-    with pytest.raises(NotImplementedError):
-        backend.multi_source_pred(dg, np.arange(4))
+
+def test_cpp_johnson_predecessors():
+    g = random_dag(40, 0.1, negative_fraction=0.3, seed=13)
+    res = ParallelJohnsonSolver(
+        SolverConfig(backend="cpp")
+    ).solve(g, sources=np.arange(10), predecessors=True)
+    _check_paths(g, res)
+
+
+def test_zero_weight_cycle_tree_is_acyclic():
+    """A 0-weight 2-cycle must not produce mutually-pointing predecessors
+    (the tight-edge BFS guarantees a tree; a naive equality scan does not)."""
+    from paralleljohnson_tpu.graphs import CSRGraph
+
+    edges = [(0, 1, 1.0), (1, 2, 0.0), (2, 1, 0.0), (1, 3, 2.0)]
+    s, d, w = zip(*edges)
+    g = CSRGraph.from_edges(s, d, w, 4)
+    for backend in ("cpp", "jax", "numpy"):
+        cfg = SolverConfig(backend=backend, mesh_shape=(1,)) \
+            if backend == "jax" else SolverConfig(backend=backend)
+        res = ParallelJohnsonSolver(cfg).sssp(g, 0, predecessors=True)
+        for t in range(4):
+            p = res.path(0, t)  # raises ValueError on a pred cycle
+            if p:
+                assert p[0] == 0 and p[-1] == t
 
 
 def test_virtual_source_pred_rejected_everywhere():
     from paralleljohnson_tpu.backends import get_backend
 
     g = erdos_renyi(16, 0.2, seed=0)
-    for name in ("jax", "numpy"):
+    for name in ("jax", "numpy", "cpp"):
         backend = get_backend(name, SolverConfig(backend=name, mesh_shape=(1,))
                               if name == "jax" else SolverConfig(backend=name))
         dg = backend.upload(g)
