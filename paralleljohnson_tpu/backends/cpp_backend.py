@@ -137,6 +137,36 @@ class CppBackend(Backend):
         )
         return KernelResult(dist=dist, edges_relaxed=int(relaxed.value))
 
+    def batch_apsp(self, batch: dict[str, np.ndarray]) -> KernelResult:
+        """Native many-small-graphs Johnson (BASELINE.json:11): OpenMP
+        parallel over graphs, serial Johnson per graph (the shared-memory
+        thread-pool decomposition — graphs are independent)."""
+        src = np.ascontiguousarray(batch["src"], np.int32)
+        dst = np.ascontiguousarray(batch["dst"], np.int32)
+        w = np.ascontiguousarray(batch["weights"], self._dtype)
+        sizes = np.ascontiguousarray(batch["num_nodes"], np.int32)
+        g, e_pad = src.shape
+        v_max = int(batch["v_max"])
+        dist = np.empty((g, v_max, v_max), self._dtype)
+        neg = np.zeros(g, np.int32)
+        fn = getattr(_LIB, f"pj_batch_johnson_{self._suffix}")
+        relaxed = fn(
+            np.int32(g),
+            np.int64(e_pad),
+            _ptr(sizes, ctypes.c_int32),
+            np.int32(v_max),
+            _ptr(src, ctypes.c_int32),
+            _ptr(dst, ctypes.c_int32),
+            _ptr(w, self._ctype),
+            _ptr(dist, self._ctype),
+            _ptr(neg, ctypes.c_int32),
+        )
+        return KernelResult(
+            dist=dist,
+            negative_cycle=bool(neg.any()),
+            edges_relaxed=int(relaxed),
+        )
+
     def bellman_ford_pred(self, dgraph: CSRGraph, source: int | None) -> KernelResult:
         """SSSP with the shortest-path tree: the converged Bellman-Ford
         distances plus a native tight-edge BFS extraction pass."""

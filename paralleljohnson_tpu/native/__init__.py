@@ -94,5 +94,8 @@ def load_library() -> ctypes.CDLL:
         ex = getattr(lib, f"pj_extract_predecessors_{suffix}")
         ex.restype = None
         ex.argtypes = [i32, p_i32, p_i32, p_t, p_t, i32, p_i32]
+        bj = getattr(lib, f"pj_batch_johnson_{suffix}")
+        bj.restype = i64
+        bj.argtypes = [i32, i64, p_i32, i32, p_i32, p_i32, p_t, p_t, p_i32]
     _lib = lib
     return lib

@@ -172,6 +172,73 @@ void dijkstra_fanout_impl(int32_t num_nodes, const int32_t *indptr,
   *edges_relaxed = total;
 }
 
+// One graph of the many-small-graphs batch (SURVEY.md §3.4): full Johnson —
+// virtual-source Bellman-Ford -> reweight -> per-source heap Dijkstra ->
+// un-reweight. Runs serially; the batch loop parallelizes across graphs
+// (the reference-shaped thread-pool decomposition: graphs are independent).
+// Edges are COO (CSR-ordered by src) with +inf padding; indptr is rebuilt
+// locally. Returns 1 on a negative cycle (dist rows left +inf).
+template <typename T>
+int32_t johnson_one_graph(int32_t v, int64_t e_pad, const int32_t *src,
+                          const int32_t *dst, const T *w, int32_t v_max,
+                          T *dist_rows, int64_t *edges_relaxed) {
+  const T inf = std::numeric_limits<T>::infinity();
+  // Trim +inf padding (stacked graphs pad the edge tail).
+  int64_t e = e_pad;
+  while (e > 0 && !std::isfinite(w[e - 1])) --e;
+
+  // Phase 1: virtual-source Bellman-Ford (dist0 = 0 everywhere).
+  std::vector<T> h(v, T(0));
+  int32_t iters = 0;
+  bool improving = v > 0;
+  while (improving && iters < v) {  // v sweeps max: v-1 suffice cycle-free
+    improving = false;
+    for (int64_t i = 0; i < e; ++i) {
+      const T du = h[src[i]];
+      if (!std::isfinite(du)) continue;
+      const T cand = du + w[i];
+      if (cand < h[dst[i]]) {
+        h[dst[i]] = cand;
+        improving = true;
+      }
+    }
+    ++iters;
+  }
+  *edges_relaxed += static_cast<int64_t>(iters) * e;
+  if (improving) {  // v-th sweep still improved: negative cycle
+    for (int64_t i = 0; i < static_cast<int64_t>(v_max) * v_max; ++i)
+      dist_rows[i] = inf;  // honor the contract: rows are +inf, not garbage
+    return 1;
+  }
+
+  // Reweight + rebuild CSR structure (COO is already src-sorted).
+  std::vector<T> wp(e);
+  std::vector<int32_t> indptr(v + 1, 0);
+  for (int64_t i = 0; i < e; ++i) {
+    T x = w[i] + h[src[i]] - h[dst[i]];
+    wp[i] = x < T(0) ? T(0) : x;  // clamp float residue
+    ++indptr[src[i] + 1];
+  }
+  for (int32_t u = 0; u < v; ++u) indptr[u + 1] += indptr[u];
+
+  // Phase 2+3: per-source Dijkstra on w', un-reweighted in place.
+  for (int32_t s = 0; s < v; ++s) {
+    T *row = dist_rows + static_cast<int64_t>(s) * v_max;
+    *edges_relaxed +=
+        dijkstra_row(v, indptr.data(), dst, wp.data(), s, row);
+    for (int32_t t = 0; t < v; ++t)
+      if (std::isfinite(row[t])) row[t] += h[t] - h[s];
+    for (int32_t t = v; t < v_max; ++t) row[t] = inf;
+  }
+  // Padded source rows: unreachable except the 0 diagonal (mirrors the
+  // vmapped jax batch kernel; callers slice to the true V anyway).
+  for (int32_t s = v; s < v_max; ++s) {
+    T *row = dist_rows + static_cast<int64_t>(s) * v_max;
+    for (int32_t t = 0; t < v_max; ++t) row[t] = (t == s) ? T(0) : inf;
+  }
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -259,5 +326,33 @@ void pj_extract_predecessors_f64(int32_t num_nodes, const int32_t *indptr,
                                  int32_t *pred) {
   extract_predecessors(num_nodes, indptr, indices, w, dist, source, pred);
 }
+
+// Many-small-graphs batch Johnson APSP (BASELINE.json:11), parallel over
+// graphs. Inputs are the stacked COO arrays [num_graphs, e_pad] with +inf
+// edge padding; dist_out is [num_graphs, v_max, v_max]; num_nodes[g] is the
+// true vertex count of graph g; neg_out[g] is set to 1 on a negative cycle.
+// Returns total edges relaxed across the batch.
+#define PJ_BATCH_JOHNSON(SUFFIX, T)                                          \
+  int64_t pj_batch_johnson_##SUFFIX(                                         \
+      int32_t num_graphs, int64_t e_pad, const int32_t *num_nodes,           \
+      int32_t v_max, const int32_t *src, const int32_t *dst, const T *w,     \
+      T *dist_out, int32_t *neg_out) {                                       \
+    int64_t total = 0;                                                       \
+    _Pragma("omp parallel for schedule(dynamic, 1) reduction(+ : total)")    \
+    for (int32_t g = 0; g < num_graphs; ++g) {                               \
+      int64_t relaxed = 0;                                                   \
+      neg_out[g] = johnson_one_graph(                                        \
+          num_nodes[g], e_pad, src + g * e_pad, dst + g * e_pad,             \
+          w + g * e_pad,                                                     \
+          v_max, dist_out + static_cast<int64_t>(g) * v_max * v_max,         \
+          &relaxed);                                                         \
+      total += relaxed;                                                      \
+    }                                                                        \
+    return total;                                                            \
+  }
+
+PJ_BATCH_JOHNSON(f32, float)
+PJ_BATCH_JOHNSON(f64, double)
+#undef PJ_BATCH_JOHNSON
 
 }  // extern "C"

@@ -101,3 +101,65 @@ def test_cpp_equals_jax_backend_on_reweighted_graph():
     d_cpp = cpp.multi_source(cpp.upload(g), sources).dist
     d_jax = np.asarray(jaxb.multi_source(jaxb.upload(g), sources).dist)
     np.testing.assert_allclose(d_cpp, d_jax, rtol=1e-5, atol=1e-5)
+
+
+def test_cpp_batch_apsp_matches_oracle():
+    """Native batch Johnson: mixed-size graphs, negative weights, oracle."""
+    from tests.conftest import oracle_apsp
+
+    from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+    from paralleljohnson_tpu.graphs import erdos_renyi, random_dag
+
+    graphs = [
+        erdos_renyi(24, 0.15, seed=1),
+        random_dag(30, 0.15, negative_fraction=0.4, seed=2),
+        erdos_renyi(12, 0.3, seed=3),
+    ]
+    results = ParallelJohnsonSolver(
+        SolverConfig(backend="cpp")
+    ).solve_batch(graphs)
+    for g, res in zip(graphs, results):
+        oracle = oracle_apsp(g)
+        np.testing.assert_allclose(res.matrix, oracle, rtol=1e-4, atol=1e-4)
+
+
+def test_cpp_batch_apsp_negative_cycle():
+    from paralleljohnson_tpu import (
+        NegativeCycleError,
+        ParallelJohnsonSolver,
+        SolverConfig,
+    )
+    from paralleljohnson_tpu.graphs import CSRGraph, erdos_renyi
+
+    s, d, w = zip(*[(0, 1, 1.0), (1, 2, -3.0), (2, 0, 1.0)])
+    bad = CSRGraph.from_edges(s, d, w, 3)
+    with pytest.raises(NegativeCycleError):
+        ParallelJohnsonSolver(SolverConfig(backend="cpp")).solve_batch(
+            [erdos_renyi(8, 0.3, seed=0), bad]
+        )
+
+
+def test_cpp_batch_matches_jax_batch():
+    from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+    from paralleljohnson_tpu.graphs import random_graph_batch
+
+    graphs = random_graph_batch(6, 20, 0.2, seed=5)
+    cpp = ParallelJohnsonSolver(SolverConfig(backend="cpp")).solve_batch(graphs)
+    jax_r = ParallelJohnsonSolver(SolverConfig(backend="jax")).solve_batch(graphs)
+    for a, b in zip(cpp, jax_r):
+        np.testing.assert_allclose(a.matrix, b.matrix, rtol=1e-4, atol=1e-4)
+
+
+def test_cpp_batch_apsp_negative_cycle_rows_are_inf():
+    """Direct batch_apsp callers must see +inf, not uninitialized memory,
+    for a negative-cycle graph's rows."""
+    from paralleljohnson_tpu import SolverConfig
+    from paralleljohnson_tpu.backends import get_backend
+    from paralleljohnson_tpu.graphs import CSRGraph, erdos_renyi, stack_graphs
+
+    s, d, w = zip(*[(0, 1, 1.0), (1, 2, -3.0), (2, 0, 1.0)])
+    bad = CSRGraph.from_edges(s, d, w, 3)
+    batch = stack_graphs([erdos_renyi(8, 0.3, seed=0), bad])
+    res = get_backend("cpp", SolverConfig(backend="cpp")).batch_apsp(batch)
+    assert res.negative_cycle
+    assert np.isinf(res.dist[1]).all()
