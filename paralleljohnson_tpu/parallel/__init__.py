@@ -1,5 +1,6 @@
-"""Mesh/sharding layer: source parallelism + ICI collectives."""
+"""Mesh/sharding layer: source parallelism + ICI/DCN collectives."""
 
+from paralleljohnson_tpu.parallel import multihost
 from paralleljohnson_tpu.parallel.mesh import make_mesh, sharded_fanout
 
-__all__ = ["make_mesh", "sharded_fanout"]
+__all__ = ["make_mesh", "multihost", "sharded_fanout"]

@@ -1,0 +1,101 @@
+"""Multi-host execution (SURVEY.md §5 "Distributed communication backend").
+
+The single-host design scales to a multi-host TPU pod without code changes
+to the kernels: the same ``shard_map`` fan-out runs over a GLOBAL mesh, XLA
+routes the final row all-gather over ICI within a pod slice and DCN across
+slices, and the replicated CSR in-specs mean the sweeps themselves stay
+collective-free. What multi-host adds is process bootstrap + building the
+global sources array from per-process shards — this module owns both.
+
+Usage on each host (standard JAX SPMD launch):
+
+    from paralleljohnson_tpu.parallel import multihost
+    multihost.initialize()          # jax.distributed, env-driven
+    mesh = multihost.global_mesh()  # 1-D "sources" mesh over ALL devices
+    ...
+
+No NCCL/MPI equivalent is needed: XLA's collectives are the communication
+backend (the reference's OpenMP path has no cross-host story at all —
+SURVEY.md §5 attests shared-memory only).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize ``jax.distributed`` for multi-host runs.
+
+    Arguments default to the standard env vars (``JAX_COORDINATOR_ADDRESS``
+    / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``); on TPU pods JAX can also
+    autodetect all three. No-op (returns False) when neither arguments nor
+    environment indicate a multi-process run, so single-host code can call
+    this unconditionally.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if not coordinator_address and not num_processes:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def global_mesh():
+    """1-D ``("sources",)`` mesh over every device of every process.
+
+    After :func:`initialize`, ``jax.devices()`` is the global device list;
+    the mesh (and the shard_map fan-out built on it) is then a multi-host
+    SPMD program — each process executes the same code on its addressable
+    shard, collectives cross hosts via ICI/DCN.
+    """
+    from paralleljohnson_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(None)
+
+
+def global_sources(mesh, sources: np.ndarray):
+    """Build the global, "sources"-sharded device array from a host copy.
+
+    Every process passes the SAME full ``sources`` array (cheap — it is
+    int32[B]); each process materializes only its addressable shards. This
+    is the multi-host-safe way to feed ``shard_map``: passing a numpy array
+    directly would require process 0 to own all shards.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sources = np.asarray(sources, np.int32)
+    sharding = NamedSharding(mesh, P("sources"))
+    return jax.make_array_from_callback(
+        sources.shape, sharding, lambda idx: sources[idx]
+    )
+
+
+def process_info() -> dict:
+    """Process/topology summary for logs and debugging."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

