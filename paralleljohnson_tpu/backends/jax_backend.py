@@ -23,7 +23,12 @@ from paralleljohnson_tpu.ops import relax
 
 @dataclasses.dataclass(frozen=True)
 class JaxDeviceGraph:
-    """HBM-resident COO/CSR buffers (padded edges are (0, 0, +inf) no-ops)."""
+    """HBM-resident COO/CSR buffers (padded edges are (0, 0, +inf) no-ops).
+
+    The ``*_by_dst`` triple is the same edge list re-sorted by destination,
+    for the vertex-major sweep (sorted segment reduction instead of
+    scatter); built lazily at first use and cached on the instance.
+    """
 
     src: jax.Array      # int32[E_pad]
     dst: jax.Array      # int32[E_pad]
@@ -31,6 +36,20 @@ class JaxDeviceGraph:
     indptr: np.ndarray  # host-side int32[V+1] (row structure, rarely needed)
     num_nodes: int
     num_real_edges: int
+    _by_dst_cache: dict = dataclasses.field(
+        default_factory=dict, compare=False, repr=False
+    )
+
+    def by_dst(self) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """(src, dst, weights) sorted by dst (stable), device-resident."""
+        cached = self._by_dst_cache.get("v")
+        if cached is None:
+            order = jnp.argsort(self.dst, stable=True)
+            cached = (
+                self.src[order], self.dst[order], self.weights[order]
+            )
+            self._by_dst_cache["v"] = cached
+        return cached
 
 
 def _edge_chunk_for(batch: int, num_edges: int, budget_elems: int = 1 << 26) -> int:
@@ -57,6 +76,22 @@ def _fanout_kernel(
     return relax.bellman_ford_sweeps(
         dist0, src, dst, w, max_iter=max_iter, edge_chunk=edge_chunk
     )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_nodes", "max_iter", "edge_chunk")
+)
+def _fanout_vm_kernel(
+    sources, src_bd, dst_bd, w_bd, *, num_nodes: int, max_iter: int,
+    edge_chunk: int,
+):
+    """Vertex-major fan-out: dist [V, B], dst-sorted edges, sorted segment
+    reduction (no scatter). Returns dist already transposed to [B, V]."""
+    dist0 = relax.multi_source_init(sources, num_nodes, dtype=w_bd.dtype).T
+    dist, iters, improving = relax.bellman_ford_sweeps_vm(
+        dist0, src_bd, dst_bd, w_bd, max_iter=max_iter, edge_chunk=edge_chunk
+    )
+    return dist.T, iters, improving
 
 
 _reweight_kernel = jax.jit(relax.reweight_weights)
@@ -329,6 +364,9 @@ class JaxBackend(Backend):
         return dataclasses.replace(
             dgraph,
             weights=_reweight_kernel(dgraph.weights, dgraph.src, dgraph.dst, h),
+            # dataclasses.replace would carry the old cache over — the
+            # dst-sorted weights must be re-derived from the new weights.
+            _by_dst_cache={},
         )
 
     def batch_apsp(self, batch: dict[str, np.ndarray]) -> KernelResult:

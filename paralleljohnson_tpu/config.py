@@ -36,6 +36,11 @@ class SolverConfig:
       edge_pad_multiple: pad E to this multiple for stable jit shapes.
       use_pallas: ``"auto"`` (Pallas dense kernels on TPU, XLA elsewhere),
         ``True`` (force, interpret-mode off-TPU — tests), or ``False``.
+      fanout_layout: sparse fan-out data layout — ``"vertex_major"``
+        (dist [V, B], dst-sorted edges, sorted segment reduction: no
+        scatter on TPU), ``"source_major"`` (dist [B, V], flattened-id
+        scatter-min), or ``"auto"`` (vertex_major on the single-chip
+        sparse path; the sharded and dense paths choose their own).
       checkpoint_dir: if set, per-source-batch distance rows are saved here
         and resumed after preemption (SURVEY.md §5 checkpoint/resume).
       validate: cross-check results against the scipy oracle (slow; tests).
@@ -49,6 +54,7 @@ class SolverConfig:
     dense_threshold: int = 1024
     edge_pad_multiple: int = 512
     use_pallas: bool | str = "auto"
+    fanout_layout: str = "auto"
     checkpoint_dir: str | None = None
     validate: bool = False
 
@@ -62,4 +68,9 @@ class SolverConfig:
         if self.use_pallas not in (True, False, "auto"):
             raise ValueError(
                 f"use_pallas must be True/False/'auto', got {self.use_pallas!r}"
+            )
+        if self.fanout_layout not in ("auto", "source_major", "vertex_major"):
+            raise ValueError(
+                "fanout_layout must be auto/source_major/vertex_major, "
+                f"got {self.fanout_layout!r}"
             )

@@ -123,6 +123,87 @@ def bellman_ford_sweeps(
 from paralleljohnson_tpu.utils.paths import NO_PRED  # noqa: E402
 
 
+# -- vertex-major (dst-sorted) sweep ----------------------------------------
+#
+# The source-major sweep above scatter-mins onto flattened (row, dst) ids —
+# unsorted segments, which XLA lowers to scatter (slow on TPU). Keeping the
+# distance block VERTEX-major (dist[V, B]) and the edges sorted by
+# DESTINATION turns the same relaxation into:
+#   gather rows:   cand[e, :] = dist[src[e], :] + w[e]     (contiguous [B])
+#   sorted reduce: upd = segment_min(cand, dst, indices_are_sorted=True)
+# — a linear-scan segment reduction instead of scatter, and lane-contiguous
+# row gathers. B should be a multiple of the 128-lane width for best tiling.
+
+
+def _chunk_edges_dst_sorted(src, dst, w, chunk: int, num_nodes: int):
+    """Like ``_chunk_edges`` but padding must keep dst non-decreasing:
+    no-op pad edges are (0, V-1, +inf), appended at the tail."""
+    e = src.shape[0]
+    n_chunks = max(1, -(-e // chunk))
+    pad = n_chunks * chunk - e
+    if pad:
+        src = jnp.concatenate([src, jnp.zeros(pad, src.dtype)])
+        dst = jnp.concatenate(
+            [dst, jnp.full(pad, num_nodes - 1, dst.dtype)]
+        )
+        w = jnp.concatenate([w, jnp.full(pad, INF, w.dtype)])
+    return (
+        src.reshape(n_chunks, chunk),
+        dst.reshape(n_chunks, chunk),
+        w.reshape(n_chunks, chunk),
+    )
+
+
+def relax_sweep_vm(dist_vm, src, dst, w, *, edge_chunk: int = 1 << 20):
+    """One relaxation sweep in vertex-major layout.
+
+    dist_vm: [V, B]; ``src``/``dst``/``w`` MUST be sorted by ``dst``
+    (``CSRGraph`` order is by src — the backend re-sorts once at upload).
+    Later chunks see earlier updates (same Gauss-Seidel-at-chunk-level
+    semantics as the source-major sweep).
+    """
+    v = dist_vm.shape[0]
+    csrc, cdst, cw = _chunk_edges_dst_sorted(
+        src, dst, w, min(edge_chunk, src.shape[0] or 1), v
+    )
+
+    def body(d, chunk):
+        s, t, wt = chunk
+        cand = d[s, :] + wt[:, None]              # [Ec, B] row gather
+        upd = jax.ops.segment_min(
+            cand, t, num_segments=v, indices_are_sorted=True
+        )                                          # [V, B] sorted reduce
+        return jnp.minimum(d, upd), None
+
+    dist_vm, _ = lax.scan(body, dist_vm, (csrc, cdst, cw))
+    return dist_vm
+
+
+def bellman_ford_sweeps_vm(
+    dist0_vm, src, dst, w, *, max_iter: int, edge_chunk: int = 1 << 20
+):
+    """Vertex-major fixpoint iteration (edges sorted by dst).
+
+    Same contract as :func:`bellman_ford_sweeps` with dist [V, B]:
+    returns (dist_vm, iterations, still_improving).
+    """
+
+    def cond(state):
+        _, i, improving = state
+        return improving & (i < max_iter)
+
+    def body(state):
+        d, i, _ = state
+        nd = relax_sweep_vm(d, src, dst, w, edge_chunk=edge_chunk)
+        return nd, i + 1, jnp.any(nd < d)
+
+    improving0 = jnp.any(jnp.isfinite(dist0_vm))
+    dist, iters, improving = lax.while_loop(
+        cond, body, (dist0_vm, jnp.int32(0), improving0)
+    )
+    return dist, iters, improving
+
+
 def relax_sweep_pred(dist, pred, src, dst, w, *, edge_chunk: int = 1 << 20):
     """Like :func:`relax_sweep` but also maintains predecessors.
 
