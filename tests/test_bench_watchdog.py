@@ -1,0 +1,84 @@
+"""Watchdog tests for the driver benchmark's TPU child supervision.
+
+The fake children stand in for the known tunnel failure modes observed in
+rounds 1-2: device init that never completes (stage timeout), a crash
+before any result, and — the subtle one — a complete valid RESULT followed
+by a wedged teardown.
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+spec = importlib.util.spec_from_file_location("pj_bench", REPO / "bench.py")
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+
+
+def _fake_child(body: str) -> list[str]:
+    return [sys.executable, "-u", "-c", body]
+
+
+def test_result_kept_despite_teardown_hang():
+    """A parsed RESULT survives a child that wedges after printing it."""
+    measured = bench._tpu_attempt(
+        0, 0, 0, total_timeout=30, stage_timeout=2,
+        _cmd=_fake_child(
+            "import time\n"
+            "print('STAGE probe ok', flush=True)\n"
+            "print('RESULT {\"edges_per_sec\": 5.0, \"dt\": 1.0, "
+            "\"t_ref\": 2.0, \"oracle_ok\": true}', flush=True)\n"
+            "time.sleep(600)\n"  # wedged teardown
+        ),
+    )
+    assert measured is not None and measured["edges_per_sec"] == 5.0
+
+
+def test_stage_timeout_kills_silent_child():
+    measured = bench._tpu_attempt(
+        0, 0, 0, total_timeout=60, stage_timeout=2,
+        _cmd=_fake_child("import time; time.sleep(600)"),
+    )
+    assert measured is None
+
+
+def test_heartbeats_extend_stage_deadline():
+    """Three 1s stages under a 3s stage timeout but > stage-timeout total
+    runtime: heartbeats must keep the watchdog from firing."""
+    measured = bench._tpu_attempt(
+        0, 0, 0, total_timeout=60, stage_timeout=3,
+        _cmd=_fake_child(
+            "import time\n"
+            "for i in range(4):\n"
+            "    print(f'STAGE step {i}', flush=True)\n"
+            "    time.sleep(1)\n"
+            "print('RESULT {\"edges_per_sec\": 1.0, \"dt\": 1.0, "
+            "\"t_ref\": 1.0, \"oracle_ok\": true}', flush=True)\n"
+        ),
+    )
+    assert measured is not None
+
+
+def test_burst_lines_do_not_starve_watchdog():
+    """Many STAGE lines arriving in one pipe chunk must all be seen (the
+    buffered-readline starvation bug class)."""
+    measured = bench._tpu_attempt(
+        0, 0, 0, total_timeout=30, stage_timeout=4,
+        _cmd=_fake_child(
+            "import time\n"
+            "print('STAGE a\\nSTAGE b\\nSTAGE c', flush=True)\n"
+            "time.sleep(3)\n"  # close to stage timeout after the burst
+            "print('RESULT {\"edges_per_sec\": 2.0, \"dt\": 1.0, "
+            "\"t_ref\": 1.0, \"oracle_ok\": true}', flush=True)\n"
+        ),
+    )
+    assert measured is not None
+
+
+def test_clean_crash_flagged_for_retry():
+    measured = bench._tpu_attempt(
+        0, 0, 0, total_timeout=30, stage_timeout=10,
+        _cmd=_fake_child("raise SystemExit(3)"),
+    )
+    assert measured == {"_clean_failure": True}
