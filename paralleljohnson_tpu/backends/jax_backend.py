@@ -319,11 +319,24 @@ class JaxBackend(Backend):
             self._mesh_cache = cached
         return cached
 
+    def _resolve_layout(self) -> str:
+        """``fanout_layout`` with ``"auto"`` resolved to the measured winner.
+
+        Measured 2026-07-29 (see BASELINE.md "fan-out layout" rows):
+        vertex-major's sorted segment reduction beats the source-major
+        scatter-min ~3x on the CPU mesh (rmat14 B=64: 163 ms vs 542 ms;
+        96x96 grid B=32: 284 ms vs 917 ms) and is the scatter-free
+        formulation TPU Mosaic tiles well — "auto" = vertex_major.
+        """
+        layout = self.config.fanout_layout
+        return "vertex_major" if layout == "auto" else layout
+
     def multi_source(self, dgraph: JaxDeviceGraph, sources: np.ndarray) -> KernelResult:
         v = dgraph.num_nodes
         sources = jnp.asarray(sources, jnp.int32)
         max_iter = self.config.max_iterations or v
         mesh = self._mesh()
+        layout = self._resolve_layout()
         if mesh.devices.size > 1:
             from paralleljohnson_tpu.parallel import sharded_fanout
 
@@ -334,9 +347,14 @@ class JaxBackend(Backend):
                 -(-sources.shape[0] // mesh.devices.size),
                 dgraph.src.shape[0],
             )
+            edges = (
+                dgraph.by_dst() if layout == "vertex_major"
+                else (dgraph.src, dgraph.dst, dgraph.weights)
+            )
             dist, iters, improving = sharded_fanout(
-                mesh, sources, dgraph.src, dgraph.dst, dgraph.weights,
+                mesh, sources, *edges,
                 num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                layout=layout,
             )
         elif v <= self.config.dense_threshold:
             use_pallas, interpret = self._pallas_mode()
@@ -344,6 +362,13 @@ class JaxBackend(Backend):
                 sources, dgraph.src, dgraph.dst, dgraph.weights,
                 num_nodes=v, max_iter=max_iter,
                 use_pallas=use_pallas, interpret=interpret,
+            )
+        elif layout == "vertex_major":
+            chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
+            src_bd, dst_bd, w_bd = dgraph.by_dst()
+            dist, iters, improving = _fanout_vm_kernel(
+                sources, src_bd, dst_bd, w_bd,
+                num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
             )
         else:
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])

@@ -39,8 +39,10 @@ class SolverConfig:
       fanout_layout: sparse fan-out data layout — ``"vertex_major"``
         (dist [V, B], dst-sorted edges, sorted segment reduction: no
         scatter on TPU), ``"source_major"`` (dist [B, V], flattened-id
-        scatter-min), or ``"auto"`` (vertex_major on the single-chip
-        sparse path; the sharded and dense paths choose their own).
+        scatter-min), or ``"auto"`` (vertex_major — the measured winner,
+        ~3x on the CPU mesh; see BASELINE.md "fan-out layout" rows).
+        Applies to the sparse single-chip and sharded paths; the dense
+        min-plus path has no layout choice.
       checkpoint_dir: if set, per-source-batch distance rows are saved here
         and resumed after preemption (SURVEY.md §5 checkpoint/resume).
       validate: cross-check results against the scipy oracle (slow; tests).

@@ -56,7 +56,8 @@ def make_mesh(mesh_shape: tuple[int, ...] | None = None) -> Mesh:
 @functools.lru_cache(maxsize=32)
 def _sharded_fanout_fn(mesh: Mesh, num_nodes: int, max_iter: int,
                        edge_chunk: int, replicate: bool,
-                       with_pred: bool = False):
+                       with_pred: bool = False,
+                       layout: str = "source_major"):
     """Build + cache the jitted sharded fan-out for one (mesh, graph-shape)
     combo. Cached on function identity so jit's own trace cache works.
 
@@ -76,6 +77,14 @@ def _sharded_fanout_fn(mesh: Mesh, num_nodes: int, max_iter: int,
             d, pred, iters, improving = relax.bellman_ford_sweeps_pred(
                 d0, s, t, wt, max_iter=max_iter, edge_chunk=edge_chunk
             )
+        elif layout == "vertex_major":
+            # Caller passes dst-sorted edges for this layout; each shard
+            # sweeps its own [V, B_shard] block, transposed back so the
+            # out_specs stay layout-independent.
+            d, iters, improving = relax.bellman_ford_sweeps_vm(
+                d0.T, s, t, wt, max_iter=max_iter, edge_chunk=edge_chunk
+            )
+            d = d.T
         else:
             d, iters, improving = relax.bellman_ford_sweeps(
                 d0, s, t, wt, max_iter=max_iter, edge_chunk=edge_chunk
@@ -115,6 +124,7 @@ def sharded_fanout(
     edge_chunk: int = 1 << 20,
     replicate: bool = False,
     with_pred: bool = False,
+    layout: str = "source_major",
 ):
     """N-source fan-out with sources sharded over ``mesh``.
 
@@ -124,7 +134,14 @@ def sharded_fanout(
     sharding assembly otherwise). Returns (dist[B, V], iterations,
     still_improving), plus pred[B, V] appended when ``with_pred=True``
     (predecessor rows stay sharded on "sources" like the distance rows).
+
+    ``layout="vertex_major"`` runs the per-shard sweep on a [V, B_shard]
+    block with a sorted segment reduction — the caller MUST then pass
+    dst-sorted ``src``/``dst``/``w`` (``JaxDeviceGraph.by_dst``). Not
+    compatible with ``with_pred`` (predecessor tracking is source-major).
     """
+    if with_pred and layout == "vertex_major":
+        raise ValueError("with_pred requires the source_major layout")
     n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     sources = jnp.asarray(sources, jnp.int32)
     b = sources.shape[0]
@@ -136,7 +153,7 @@ def sharded_fanout(
         # turning a converged fan-out into a spurious ConvergenceError.
         sources = jnp.concatenate([sources, jnp.full(pad, sources[0], jnp.int32)])
     fn = _sharded_fanout_fn(mesh, num_nodes, max_iter, int(edge_chunk),
-                            bool(replicate), bool(with_pred))
+                            bool(replicate), bool(with_pred), str(layout))
     if with_pred:
         d, iters, improving, pred = fn(sources, src, dst, w)
         return d[:b], iters, improving.astype(bool), pred[:b]

@@ -1,0 +1,84 @@
+"""Fan-out layout dispatch tests (SURVEY.md §7 step 6: the vertex-major
+sorted-segment-reduction design vs the source-major scatter-min).
+
+Both layouts must be oracle-exact on the single-chip sparse path and the
+sharded path; ``"auto"`` resolves to vertex_major (the measured winner,
+BASELINE.md "fan-out layout" rows).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.backends import get_backend
+from paralleljohnson_tpu.graphs import erdos_renyi, grid2d, random_dag
+
+from conftest import oracle_apsp
+
+LAYOUTS = ["source_major", "vertex_major", "auto"]
+
+
+def _sparse_config(layout, **kw):
+    # dense_threshold=0 forces the sparse fan-out even on tiny graphs.
+    return SolverConfig(
+        backend="jax", dense_threshold=0, fanout_layout=layout, **kw
+    )
+
+
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_single_chip_sparse_fanout_matches_oracle(layout):
+    g = erdos_renyi(60, 0.09, seed=7)
+    backend = get_backend("jax", _sparse_config(layout, mesh_shape=(1,)))
+    dg = backend.upload(g)
+    res = backend.multi_source(dg, np.arange(60))
+    assert res.converged
+    np.testing.assert_allclose(res.dist, oracle_apsp(g), rtol=1e-5)
+
+
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_layouts_on_grid(layout):
+    """High-diameter graph: layouts must agree on many-sweep convergence."""
+    g = grid2d(7, 7, seed=3)
+    backend = get_backend("jax", _sparse_config(layout, mesh_shape=(1,)))
+    dg = backend.upload(g)
+    sources = np.array([0, 5, 24, 48])
+    res = backend.multi_source(dg, sources)
+    np.testing.assert_allclose(res.dist, oracle_apsp(g)[sources], rtol=1e-5)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+@pytest.mark.parametrize("layout", ["source_major", "vertex_major"])
+def test_sharded_fanout_layouts(layout):
+    g = erdos_renyi(64, 0.08, seed=11)
+    backend = get_backend("jax", _sparse_config(layout))
+    dg = backend.upload(g)
+    res = backend.multi_source(dg, np.arange(64))
+    np.testing.assert_allclose(res.dist, oracle_apsp(g), rtol=1e-5)
+
+
+@pytest.mark.parametrize("layout", ["source_major", "vertex_major"])
+def test_solver_end_to_end_negative_weights(layout):
+    """Full Johnson (reweighting included) under both layouts — the
+    vertex-major dst-sorted cache must be rebuilt after reweight."""
+    g = random_dag(48, 0.12, negative_fraction=0.4, seed=9)
+    res = ParallelJohnsonSolver(_sparse_config(layout)).solve(g)
+    np.testing.assert_allclose(res.matrix, oracle_apsp(g), rtol=1e-4, atol=1e-5)
+
+
+def test_vertex_major_with_pred_rejected():
+    from paralleljohnson_tpu.parallel import make_mesh, sharded_fanout
+
+    g = erdos_renyi(16, 0.2, seed=1)
+    with pytest.raises(ValueError, match="source_major"):
+        sharded_fanout(
+            make_mesh((1,)), np.arange(4),
+            g.src, g.indices, g.weights,
+            num_nodes=16, max_iter=16,
+            with_pred=True, layout="vertex_major",
+        )
+
+
+def test_auto_resolves_to_measured_winner():
+    backend = get_backend("jax", _sparse_config("auto"))
+    assert backend._resolve_layout() == "vertex_major"
