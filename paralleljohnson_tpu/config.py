@@ -43,6 +43,14 @@ class SolverConfig:
         ~3x on the CPU mesh; see BASELINE.md "fan-out layout" rows).
         Applies to the sparse single-chip and sharded paths; the dense
         min-plus path has no layout choice.
+      frontier: frontier-compacted Bellman-Ford (SSSP): relax only the
+        out-edges of vertices improved last round instead of all E every
+        sweep — the high-diameter (road/grid) mitigation of SURVEY.md §7.
+        ``"auto"`` enables it for low-max-degree non-tiny graphs; True
+        forces, False disables (always full sweeps).
+      frontier_capacity: static frontier-id buffer size (rounds whose
+        active set exceeds it fall back to one full sweep); ``None``
+        sizes it from V (see ``JaxBackend._frontier_capacity``).
       checkpoint_dir: if set, per-source-batch distance rows are saved here
         and resumed after preemption (SURVEY.md §5 checkpoint/resume).
       validate: cross-check results against the scipy oracle (slow; tests).
@@ -57,6 +65,8 @@ class SolverConfig:
     edge_pad_multiple: int = 512
     use_pallas: bool | str = "auto"
     fanout_layout: str = "auto"
+    frontier: bool | str = "auto"
+    frontier_capacity: int | None = None
     checkpoint_dir: str | None = None
     validate: bool = False
 
@@ -75,4 +85,8 @@ class SolverConfig:
             raise ValueError(
                 "fanout_layout must be auto/source_major/vertex_major, "
                 f"got {self.fanout_layout!r}"
+            )
+        if self.frontier not in (True, False, "auto"):
+            raise ValueError(
+                f"frontier must be True/False/'auto', got {self.frontier!r}"
             )

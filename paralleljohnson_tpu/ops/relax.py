@@ -277,6 +277,108 @@ def bellman_ford_sweeps_pred(
     return dist, pred, iters, improving
 
 
+# -- frontier-compacted sweeps (high-diameter graphs) -----------------------
+#
+# Sweep count ~ graph diameter is inherent to the full-sweep formulation
+# (see the dead-end note at the top of this file); on a road-like grid the
+# per-sweep WORK is the attackable axis instead: only out-edges of vertices
+# whose distance changed last round can improve anything, and on such
+# graphs that frontier is ~O(sqrt(V)) vertices, not V. The frontier is
+# compacted to a static-capacity id buffer (jnp.nonzero with size=K — jit
+# needs static shapes), out-edges are gathered via CSR indptr padded to the
+# graph's max degree, and a lax.cond falls back to the full chunked sweep
+# whenever the frontier overflows K (e.g. the all-active first rounds of a
+# virtual-source pass). Same fixpoint/negative-cycle contract as
+# bellman_ford_sweeps: round r of frontier relaxation computes exactly the
+# round-r Jacobi labels, so "still active after max_iter >= V rounds"
+# still certifies a reachable negative cycle.
+
+
+def bellman_ford_frontier(
+    dist0, src, dst, w, indptr, *, max_iter: int, capacity: int,
+    max_degree: int, num_real_edges: int, edge_chunk: int = 1 << 20,
+):
+    """Fixpoint Bellman-Ford over an active-vertex frontier (B=1).
+
+    Every per-round op is O(capacity x max_degree) — NOT O(V): the carried
+    distance vector is updated by an in-place scatter-min (XLA aliases the
+    while_loop carry, so no [V] copy), and the NEXT frontier is compacted
+    from the candidate tile itself (winner edges' destinations) rather
+    than scanning a [V] mask with jnp.nonzero. Winner ids may contain
+    duplicates (ties / multiple improving edges into one vertex) — that
+    only costs capacity, never correctness (re-relaxing is idempotent).
+
+    A round whose frontier count exceeds ``capacity`` falls back to one
+    full chunked sweep (O(E)), which preserves the Jacobi-round invariant:
+    round r always subsumes Jacobi round r, so "still active after
+    max_iter >= V rounds" still certifies a reachable negative cycle.
+
+    ``src``/``dst``/``w`` must be in CSR (src-sorted) order with ``indptr``
+    int32[V+1] describing the real (unpadded) edges; padded tail edges are
+    never touched by the frontier path and are (0, 0, +inf) no-ops for the
+    full-sweep fallback. ``capacity``/``max_degree``/``num_real_edges``
+    are static (host) ints. Returns (dist, rounds, still_improving,
+    edges_examined) — the last an f32 count of candidate relaxations
+    actually performed (the honest work metric; full sweeps add E each).
+    """
+    v = dist0.shape[0]
+    indptr = jnp.asarray(indptr, jnp.int32)
+    indptr_ext = jnp.concatenate([indptr, indptr[-1:]])
+    capacity = int(min(capacity, v))
+    k_edges = capacity * max_degree
+    n_edges = jnp.float32(num_real_edges)
+
+    def frontier_branch(d, ids, _count):
+        starts = indptr_ext[ids]
+        ends = indptr_ext[ids + 1]
+        eidx = starts[:, None] + jnp.arange(max_degree, dtype=jnp.int32)[None, :]
+        valid = eidx < ends[:, None]
+        eidx = jnp.minimum(eidx, dst.shape[0] - 1)  # clip; masked below
+        t = jnp.where(valid, dst[eidx], v).ravel()  # sentinel v: no-op row
+        wt = jnp.where(valid, w[eidx], INF)
+        cand = (d[ids][:, None] + wt).ravel()       # [K*max_deg]
+        old = d[t]                                  # gather (v -> clip, masked)
+        # In-place on the while_loop carry: O(K*max_deg) writes, no [V] copy.
+        nd = d.at[t].min(cand, mode="drop")
+        new = nd[t]
+        # Winner edges: strictly improved their destination AND achieved
+        # the post-scatter minimum. Their dsts form the next frontier.
+        winner = (cand < old) & (cand == new)
+        count = jnp.sum(winner)
+        t_ext = jnp.concatenate([t, jnp.full((1,), v, t.dtype)])
+        (pos,) = jnp.nonzero(winner, size=capacity, fill_value=k_edges)
+        next_ids = t_ext[pos]
+        return nd, next_ids, count, jnp.sum(valid).astype(jnp.float32)
+
+    def full_branch(d, _ids, _count):
+        nd = relax_sweep(d, src, dst, w, edge_chunk=edge_chunk)
+        improved = nd < d
+        count = jnp.sum(improved)
+        (next_ids,) = jnp.nonzero(improved, size=capacity, fill_value=v)
+        return nd, next_ids, count, n_edges
+
+    def cond(state):
+        _, _, count, i, _ = state
+        return (count > 0) & (i < max_iter)
+
+    def body(state):
+        d, ids, count, i, examined = state
+        nd, nids, ncount, ex = lax.cond(
+            count <= capacity, frontier_branch, full_branch, d, ids, count
+        )
+        return nd, nids, ncount, i + 1, examined + ex
+
+    # Initial frontier: the finite entries of dist0 (the sources). One
+    # O(V) nonzero outside the loop is fine.
+    active0 = jnp.isfinite(dist0)
+    count0 = jnp.sum(active0)
+    (ids0,) = jnp.nonzero(active0, size=capacity, fill_value=v)
+    dist, _, count, iters, examined = lax.while_loop(
+        cond, body, (dist0, ids0, count0, jnp.int32(0), jnp.float32(0.0))
+    )
+    return dist, iters, count > 0, examined
+
+
 def multi_source_init(sources, num_nodes: int, dtype=jnp.float32):
     """dist0[B, V]: +inf everywhere, 0 at each row's source."""
     b = sources.shape[0]

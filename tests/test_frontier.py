@@ -1,0 +1,81 @@
+"""Frontier-compacted Bellman-Ford tests (SURVEY.md §7 "Hard parts" #1:
+the high-diameter mitigation). Correctness bar: identical results to the
+full-sweep path and the scipy oracle, including negative weights, the
+overflow->full-sweep fallback, and negative-cycle certification."""
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.backends import get_backend
+from paralleljohnson_tpu.graphs import CSRGraph, grid2d, rmat
+
+from conftest import oracle_sssp
+
+
+def _bf(g, source, **cfg):
+    be = get_backend("jax", SolverConfig(**cfg))
+    return be.bellman_ford(be.upload(g), source)
+
+
+@pytest.mark.parametrize("neg", [0.0, 0.25])
+def test_frontier_matches_oracle_on_grid(neg):
+    g = grid2d(13, 13, negative_fraction=neg, seed=2)
+    res = _bf(g, 0, frontier=True)
+    np.testing.assert_allclose(res.dist, oracle_sssp(g, 0), atol=1e-4)
+    assert res.converged and not res.negative_cycle
+
+
+def test_frontier_equals_full_sweeps():
+    g = grid2d(17, 17, negative_fraction=0.2, seed=5)
+    a = _bf(g, 3, frontier=True)
+    b = _bf(g, 3, frontier=False)
+    np.testing.assert_array_equal(a.dist, b.dist)
+    # Same Jacobi-round count, far less edge work examined.
+    assert a.iterations == b.iterations
+    assert a.edges_relaxed < b.edges_relaxed / 3
+
+
+def test_overflow_falls_back_to_full_sweep():
+    """Capacity 8 is overwhelmed immediately; results must not change."""
+    g = grid2d(11, 11, seed=9)
+    a = _bf(g, 0, frontier=True, frontier_capacity=8)
+    np.testing.assert_allclose(a.dist, oracle_sssp(g, 0), atol=1e-4)
+
+
+def test_negative_cycle_detected_through_frontier():
+    # A long path (keeps max_degree small, V >= 512-free via force) into
+    # a 3-cycle of total weight -1.
+    n = 40
+    src = list(range(n - 4)) + [n - 4, n - 3, n - 2]
+    dst = list(range(1, n - 3)) + [n - 3, n - 2, n - 4]
+    w = [1.0] * (n - 4) + [1.0, 1.0, -3.0]
+    g = CSRGraph.from_edges(src, dst, w, n)
+    res = _bf(g, 0, frontier=True)
+    assert res.negative_cycle
+
+
+def test_virtual_source_with_frontier():
+    """Johnson phase 1 (source=None: all vertices start active) must run
+    through the frontier kernel's full-sweep fallback unharmed."""
+    g = grid2d(9, 9, negative_fraction=0.3, seed=11)
+    a = _bf(g, None, frontier=True)
+    b = _bf(g, None, frontier=False)
+    np.testing.assert_array_equal(a.dist, b.dist)
+
+
+def test_auto_gate():
+    cfg = SolverConfig(frontier="auto")
+    be = get_backend("jax", cfg)
+    assert be._use_frontier(be.upload(grid2d(32, 32, seed=1)))  # deg<=4
+    hubby = rmat(10, 16, seed=1)  # power-law: hub degrees >> 32
+    assert not be._use_frontier(be.upload(hubby))
+    assert not be._use_frontier(be.upload(grid2d(4, 4, seed=1)))  # tiny
+
+
+def test_solver_end_to_end_with_frontier():
+    g = grid2d(12, 12, negative_fraction=0.2, seed=8)
+    res = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", frontier=True)
+    ).sssp(g, 0)
+    np.testing.assert_allclose(res.dist[0], oracle_sssp(g, 0), atol=1e-4)
