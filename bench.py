@@ -57,24 +57,24 @@ def _run_config(scale: int, n_sources: int, repeats: int, *, ramp: bool) -> dict
     from paralleljohnson_tpu.config import SolverConfig
     from paralleljohnson_tpu.graphs import rmat
 
-    # Ramp mode forces dense_threshold=0 so the rungs compile the SAME
-    # sparse fan-out kernel they are warming up (rmat(10) has exactly 1024
-    # nodes, which would otherwise hit the unrelated dense min-plus
-    # branch). Non-ramp (smoke/fallback) keeps the default dispatch so the
-    # smoke metric stays comparable across rounds.
-    cfg = SolverConfig(dense_threshold=0) if ramp else SolverConfig()
-    backend = get_backend("jax", cfg)
+    # The TARGET always runs under the default config so the metric stays
+    # comparable across rounds and platforms; only the warm-up rungs force
+    # dense_threshold=0, so they compile the sparse fan-out kernel the
+    # target will use (rmat(10) has exactly 1024 nodes, which would
+    # otherwise hit the unrelated dense min-plus branch).
+    backend = get_backend("jax", SolverConfig())
 
     if ramp:
         # Grow compiled-fusion sizes gradually: a huge first XLA program is
         # a known tunnel-wedge trigger on this device lease.
+        warm_backend = get_backend("jax", SolverConfig(dense_threshold=0))
         for s in RAMP_SCALES:
             if s >= scale:
                 break
             gw = rmat(s, 16, seed=42)
-            dgw = backend.upload(gw)
+            dgw = warm_backend.upload(gw)
             srcs = np.arange(min(16, gw.num_nodes), dtype=np.int64)
-            backend.multi_source(dgw, srcs)
+            warm_backend.multi_source(dgw, srcs)
             _stage(f"warm scale={s} ok")
 
     g = rmat(scale, 16, seed=42)
@@ -150,19 +150,9 @@ def _child_main(scale: int, n_sources: int, repeats: int) -> None:
 def _graceful_stop(p: subprocess.Popen) -> None:
     """SIGTERM, wait, then SIGKILL only as a last resort — a hard-killed
     client is itself a known wedge trigger for the device tunnel."""
-    if p.poll() is not None:
-        return
-    p.terminate()
-    try:
-        p.wait(30)
-    except subprocess.TimeoutExpired:
-        p.kill()
-        try:
-            p.wait(10)
-        except subprocess.TimeoutExpired:
-            # Unreapable (D-state on wedged device I/O): abandon the zombie
-            # rather than crash — the caller must still emit its JSON line.
-            print("WARNING: child unreapable after SIGKILL", file=sys.stderr)
+    from paralleljohnson_tpu.utils.procs import graceful_stop
+
+    graceful_stop(p)
 
 
 def _tpu_attempt(

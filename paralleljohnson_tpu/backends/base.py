@@ -97,6 +97,13 @@ class Backend(abc.ABC):
         Optional."""
         raise NotImplementedError(f"{self.name} does not track predecessors")
 
+    def suggested_source_batch(self, dgraph: Any) -> int | None:
+        """Largest source batch one fan-out kernel call should take when
+        ``config.source_batch_size`` is None (the promised fits-memory
+        heuristic); ``None`` = no cap, solve all sources in one call.
+        Host-memory backends have no hard cap."""
+        return None
+
     # -- optional fast paths (defaults compose the kernels host-side) -------
 
     def reweight(self, dgraph: Any, potentials: np.ndarray) -> Any:

@@ -253,6 +253,36 @@ class JaxBackend(Backend):
         g.__dict__["_src"] = np.asarray(dgraph.src)[:e]
         return g
 
+    def _memory_budget_bytes(self) -> int:
+        """Usable accelerator memory for one fan-out call. Prefers the
+        device's own bytes_limit (TPU HBM); CPU hosts get a conservative
+        constant so the simulated mesh never balloons."""
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            limit = int(stats.get("bytes_limit", 0))
+            if limit > 0:
+                return limit // 2  # leave headroom for XLA temporaries
+        except Exception:
+            pass
+        return 4 << 30
+
+    def suggested_source_batch(self, dgraph: JaxDeviceGraph) -> int | None:
+        """Cap the [B, V] distance block to the device budget
+        (SolverConfig.source_batch_size=None contract). The edge-chunk
+        intermediate is bounded separately by ``_edge_chunk_for``, so the
+        [B, V] blocks dominate: ~6 of them live across the while_loop
+        carry, the update, and XLA temporaries."""
+        v = max(dgraph.num_nodes, 1)
+        itemsize = jnp.dtype(self._dtype).itemsize
+        n = self._mesh().devices.size
+        # Per-DEVICE budget: the batch shards over the mesh, so the global
+        # B is n x what one device can hold.
+        b = (self._memory_budget_bytes() // (6 * v * itemsize)) * n
+        b = int(max(1, min(b, 1 << 16)))
+        if b > n:
+            b -= b % n  # keep shards even on the mesh
+        return b
+
     def _use_frontier(self, dgraph: JaxDeviceGraph) -> bool:
         """Frontier compaction pays when the out-edge gather tile
         (capacity x max_degree) is small next to E — low-max-degree,
@@ -355,10 +385,10 @@ class JaxBackend(Backend):
                 -(-sources.shape[0] // mesh.devices.size),
                 dgraph.src.shape[0],
             )
-            dist, iters, improving, pred = sharded_fanout(
+            dist, iters, improving, pred, row_sweeps = sharded_fanout(
                 mesh, sources, dgraph.src, dgraph.dst, dgraph.weights,
                 num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
-                with_pred=True,
+                with_pred=True, with_row_sweeps=True,
             )
         else:
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
@@ -366,13 +396,14 @@ class JaxBackend(Backend):
                 sources, dgraph.src, dgraph.dst, dgraph.weights,
                 num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
             )
+            row_sweeps = int(iters) * int(sources.shape[0])
         iters = int(iters)
         return KernelResult(
             dist=np.asarray(dist),
             pred=np.asarray(pred),
             converged=not bool(improving),
             iterations=iters,
-            edges_relaxed=iters * dgraph.num_real_edges * int(sources.shape[0]),
+            edges_relaxed=int(row_sweeps) * dgraph.num_real_edges,
         )
 
     def _pallas_mode(self) -> tuple[bool, bool]:
@@ -426,10 +457,10 @@ class JaxBackend(Backend):
                 dgraph.by_dst() if layout == "vertex_major"
                 else (dgraph.src, dgraph.dst, dgraph.weights)
             )
-            dist, iters, improving = sharded_fanout(
+            dist, iters, improving, row_sweeps = sharded_fanout(
                 mesh, sources, *edges,
                 num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
-                layout=layout,
+                layout=layout, with_row_sweeps=True,
             )
         elif v <= self.config.dense_threshold:
             use_pallas, interpret = self._pallas_mode()
@@ -438,6 +469,7 @@ class JaxBackend(Backend):
                 num_nodes=v, max_iter=max_iter,
                 use_pallas=use_pallas, interpret=interpret,
             )
+            row_sweeps = int(iters) * int(sources.shape[0])
         elif layout == "vertex_major":
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
             src_bd, dst_bd, w_bd = dgraph.by_dst()
@@ -445,18 +477,22 @@ class JaxBackend(Backend):
                 sources, src_bd, dst_bd, w_bd,
                 num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
             )
+            row_sweeps = int(iters) * int(sources.shape[0])
         else:
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
             dist, iters, improving = _fanout_kernel(
                 sources, dgraph.src, dgraph.dst, dgraph.weights,
                 num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
             )
+            row_sweeps = int(iters) * int(sources.shape[0])
         iters = int(iters)
+        # Single-chip kernels iterate every row together, so iters x B is
+        # exact; the sharded path reports the psum'd per-shard total.
         return KernelResult(
             dist=np.asarray(dist),
             converged=not bool(improving),
             iterations=iters,
-            edges_relaxed=iters * dgraph.num_real_edges * int(sources.shape[0]),
+            edges_relaxed=int(row_sweeps) * dgraph.num_real_edges,
         )
 
     def reweight(self, dgraph: JaxDeviceGraph, potentials) -> JaxDeviceGraph:

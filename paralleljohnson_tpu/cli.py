@@ -30,6 +30,17 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--use-pallas", default="auto",
                    choices=["auto", "true", "false"],
                    help="Pallas dense kernels: auto (TPU only) / force / off")
+    p.add_argument("--mesh-shape", default=None, metavar="N[,M...]",
+                   help="devices along the sources mesh axis (e.g. 8); "
+                        "default: all visible devices")
+    p.add_argument("--fanout-layout", default="auto",
+                   choices=["auto", "source_major", "vertex_major"],
+                   help="sparse fan-out data layout (auto = vertex_major, "
+                        "the measured winner)")
+    p.add_argument("--frontier", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="frontier-compacted Bellman-Ford for high-diameter "
+                        "graphs: auto (low-degree graphs) / force / off")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--predecessors", action="store_true",
                    help="also compute shortest-path trees (saved to --output)")
@@ -47,13 +58,20 @@ def _add_common(p: argparse.ArgumentParser) -> None:
 def _config(args) -> "SolverConfig":
     from paralleljohnson_tpu.config import SolverConfig
 
+    tristate = {"auto": "auto", "true": True, "false": False}
+    mesh_shape = None
+    if args.mesh_shape is not None:
+        mesh_shape = tuple(int(n) for n in args.mesh_shape.split(","))
     return SolverConfig(
         backend=args.backend,
         precision=args.precision,
         source_batch_size=args.batch_size,
+        mesh_shape=mesh_shape,
         max_iterations=args.max_iterations,
         dense_threshold=args.dense_threshold,
-        use_pallas={"auto": "auto", "true": True, "false": False}[args.use_pallas],
+        use_pallas=tristate[args.use_pallas],
+        fanout_layout=args.fanout_layout,
+        frontier=tristate[args.frontier],
         checkpoint_dir=args.checkpoint_dir,
         validate=args.validate,
     )

@@ -57,7 +57,8 @@ def make_mesh(mesh_shape: tuple[int, ...] | None = None) -> Mesh:
 def _sharded_fanout_fn(mesh: Mesh, num_nodes: int, max_iter: int,
                        edge_chunk: int, replicate: bool,
                        with_pred: bool = False,
-                       layout: str = "source_major"):
+                       layout: str = "source_major",
+                       pad: int = 0):
     """Build + cache the jitted sharded fan-out for one (mesh, graph-shape)
     combo. Cached on function identity so jit's own trace cache works.
 
@@ -91,16 +92,30 @@ def _sharded_fanout_fn(mesh: Mesh, num_nodes: int, max_iter: int,
             )
         if replicate:
             d = jax.lax.all_gather(d, "sources", axis=0, tiled=True)
+        # Exact work accounting (not pmax(iters) x B, which overcounts
+        # shards that converged early): each shard contributes its own
+        # sweep count x its REAL row count. Padding rows sit at the TAIL
+        # of the padded batch and may span several shards (e.g. 11 rows
+        # on 8 devices -> per_shard 2, pad 5 across shards 5-7), so clip
+        # per shard rather than billing only the last one. psum keeps
+        # this multi-host-safe.
+        per_shard = srcs.shape[0]
+        n_shards = jax.lax.axis_size("sources")
+        b_real = n_shards * per_shard - pad
+        my_rows = jnp.clip(
+            b_real - jax.lax.axis_index("sources") * per_shard, 0, per_shard
+        )
+        row_sweeps = jax.lax.psum(iters * my_rows, "sources")
         iters = jax.lax.pmax(iters, "sources")
         improving = jax.lax.pmax(improving.astype(jnp.int32), "sources")
         if with_pred:
-            return d, iters, improving, pred
-        return d, iters, improving
+            return d, iters, improving, row_sweeps, pred
+        return d, iters, improving, row_sweeps
 
     dist_spec = P(None) if replicate else P("sources")
     out_specs = (
-        (dist_spec, P(), P(), P("sources")) if with_pred
-        else (dist_spec, P(), P())
+        (dist_spec, P(), P(), P(), P("sources")) if with_pred
+        else (dist_spec, P(), P(), P())
     )
     mapped = shard_map(
         shard_body,
@@ -125,6 +140,8 @@ def sharded_fanout(
     replicate: bool = False,
     with_pred: bool = False,
     layout: str = "source_major",
+    with_row_sweeps: bool = False,
+    n_real_rows: int | None = None,
 ):
     """N-source fan-out with sources sharded over ``mesh``.
 
@@ -133,7 +150,14 @@ def sharded_fanout(
     gathers rows (explicit ICI all_gather when ``replicate=True``, output-
     sharding assembly otherwise). Returns (dist[B, V], iterations,
     still_improving), plus pred[B, V] appended when ``with_pred=True``
-    (predecessor rows stay sharded on "sources" like the distance rows).
+    (predecessor rows stay sharded on "sources" like the distance rows),
+    plus the exact row-sweep total (sum over shards of sweeps x real rows,
+    for edges-relaxed accounting) appended when ``with_row_sweeps=True``.
+
+    ``n_real_rows``: when the caller already padded the batch (e.g.
+    :func:`multihost.global_sources`), the number of genuine rows at the
+    front — the duplicate tail rows are then excluded from the row-sweep
+    accounting exactly like locally-added padding.
 
     ``layout="vertex_major"`` runs the per-shard sweep on a [V, B_shard]
     block with a sorted segment reduction — the caller MUST then pass
@@ -147,15 +171,27 @@ def sharded_fanout(
     b = sources.shape[0]
     pad = (-b) % n
     if pad:
+        if isinstance(sources, jax.Array) and not sources.is_fully_addressable:
+            raise ValueError(
+                "off-multiple source batch arrived as a non-fully-"
+                "addressable global array; pad on the host before building "
+                "it (multihost.global_sources does this automatically)"
+            )
         # Pad with a duplicate of a real source, not vertex 0: padding rows
         # participate in the pmax'd still-improving flag, and an arbitrary
         # vertex 0 row could need more sweeps than every requested source,
         # turning a converged fan-out into a spurious ConvergenceError.
         sources = jnp.concatenate([sources, jnp.full(pad, sources[0], jnp.int32)])
+    acct_pad = pad + (b - n_real_rows if n_real_rows is not None else 0)
     fn = _sharded_fanout_fn(mesh, num_nodes, max_iter, int(edge_chunk),
-                            bool(replicate), bool(with_pred), str(layout))
+                            bool(replicate), bool(with_pred), str(layout),
+                            int(acct_pad))
     if with_pred:
-        d, iters, improving, pred = fn(sources, src, dst, w)
-        return d[:b], iters, improving.astype(bool), pred[:b]
-    d, iters, improving = fn(sources, src, dst, w)
-    return d[:b], iters, improving.astype(bool)
+        d, iters, improving, row_sweeps, pred = fn(sources, src, dst, w)
+        out = (d[:b], iters, improving.astype(bool), pred[:b])
+    else:
+        d, iters, improving, row_sweeps = fn(sources, src, dst, w)
+        out = (d[:b], iters, improving.astype(bool))
+    if with_row_sweeps:
+        out = out + (int(row_sweeps),)
+    return out

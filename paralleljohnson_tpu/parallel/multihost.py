@@ -78,11 +78,25 @@ def global_sources(mesh, sources: np.ndarray):
     int32[B]); each process materializes only its addressable shards. This
     is the multi-host-safe way to feed ``shard_map``: passing a numpy array
     directly would require process 0 to own all shards.
+
+    Off-multiple batches are padded HERE, on the host copy, to a multiple
+    of the global device count (duplicating ``sources[0]``, the same
+    convention as ``sharded_fanout``): padding a non-fully-addressable
+    global array later with eager ops would fail in a real multi-process
+    run. Callers slice result rows back to their own batch length, and
+    should pass ``n_real_rows=<their B>`` to ``sharded_fanout`` so the
+    duplicate tail rows stay out of the row-sweep accounting.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     sources = np.asarray(sources, np.int32)
+    n = mesh.devices.size
+    pad = (-sources.shape[0]) % n
+    if pad and sources.shape[0]:
+        sources = np.concatenate(
+            [sources, np.full(pad, sources[0], np.int32)]
+        )
     sharding = NamedSharding(mesh, P("sources"))
     return jax.make_array_from_callback(
         sources.shape, sharding, lambda idx: sources[idx]

@@ -247,8 +247,16 @@ class ParallelJohnsonSolver:
 
     # -- internals ----------------------------------------------------------
 
-    def _source_batches(self, sources: np.ndarray) -> list[np.ndarray]:
-        bs = self.config.source_batch_size or len(sources) or 1
+    def _source_batches(
+        self, sources: np.ndarray, dgraph: Any = None
+    ) -> list[np.ndarray]:
+        bs = self.config.source_batch_size
+        if bs is None and dgraph is not None:
+            # The promised fits-memory heuristic (config.source_batch_size
+            # docstring): the backend sizes the [B, V] block to its device
+            # budget so e.g. RMAT-20 full APSP cannot OOM by default.
+            bs = self.backend.suggested_source_batch(dgraph)
+        bs = bs or len(sources) or 1
         return [sources[i : i + bs] for i in range(0, len(sources), bs)]
 
     def _fanout(
@@ -273,7 +281,9 @@ class ParallelJohnsonSolver:
             )
         rows: list[np.ndarray] = []
         preds: list[np.ndarray] = []
-        for batch_idx, batch in enumerate(self._source_batches(sources)):
+        for batch_idx, batch in enumerate(
+            self._source_batches(sources, dgraph)
+        ):
             if ckpt is not None:
                 cached = ckpt.load(batch_idx, batch, with_pred=with_pred)
                 if cached is not None:

@@ -75,11 +75,14 @@ class BatchCheckpointer:
     def load(
         self, batch_idx: int, sources: np.ndarray, *, with_pred: bool = False
     ) -> tuple[np.ndarray, np.ndarray | None] | None:
-        """(rows, pred-or-None) for this batch, or None if absent/corrupt/
-        tampered (recompute — fault detection per SURVEY.md §5: a
-        bit-flipped batch result must be caught, not propagated into the
-        APSP matrix). ``with_pred=True`` additionally requires a valid
-        predecessor array — a rows-only checkpoint is treated as missing."""
+        """(rows, pred-or-None) for this batch, or None if absent or
+        CORRUPT (recompute — fault detection per SURVEY.md §5: a
+        bit-flipped or truncated batch result must be caught, not
+        propagated into the APSP matrix). The unkeyed sha-256 detects
+        accidental corruption only — anyone who can modify rows can
+        recompute the digest, so deliberate tampering is out of scope.
+        ``with_pred=True`` additionally requires a valid predecessor
+        array — a rows-only checkpoint is treated as missing."""
         path = self._path(batch_idx, sources)
         if not path.exists():
             return None

@@ -23,7 +23,7 @@ def _fake_child(body: str) -> list[str]:
 def test_result_kept_despite_teardown_hang():
     """A parsed RESULT survives a child that wedges after printing it."""
     measured = bench._tpu_attempt(
-        0, 0, 0, total_timeout=30, stage_timeout=2,
+        0, 0, 0, total_timeout=60, stage_timeout=6,
         _cmd=_fake_child(
             "import time\n"
             "print('STAGE probe ok', flush=True)\n"

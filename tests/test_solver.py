@@ -132,3 +132,30 @@ def test_stats_populated(tiny_graph):
     assert d["edges_relaxed"] > 0
     assert "bellman_ford" in d["phase_seconds"]
     assert d["edges_relaxed_per_sec"] >= 0
+
+
+def test_source_batch_heuristic(monkeypatch):
+    """source_batch_size=None uses the backend's fits-memory suggestion
+    (config.py contract; VERDICT r1 weak #5)."""
+    from paralleljohnson_tpu.backends import get_backend
+
+    g = erdos_renyi(64, 0.1, seed=12)
+    be = get_backend("jax", SolverConfig())
+    dg = be.upload(g)
+    b = be.suggested_source_batch(dg)
+    assert b is not None and b >= 1
+    # Tiny budget: 64 rows per DEVICE -> 64 x mesh size globally.
+    monkeypatch.setattr(
+        type(be), "_memory_budget_bytes", lambda self: 64 * 64 * 4 * 6
+    )
+    n = be._mesh().devices.size
+    assert be.suggested_source_batch(dg) == 64 * n
+    solver = ParallelJohnsonSolver(SolverConfig(backend="jax"))
+    monkeypatch.setattr(
+        type(solver.backend), "suggested_source_batch",
+        lambda self, dg: 16,
+    )
+    res = solver.solve(g)
+    from conftest import oracle_apsp
+
+    np.testing.assert_allclose(res.matrix, oracle_apsp(g), rtol=1e-5)
