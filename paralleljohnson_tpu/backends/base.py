@@ -28,7 +28,9 @@ class KernelResult:
     """Output of one backend kernel invocation.
 
     dist: [V] (single-source) or [B, V] (multi-source) distances, +inf for
-      unreachable.
+      unreachable. Device backends return their native device array (jax)
+      so results can stay resident in HBM — RMAT-22 rows must never be
+      forced to host wholesale; call ``np.asarray`` to materialize.
     negative_cycle: True iff a negative cycle is reachable (Bellman-Ford
       only; always False for the non-negative fan-out). Only claimed when
       the kernel ran the full |V|-sweep Bellman-Ford bound — a user-capped
@@ -43,7 +45,7 @@ class KernelResult:
       settled vertices.
     """
 
-    dist: np.ndarray
+    dist: Any  # np.ndarray or a device array (see docstring)
     negative_cycle: bool = False
     iterations: int = 0
     edges_relaxed: int = 0

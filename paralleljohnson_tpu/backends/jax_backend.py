@@ -337,7 +337,7 @@ class JaxBackend(Backend):
         iters = int(iters)
         improving = bool(improving)
         return KernelResult(
-            dist=np.asarray(dist),
+            dist=dist,
             negative_cycle=improving and max_iter >= v,
             converged=not improving,
             iterations=iters,
@@ -362,8 +362,8 @@ class JaxBackend(Backend):
         iters = int(iters)
         improving = bool(improving)
         return KernelResult(
-            dist=np.asarray(dist),
-            pred=np.asarray(pred),
+            dist=dist,
+            pred=pred,
             negative_cycle=improving and max_iter >= v,
             converged=not improving,
             iterations=iters,
@@ -399,8 +399,8 @@ class JaxBackend(Backend):
             row_sweeps = int(iters) * int(sources.shape[0])
         iters = int(iters)
         return KernelResult(
-            dist=np.asarray(dist),
-            pred=np.asarray(pred),
+            dist=dist,
+            pred=pred,
             converged=not bool(improving),
             iterations=iters,
             edges_relaxed=int(row_sweeps) * dgraph.num_real_edges,
@@ -489,7 +489,7 @@ class JaxBackend(Backend):
         # Single-chip kernels iterate every row together, so iters x B is
         # exact; the sharded path reports the psum'd per-shard total.
         return KernelResult(
-            dist=np.asarray(dist),
+            dist=dist,
             converged=not bool(improving),
             iterations=iters,
             edges_relaxed=int(row_sweeps) * dgraph.num_real_edges,
@@ -518,7 +518,7 @@ class JaxBackend(Backend):
         )
         total_iters = int(jnp.sum(iters))
         return KernelResult(
-            dist=np.asarray(dist),
+            dist=dist,
             negative_cycle=bool(jnp.any(neg)),
             iterations=int(jnp.max(iters)),
             edges_relaxed=total_iters * e * v,

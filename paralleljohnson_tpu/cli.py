@@ -82,7 +82,11 @@ def _report(res, args) -> None:
         from paralleljohnson_tpu.utils.profiling import log_stats
 
         log_stats(res.stats, label=args.command)
-    finite = float(np.isfinite(res.dist).mean())
+    # Device-aware reduction: np.isfinite on a device-resident dist would
+    # download the whole matrix just to print one fraction.
+    from paralleljohnson_tpu.benchmarks import _finite_frac
+
+    finite = _finite_frac(res.dist)
     payload = {
         "shape": list(res.dist.shape),
         "finite_fraction": round(finite, 6),

@@ -39,27 +39,33 @@ INF = jnp.inf
 _K_SUB = 8
 
 
-def _minplus_kernel(d_ref, a_ref, o_ref, *, k_sub: int):
-    """One (i, j, k) grid step: fold d[bi, bk] (x) a[bk, bj] into o[bi, bj].
+def _minplus_kernel(dt_ref, a_ref, o_ref, *, k_sub: int):
+    """One (i, j, k) grid step: fold dT[bk, bi] (x) a[bk, bj] into o[bi, bj].
 
     Grid order puts k innermost, so o_ref revisits: initialize at k==0,
     min-accumulate after. The fori_loop sweeps the k-block in ``k_sub``
-    sub-slabs to bound the [bi, k_sub, bj] broadcast intermediate.
+    sub-slabs to bound the [k_sub, bi, bj] broadcast intermediate.
+
+    Real-v5e Mosaic constraints shaped this kernel (interpret-mode CI
+    accepts much more than the chip does):
+      - ``lax.dynamic_slice`` on loaded values has no TC lowering — slabs
+        are sliced off the VMEM refs with ``pl.ds``;
+      - a dynamic slice start on the minor (lane) dimension must be
+        provably 128-aligned, so ``d`` arrives TRANSPOSED ([K, I]) and both
+        refs are sliced on the sublane dimension, where ``s * k_sub`` is
+        provably 8-aligned.
     """
 
     @pl.when(pl.program_id(2) == 0)
     def _init():
         o_ref[:] = jnp.full_like(o_ref, INF)
 
-    d_blk = d_ref[:]
-    a_blk = a_ref[:]
-    bi, bk = d_blk.shape
-    bj = a_blk.shape[1]
+    bk = dt_ref.shape[0]
 
     def body(s, acc):
-        ds = jax.lax.dynamic_slice(d_blk, (0, s * k_sub), (bi, k_sub))
-        as_ = jax.lax.dynamic_slice(a_blk, (s * k_sub, 0), (k_sub, bj))
-        cand = jnp.min(ds[:, :, None] + as_[None, :, :], axis=1)
+        dt = dt_ref[pl.ds(s * k_sub, k_sub), :]   # [k_sub, bi]
+        as_ = a_ref[pl.ds(s * k_sub, k_sub), :]   # [k_sub, bj]
+        cand = jnp.min(dt[:, :, None] + as_[:, None, :], axis=0)
         return jnp.minimum(acc, cand)
 
     o_ref[:] = jax.lax.fori_loop(0, bk // k_sub, body, o_ref[:])
@@ -98,18 +104,21 @@ def minplus_pallas(
     # dim (8 for f32); bj and bk are lane dims of their blocks (128) — bk
     # is the minor axis of the d block, and a multiple of 128 is also a
     # multiple of _K_SUB, so the fori_loop never drops remainder k-rows.
-    bi = _round_up(min(block_i, i), _K_SUB)
+    # bi is a lane dim of the transposed d block (128); bj is the lane dim
+    # of the a/out blocks (128); bk is a sublane dim for both inputs and a
+    # multiple of _K_SUB, so the fori_loop never drops remainder k-rows.
+    bi = _round_up(min(block_i, i), 128)
     bj = _round_up(min(block_j, j), 128)
-    bk = _round_up(min(block_k, k), 128)
+    bk = _round_up(min(block_k, k), _K_SUB)
     ip, kp, jp = _round_up(i, bi), _round_up(k, bk), _round_up(j, bj)
-    d = _pad_to(d, ip, kp)
+    dt = _pad_to(d.T, kp, ip)  # [K, I]: k on the sublane dim (see kernel)
     a = _pad_to(a, kp, jp)
 
     out = pl.pallas_call(
         functools.partial(_minplus_kernel, k_sub=_K_SUB),
         grid=(ip // bi, jp // bj, kp // bk),
         in_specs=[
-            pl.BlockSpec((bi, bk), lambda gi, gj, gk: (gi, gk)),
+            pl.BlockSpec((bk, bi), lambda gi, gj, gk: (gk, gi)),
             pl.BlockSpec((bk, bj), lambda gi, gj, gk: (gk, gj)),
         ],
         out_specs=pl.BlockSpec((bi, bj), lambda gi, gj, gk: (gi, gj)),
@@ -118,7 +127,7 @@ def minplus_pallas(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(d, a)
+    )(dt, a)
     return out[:i, :j]
 
 

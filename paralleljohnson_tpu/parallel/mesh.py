@@ -57,8 +57,7 @@ def make_mesh(mesh_shape: tuple[int, ...] | None = None) -> Mesh:
 def _sharded_fanout_fn(mesh: Mesh, num_nodes: int, max_iter: int,
                        edge_chunk: int, replicate: bool,
                        with_pred: bool = False,
-                       layout: str = "source_major",
-                       pad: int = 0):
+                       layout: str = "source_major"):
     """Build + cache the jitted sharded fan-out for one (mesh, graph-shape)
     combo. Cached on function identity so jit's own trace cache works.
 
@@ -93,29 +92,21 @@ def _sharded_fanout_fn(mesh: Mesh, num_nodes: int, max_iter: int,
         if replicate:
             d = jax.lax.all_gather(d, "sources", axis=0, tiled=True)
         # Exact work accounting (not pmax(iters) x B, which overcounts
-        # shards that converged early): each shard contributes its own
-        # sweep count x its REAL row count. Padding rows sit at the TAIL
-        # of the padded batch and may span several shards (e.g. 11 rows
-        # on 8 devices -> per_shard 2, pad 5 across shards 5-7), so clip
-        # per shard rather than billing only the last one. psum keeps
-        # this multi-host-safe.
-        per_shard = srcs.shape[0]
-        n_shards = jax.lax.axis_size("sources")
-        b_real = n_shards * per_shard - pad
-        my_rows = jnp.clip(
-            b_real - jax.lax.axis_index("sources") * per_shard, 0, per_shard
-        )
-        row_sweeps = jax.lax.psum(iters * my_rows, "sources")
+        # shards that converged early): each shard reports its own sweep
+        # count; the host multiplies by that shard's REAL row count in
+        # Python ints (an int32 iters x rows product on device could wrap
+        # past 2^31 on high-diameter graphs with wide batches).
+        iters_vec = iters[None]  # [1] per shard -> [n_shards] global
         iters = jax.lax.pmax(iters, "sources")
         improving = jax.lax.pmax(improving.astype(jnp.int32), "sources")
         if with_pred:
-            return d, iters, improving, row_sweeps, pred
-        return d, iters, improving, row_sweeps
+            return d, iters, improving, iters_vec, pred
+        return d, iters, improving, iters_vec
 
     dist_spec = P(None) if replicate else P("sources")
     out_specs = (
-        (dist_spec, P(), P(), P(), P("sources")) if with_pred
-        else (dist_spec, P(), P(), P())
+        (dist_spec, P(), P(), P("sources"), P("sources")) if with_pred
+        else (dist_spec, P(), P(), P("sources"))
     )
     mapped = shard_map(
         shard_body,
@@ -184,14 +175,26 @@ def sharded_fanout(
         sources = jnp.concatenate([sources, jnp.full(pad, sources[0], jnp.int32)])
     acct_pad = pad + (b - n_real_rows if n_real_rows is not None else 0)
     fn = _sharded_fanout_fn(mesh, num_nodes, max_iter, int(edge_chunk),
-                            bool(replicate), bool(with_pred), str(layout),
-                            int(acct_pad))
+                            bool(replicate), bool(with_pred), str(layout))
     if with_pred:
-        d, iters, improving, row_sweeps, pred = fn(sources, src, dst, w)
+        d, iters, improving, iters_vec, pred = fn(sources, src, dst, w)
         out = (d[:b], iters, improving.astype(bool), pred[:b])
     else:
-        d, iters, improving, row_sweeps = fn(sources, src, dst, w)
+        d, iters, improving, iters_vec = fn(sources, src, dst, w)
         out = (d[:b], iters, improving.astype(bool))
     if with_row_sweeps:
-        out = out + (int(row_sweeps),)
+        # Exact, overflow-free accounting in Python ints: each shard's
+        # sweep count x its REAL row count. Padding rows (locally added
+        # and/or the caller's pre-padded tail, ``acct_pad`` total) sit at
+        # the TAIL and may span several shards (11 rows on 8 devices ->
+        # per_shard 2, pad 5 across shards 5-7), so clip per shard.
+        per_shard = (b + pad) // n
+        b_real = b + pad - acct_pad
+        shard_iters = np.asarray(iters_vec)
+        row_sweeps = sum(
+            int(shard_iters[i])
+            * max(0, min(per_shard, b_real - i * per_shard))
+            for i in range(n)
+        )
+        out = out + (row_sweeps,)
     return out

@@ -49,6 +49,9 @@ class SolveResult:
 
     dist: [N_sources, V] distance rows (+inf unreachable); for full APSP
       N_sources == V and row i is distances from vertex ``sources[i]``.
+      Device backends leave single-batch rows resident on device (HBM) —
+      ``np.asarray(result.dist)`` materializes host-side; multi-batch and
+      checkpointed solves already return host arrays.
     sources: the source vertex of each row.
     potentials: Johnson potentials h(v) (zeros when no reweighting ran).
     stats: per-phase wall-clock, iteration counts, edges-relaxed totals.
@@ -58,11 +61,11 @@ class SolveResult:
       shortest paths, so the tree computed on w' is the tree on w.
     """
 
-    dist: np.ndarray
+    dist: Any  # np.ndarray or device array (see docstring)
     sources: np.ndarray
-    potentials: np.ndarray
+    potentials: Any
     stats: SolverStats
-    predecessors: np.ndarray | None = None
+    predecessors: Any | None = None
 
     @property
     def matrix(self) -> np.ndarray:
@@ -80,7 +83,12 @@ class SolveResult:
         rows = np.flatnonzero(self.sources == source)
         if rows.size == 0:
             raise ValueError(f"vertex {source} was not a solve source")
-        return reconstruct_path(self.predecessors[rows[0]], source, target)
+        # One host materialization of the row: reconstruct_path walks it
+        # element-wise, which on a device-resident row would be one
+        # blocking device round-trip per hop.
+        return reconstruct_path(
+            np.asarray(self.predecessors[rows[0]]), source, target
+        )
 
 
 class ParallelJohnsonSolver:
@@ -135,7 +143,10 @@ class ParallelJohnsonSolver:
                     "Bellman-Ford hit max_iterations while still improving; "
                     "raise SolverConfig.max_iterations (or leave it None)"
                 )
-            h = np.asarray(bf.dist)
+            # Keep potentials on the backend's device (a [V] row is 16 MB at
+            # RMAT-22); reweight and phase-3 arithmetic both consume them
+            # in place, and np.asarray materializes on demand elsewhere.
+            h = bf.dist
             with phase_timer(stats, "reweight"):
                 dgraph = self.backend.reweight(dgraph, h)
         else:
@@ -150,7 +161,11 @@ class ParallelJohnsonSolver:
         # Phase 3 — un-reweight: d(u,v) = d'(u,v) - h(u) + h(v).
         with phase_timer(stats, "unreweight"):
             if graph.has_negative_weights:
-                dist = dist - h[sources][:, None] + h[None, :]
+                # Where dist lives wins: device h against host rows (the
+                # checkpointed / multi-batch path) would silently promote
+                # the whole matrix back onto the device.
+                hh = np.asarray(h) if isinstance(dist, np.ndarray) else h
+                dist = dist - hh[sources][:, None] + hh[None, :]
                 # +inf - h + h must stay +inf; inf arithmetic already
                 # guarantees that, but mask anyway against inf-inf NaNs
                 # if h itself has +inf (unreachable-from-virtual never
@@ -182,11 +197,11 @@ class ParallelJohnsonSolver:
                 "Bellman-Ford hit max_iterations while still improving"
             )
         return SolveResult(
-            dist=np.asarray(bf.dist)[None, :],
+            dist=bf.dist[None, :],
             sources=np.array([source]),
             potentials=np.zeros(graph.num_nodes, graph.dtype),
             stats=stats,
-            predecessors=None if bf.pred is None else np.asarray(bf.pred)[None, :],
+            predecessors=None if bf.pred is None else bf.pred[None, :],
         )
 
     def multi_source(
@@ -279,11 +294,10 @@ class ParallelJohnsonSolver:
             ckpt = BatchCheckpointer(
                 self.config.checkpoint_dir, graph_key=graph
             )
+        batches = self._source_batches(sources, dgraph)
         rows: list[np.ndarray] = []
         preds: list[np.ndarray] = []
-        for batch_idx, batch in enumerate(
-            self._source_batches(sources, dgraph)
-        ):
+        for batch_idx, batch in enumerate(batches):
             if ckpt is not None:
                 cached = ckpt.load(batch_idx, batch, with_pred=with_pred)
                 if cached is not None:
@@ -302,10 +316,19 @@ class ParallelJohnsonSolver:
                 raise ConvergenceError(
                     "fan-out hit max_iterations while still improving"
                 )
-            row = np.asarray(res.dist)
-            pred = None if res.pred is None else np.asarray(res.pred)
-            if ckpt is not None:
-                ckpt.save(batch_idx, batch, row, pred=pred)
+            # A SINGLE-batch solve keeps device-backend rows resident on
+            # device (at RMAT-22 scale rows must never be forced to host
+            # wholesale). Multi-batch solves STREAM each batch to host:
+            # the batching exists because all rows together exceed the
+            # device budget (suggested_source_batch), so accumulating
+            # device buffers across batches would defeat it. Checkpointing
+            # (host .npz) forces the download either way.
+            row, pred = res.dist, res.pred
+            if ckpt is not None or len(batches) > 1:
+                row = np.asarray(row)
+                pred = None if pred is None else np.asarray(pred)
+                if ckpt is not None:
+                    ckpt.save(batch_idx, batch, row, pred=pred)
             rows.append(row)
             if with_pred:
                 preds.append(pred)

@@ -1,0 +1,118 @@
+"""On-chip microbenchmark of the fan-out sweep pieces (VERDICT #6).
+
+Times, on the real TPU: the full vm/sm fan-out (sweep counts + wall), one
+isolated sweep of each layout, and the two constituent ops of the vm sweep
+(the [E, B] row gather on src and the sorted segment-min on dst) so the
+Pallas go/no-go decision can cite real numbers. Run from the repo root:
+
+    python scripts/tpu_micro.py [scale] [B]
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def timed(fn, *args, repeats=3, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+
+if __name__ == "__main__":
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+
+    import jax
+    import jax.numpy as jnp
+
+    print("platform:", jax.default_backend(), flush=True)
+
+    from paralleljohnson_tpu.backends import get_backend
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.graphs import rmat
+    from paralleljohnson_tpu.ops import relax
+
+    # small warmup first (tunnel ramp)
+    for s in (10, 13):
+        if s >= scale:
+            break
+        gw = rmat(s, 16, seed=42)
+        be = get_backend("jax", SolverConfig(dense_threshold=0))
+        dg = be.upload(gw)
+        be.multi_source(dg, np.arange(8, dtype=np.int64))
+        print(f"warm {s} ok", flush=True)
+
+    g = rmat(scale, 16, seed=42)
+    rng = np.random.default_rng(0)
+    sources = np.sort(rng.choice(g.num_nodes, size=B, replace=False)).astype(np.int64)
+    V = g.num_nodes
+    E = g.num_real_edges
+    print(f"graph: V={V} E={E} B={B}", flush=True)
+
+    for layout in ("vertex_major", "source_major"):
+        be = get_backend("jax", SolverConfig(fanout_layout=layout))
+        dg = be.upload(g)
+        res = be.multi_source(dg, sources)  # compile
+        t0 = time.perf_counter()
+        res = be.multi_source(dg, sources)
+        dt = time.perf_counter() - t0
+        print(f"fanout[{layout}]: {dt:.3f}s iters={res.iterations} "
+              f"-> {dt/max(res.iterations,1)*1e3:.1f} ms/sweep", flush=True)
+
+    # isolated pieces, vm layout
+    be = get_backend("jax", SolverConfig())
+    dg = be.upload(g)
+    src_bd, dst_bd, w_bd = dg.by_dst()
+    d_vm = jnp.asarray(
+        np.random.default_rng(1).random((V, B), np.float32) * 10
+    )
+
+    sweep = jax.jit(lambda d: relax.relax_sweep_vm(d, src_bd, dst_bd, w_bd))
+    dt, _ = timed(sweep, d_vm)
+    print(f"one vm sweep: {dt*1e3:.1f} ms "
+          f"({(E*B*4*2)/dt/1e9:.1f} GB/s eff)", flush=True)
+
+    gather = jax.jit(lambda d: d[src_bd, :] + w_bd[:, None])
+    dt_g, cand = timed(gather, d_vm)
+    print(f"  gather only [E,B]: {dt_g*1e3:.1f} ms "
+          f"({(E*B*4)/dt_g/1e9:.1f} GB/s)", flush=True)
+
+    segmin = jax.jit(
+        lambda c: jax.ops.segment_min(
+            c, dst_bd, num_segments=V, indices_are_sorted=True
+        )
+    )
+    dt_s, _ = timed(segmin, cand)
+    print(f"  sorted segment_min: {dt_s*1e3:.1f} ms", flush=True)
+
+    segmin_us = jax.jit(
+        lambda c: jax.ops.segment_min(
+            c, dst_bd, num_segments=V, indices_are_sorted=False
+        )
+    )
+    dt_u, _ = timed(segmin_us, cand)
+    print(f"  unsorted segment_min: {dt_u*1e3:.1f} ms", flush=True)
+
+    # scatter-style (source-major shape): flattened ids
+    d_sm = jnp.asarray(np.asarray(d_vm).T.copy())
+    be2 = get_backend("jax", SolverConfig(fanout_layout="source_major"))
+    dg2 = be2.upload(g)
+    sweep_sm = jax.jit(
+        lambda d: relax.relax_sweep(d, dg2.src, dg2.dst, dg2.weights)
+    )
+    dt, _ = timed(sweep_sm, d_sm)
+    print(f"one sm sweep: {dt*1e3:.1f} ms", flush=True)
+
+    # dense-block alternative piece: cand via one-hot matmul? (skip)
+    print("done", flush=True)

@@ -1,0 +1,81 @@
+"""Separate device-compute time from host-download time in the fan-out."""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+if __name__ == "__main__":
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+
+    import jax
+    import jax.numpy as jnp
+
+    print("platform:", jax.default_backend(), flush=True)
+
+    from paralleljohnson_tpu.backends import get_backend
+    from paralleljohnson_tpu.backends.jax_backend import (
+        _edge_chunk_for, _fanout_vm_kernel,
+    )
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.graphs import rmat
+
+    for s in (10, 13):
+        if s >= scale:
+            break
+        gw = rmat(s, 16, seed=42)
+        be = get_backend("jax", SolverConfig(dense_threshold=0))
+        dg = be.upload(gw)
+        be.multi_source(dg, np.arange(8, dtype=np.int64))
+        print(f"warm {s} ok", flush=True)
+
+    g = rmat(scale, 16, seed=42)
+    rng = np.random.default_rng(0)
+    sources = jnp.asarray(
+        np.sort(rng.choice(g.num_nodes, size=B, replace=False)), jnp.int32
+    )
+    V = g.num_nodes
+    be = get_backend("jax", SolverConfig())
+    dg = be.upload(g)
+    src_bd, dst_bd, w_bd = dg.by_dst()
+    chunk = _edge_chunk_for(B, dg.src.shape[0])
+    print(f"V={V} E={g.num_real_edges} B={B} edge_chunk={chunk}", flush=True)
+
+    def run():
+        return _fanout_vm_kernel(
+            sources, src_bd, dst_bd, w_bd,
+            num_nodes=V, max_iter=V, edge_chunk=chunk,
+        )
+
+    out = run()
+    jax.block_until_ready(out)
+    for tag in ("device-only", "device-only2"):
+        t0 = time.perf_counter()
+        out = run()
+        jax.block_until_ready(out)
+        print(f"{tag}: {time.perf_counter()-t0:.3f}s iters={int(out[1])}",
+              flush=True)
+
+    t0 = time.perf_counter()
+    host = np.asarray(out[0])
+    print(f"download [B,V] {host.nbytes/1e6:.0f}MB: "
+          f"{time.perf_counter()-t0:.3f}s", flush=True)
+
+    # chunk-size sensitivity: one-chunk vs two-chunk scan
+    for ch in (1 << 20, 524288, 262144):
+        def run_c():
+            return _fanout_vm_kernel(
+                sources, src_bd, dst_bd, w_bd,
+                num_nodes=V, max_iter=V, edge_chunk=ch,
+            )
+        out = run_c()
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = run_c()
+        jax.block_until_ready(out)
+        print(f"edge_chunk={ch}: {time.perf_counter()-t0:.3f}s", flush=True)
+    print("done", flush=True)
