@@ -16,6 +16,7 @@ from paralleljohnson_tpu.solver import (
     ConvergenceError,
     NegativeCycleError,
     ParallelJohnsonSolver,
+    ReducedResult,
     SolveResult,
     ValidationError,
 )
@@ -33,6 +34,7 @@ __all__ = [
     "NegativeCycleError",
     "ValidationError",
     "ParallelJohnsonSolver",
+    "ReducedResult",
     "SolveResult",
     "SolverConfig",
     "available_backends",

@@ -26,6 +26,8 @@ from typing import Callable
 
 import numpy as np
 
+from paralleljohnson_tpu.utils.reductions import finite_frac as _finite_frac
+
 
 @dataclasses.dataclass
 class BenchRecord:
@@ -79,27 +81,6 @@ def _platform() -> str:
     import jax
 
     return jax.default_backend()
-
-
-def _finite_frac(dist) -> float:
-    """Fraction of finite entries, reduced where the rows live — device
-    rows reduce on device (a scale-20 row block is ~0.5 GB; np.isfinite
-    would download it through the host tunnel first)."""
-    if isinstance(dist, np.ndarray):
-        return float(np.isfinite(dist).mean())
-    import jax.numpy as jnp
-
-    return float(jnp.isfinite(dist).mean())
-
-
-def _finite_checksum(dist) -> float:
-    """Sum of finite entries (the streamed-rows reduction of the RMAT
-    config), computed where the rows live."""
-    if isinstance(dist, np.ndarray):
-        return float(np.where(np.isfinite(dist), dist, 0.0).sum())
-    import jax.numpy as jnp
-
-    return float(jnp.where(jnp.isfinite(dist), dist, 0.0).sum())
 
 
 def _solver(backend: str, **cfg_overrides):
@@ -197,11 +178,11 @@ def bench_rmat_apsp(backend: str, preset: str) -> BenchRecord:
     sources = np.sort(rng.choice(g.num_nodes, size=n_sources, replace=False))
     solver = _solver(backend)
     small = sources[: max(2, n_sources // 8)]
-    solver.solve(g, sources=small)  # warm at reduced batch
+    solver.solve_reduced(g, sources=small, reduce_rows="checksum")  # warm
     t0 = time.perf_counter()
-    res = solver.solve(g, sources=sources)
+    res = solver.solve_reduced(g, sources=sources, reduce_rows="checksum")
     wall = time.perf_counter() - t0
-    checksum = _finite_checksum(res.dist)
+    checksum = float(sum(res.values))
     return BenchRecord(
         "rmat_apsp", backend, preset, wall,
         res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),

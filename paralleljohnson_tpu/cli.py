@@ -84,9 +84,9 @@ def _report(res, args) -> None:
         log_stats(res.stats, label=args.command)
     # Device-aware reduction: np.isfinite on a device-resident dist would
     # download the whole matrix just to print one fraction.
-    from paralleljohnson_tpu.benchmarks import _finite_frac
+    from paralleljohnson_tpu.utils.reductions import finite_frac
 
-    finite = _finite_frac(res.dist)
+    finite = finite_frac(res.dist)
     payload = {
         "shape": list(res.dist.shape),
         "finite_fraction": round(finite, 6),

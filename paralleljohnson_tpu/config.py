@@ -28,11 +28,17 @@ class SolverConfig:
         fan-out.
       max_iterations: cap on relaxation sweeps; ``None`` = |V| (the
         Bellman-Ford bound).
-      dense_threshold: graphs with V <= threshold use the dense min-plus
-        (MXU-friendly) path instead of the sparse CSR sweep. Precedence:
-        a multi-device mesh routes the fan-out to the sharded sparse path
-        regardless — the dense path is single-chip; set mesh_shape=(1,)
-        to force it on a multi-device host.
+      dense_threshold: graphs with V <= threshold are ELIGIBLE for the
+        dense min-plus path instead of the sparse CSR sweep; the graph
+        must also actually be dense (see ``dense_min_density``).
+        Precedence: a multi-device mesh routes the fan-out to the sharded
+        sparse path regardless — the dense path is single-chip; set
+        mesh_shape=(1,) to force it on a multi-device host.
+      dense_min_density: minimum E/V^2 for the dense path (default 1/16:
+        per sweep dense does B x V^2 work vs sparse B x E, and dense's
+        regularity advantage measures ~an order of magnitude, so below
+        V^2/16 edges the sparse path wins even on small graphs). 0 makes
+        ``dense_threshold`` alone decide (tests).
       edge_pad_multiple: pad E to this multiple for stable jit shapes.
       use_pallas: ``"auto"`` (Pallas dense kernels on TPU, XLA elsewhere),
         ``True`` (force, interpret-mode off-TPU — tests), or ``False``.
@@ -62,6 +68,7 @@ class SolverConfig:
     mesh_shape: tuple[int, ...] | None = None
     max_iterations: int | None = None
     dense_threshold: int = 1024
+    dense_min_density: float = 1.0 / 16.0
     edge_pad_multiple: int = 512
     use_pallas: bool | str = "auto"
     fanout_layout: str = "auto"

@@ -135,11 +135,26 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-# -- sparse frontier sweep ---------------------------------------------------
+# -- on-chip measurements (real v5e, 2026-07-29) -----------------------------
 #
-# The CSR edge sweep (gather on src, scatter-min on dst) stays on the XLA
-# path (ops.relax.relax_sweep): arbitrary-index scatter inside a Pallas TPU
-# kernel serializes on the VPU lane permute network and loses to XLA's
-# deterministic segment_min lowering. Profiling note kept here so the
-# decision is revisitable (SURVEY.md §7 "only move the inner loop to Pallas
-# where profiling shows wins").
+# Dense min-plus, V=2048 (sparse adjacency, 1% density): this Pallas kernel
+# 88.3 ms vs the XLA blocked formulation 77.3 ms — both ~0.2 Tops/s, far
+# from VPU peak, because a tropical product is transpose-bound (the d
+# operand's k axis must move lanes->sublanes every sub-slab; the MXU cannot
+# help, see module docstring). At the sizes the dense path actually serves
+# (V <= dense_threshold = 1024) both impls are dispatch-bound and at
+# parity, so ``use_pallas="auto"`` keeps this kernel on TPU (the
+# explicit-VMEM tier stays a product path); it now actually compiles
+# on-chip (see _minplus_kernel docstring for the two Mosaic constraints
+# CI's interpret-mode never surfaced).
+#
+# Sparse sweep pieces, rmat16 (V=65536, E=955171, B=128 rows): one
+# vertex-major sweep 77.7 ms isolated / ~19 ms amortized inside the
+# while_loop (XLA overlaps sweeps); row gather d[src, :] 67.7 ms; sorted
+# segment_min 33.1 ms; unsorted 39.3 ms; the full 9-sweep fan-out 0.17 s
+# device-side. The CSR sweep therefore stays on the XLA path: the gather,
+# not the scatter/segment reduction, is the cost center, and a Pallas
+# variant would have to beat XLA's HBM row-gather pipeline, not its
+# scatter. Revisit with a block-bucketed (src-block, dst-block) edge
+# layout if the fan-out ever dominates again (SURVEY.md §7 "only move the
+# inner loop to Pallas where profiling shows wins").

@@ -184,13 +184,24 @@ def sharded_fanout(
         out = (d[:b], iters, improving.astype(bool))
     if with_row_sweeps:
         # Exact, overflow-free accounting in Python ints: each shard's
-        # sweep count x its REAL row count. Padding rows (locally added
-        # and/or the caller's pre-padded tail, ``acct_pad`` total) sit at
-        # the TAIL and may span several shards (11 rows on 8 devices ->
-        # per_shard 2, pad 5 across shards 5-7), so clip per shard.
+        # sweep count x its REAL row count (an int32 product on device
+        # could wrap). Padding rows (locally added and/or the caller's
+        # pre-padded tail, ``acct_pad`` total) sit at the TAIL and may
+        # span several shards (11 rows on 8 devices -> per_shard 2, pad 5
+        # across shards 5-7), so clip per shard.
         per_shard = (b + pad) // n
         b_real = b + pad - acct_pad
-        shard_iters = np.asarray(iters_vec)
+        if iters_vec.is_fully_addressable:
+            shard_iters = np.asarray(iters_vec)
+        else:
+            # Multi-process: shards of the P("sources") vector live on
+            # other hosts; allgather the (tiny, [n]) vector so every host
+            # computes the same exact total.
+            from jax.experimental import multihost_utils
+
+            shard_iters = np.asarray(
+                multihost_utils.process_allgather(iters_vec, tiled=True)
+            )
         row_sweeps = sum(
             int(shard_iters[i])
             * max(0, min(per_shard, b_real - i * per_shard))

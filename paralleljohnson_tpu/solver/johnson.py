@@ -26,6 +26,7 @@ from paralleljohnson_tpu.backends import Backend, get_backend
 from paralleljohnson_tpu.config import SolverConfig
 from paralleljohnson_tpu.graphs import CSRGraph, stack_graphs
 from paralleljohnson_tpu.utils.metrics import SolverStats, phase_timer
+from paralleljohnson_tpu.utils.reductions import finite_checksum, xp as _xp
 
 
 class NegativeCycleError(ValueError):
@@ -91,6 +92,51 @@ class SolveResult:
         )
 
 
+@dataclasses.dataclass
+class ReducedResult:
+    """Result of :meth:`ParallelJohnsonSolver.solve_reduced` — per-batch
+    reduction values instead of distance rows (streaming mode)."""
+
+    values: list
+    sources: np.ndarray
+    potentials: Any
+    stats: "SolverStats"
+
+
+def _reduce_checksum(rows, batch):
+    return finite_checksum(rows)
+
+
+def _reduce_eccentricity(rows, batch):
+    xp = _xp(rows)
+    return np.asarray(xp.max(xp.where(xp.isfinite(rows), rows, -xp.inf), axis=1))
+
+
+def _reduce_reach_count(rows, batch):
+    xp = _xp(rows)
+    return np.asarray(xp.isfinite(rows).sum(axis=1))
+
+
+def _unreweight(rows, h, row_sources):
+    """Phase-3 arithmetic d(u,v) = d'(u,v) - h(u) + h(v), in the namespace
+    where ``rows`` live: device h against host rows (the checkpointed /
+    multi-batch path) would silently promote the whole matrix back onto
+    the device. +inf - h + h stays +inf by IEEE inf arithmetic (h is
+    always finite: the virtual source reaches every vertex).
+    Single source of truth for solve() and solve_reduced().
+
+    """
+    hh = np.asarray(h) if isinstance(rows, np.ndarray) else h
+    return rows - hh[row_sources][:, None] + hh[None, :]
+
+
+_ROW_REDUCERS = {
+    "checksum": _reduce_checksum,
+    "eccentricity": _reduce_eccentricity,
+    "reach_count": _reduce_reach_count,
+}
+
+
 class ParallelJohnsonSolver:
     """Orchestrates Johnson's algorithm over a pluggable backend."""
 
@@ -128,29 +174,7 @@ class ParallelJohnsonSolver:
         with phase_timer(stats, "upload"):
             dgraph = self.backend.upload(graph)
 
-        # Phase 1 — potentials. Skipped when no negative weights exist:
-        # h = 0 is already valid and the fan-out can run directly.
-        if graph.has_negative_weights:
-            with phase_timer(stats, "bellman_ford"):
-                bf = self.backend.bellman_ford(dgraph, source=None)
-            stats.accumulate(bf, phase="bellman_ford")
-            if bf.negative_cycle:
-                raise NegativeCycleError(
-                    "negative-weight cycle detected during reweighting"
-                )
-            if not bf.converged:
-                raise ConvergenceError(
-                    "Bellman-Ford hit max_iterations while still improving; "
-                    "raise SolverConfig.max_iterations (or leave it None)"
-                )
-            # Keep potentials on the backend's device (a [V] row is 16 MB at
-            # RMAT-22); reweight and phase-3 arithmetic both consume them
-            # in place, and np.asarray materializes on demand elsewhere.
-            h = bf.dist
-            with phase_timer(stats, "reweight"):
-                dgraph = self.backend.reweight(dgraph, h)
-        else:
-            h = np.zeros(v, graph.dtype)
+        h, dgraph = self._potentials(graph, dgraph, stats)
 
         # Phase 2 — batched fan-out over sources.
         with phase_timer(stats, "fanout"):
@@ -161,20 +185,67 @@ class ParallelJohnsonSolver:
         # Phase 3 — un-reweight: d(u,v) = d'(u,v) - h(u) + h(v).
         with phase_timer(stats, "unreweight"):
             if graph.has_negative_weights:
-                # Where dist lives wins: device h against host rows (the
-                # checkpointed / multi-batch path) would silently promote
-                # the whole matrix back onto the device.
-                hh = np.asarray(h) if isinstance(dist, np.ndarray) else h
-                dist = dist - hh[sources][:, None] + hh[None, :]
-                # +inf - h + h must stay +inf; inf arithmetic already
-                # guarantees that, but mask anyway against inf-inf NaNs
-                # if h itself has +inf (unreachable-from-virtual never
-                # happens: virtual source reaches everything).
+                dist = _unreweight(dist, h, sources)
         result = SolveResult(dist=dist, sources=sources, potentials=h,
                              stats=stats, predecessors=pred)
         if self.config.validate:
             self._validate(graph, result)
         return result
+
+    def solve_reduced(
+        self,
+        graph: CSRGraph,
+        sources: np.ndarray | None = None,
+        *,
+        reduce_rows,
+    ) -> "ReducedResult":
+        """Johnson APSP with per-batch on-device row reduction — the
+        streaming mode the attested RMAT-22 config requires (SURVEY.md §7:
+        a scale-22 distance matrix is ~70 PB; rows must be reduced or
+        streamed, never stored).
+
+        ``reduce_rows(dist_rows, batch_sources)`` is called once per source
+        batch with the UN-REWEIGHTED distance rows exactly as ``solve``
+        would return them — still resident on the backend's device for
+        device backends, so reductions written with jnp run on-chip and
+        only their (small) results ever reach the host. Built-in names:
+        ``"checksum"`` (sum of finite entries, float), ``"eccentricity"``
+        ([B] max finite distance per source), ``"reach_count"`` ([B]
+        finite entries per row).
+
+        Returns :class:`ReducedResult` with ``values`` = the per-batch
+        reduction results in batch order. Negative-cycle/convergence
+        semantics match :meth:`solve`; checkpointing is not applied (the
+        point of this mode is that rows are never materialized).
+        """
+        if isinstance(reduce_rows, str):
+            reduce_rows = _ROW_REDUCERS[reduce_rows]
+        stats = SolverStats()
+        v = graph.num_nodes
+        sources = (
+            np.arange(v, dtype=np.int64)
+            if sources is None
+            else np.asarray(sources, np.int64)
+        )
+        with phase_timer(stats, "upload"):
+            dgraph = self.backend.upload(graph)
+        h, dgraph = self._potentials(graph, dgraph, stats)
+        values = []
+        with phase_timer(stats, "fanout"):
+            for batch in self._source_batches(sources, dgraph):
+                res = self.backend.multi_source(dgraph, batch)
+                stats.accumulate(res, phase="fanout")
+                if not res.converged:
+                    raise ConvergenceError(
+                        "fan-out hit max_iterations while still improving"
+                    )
+                rows = res.dist
+                if graph.has_negative_weights:
+                    rows = _unreweight(rows, h, batch)
+                values.append(reduce_rows(rows, batch))
+        return ReducedResult(
+            values=values, sources=sources, potentials=h, stats=stats
+        )
 
     def sssp(
         self, graph: CSRGraph, source: int, *, predecessors: bool = False
@@ -261,6 +332,30 @@ class ParallelJohnsonSolver:
         return out
 
     # -- internals ----------------------------------------------------------
+
+    def _potentials(self, graph: CSRGraph, dgraph: Any, stats: SolverStats):
+        """Phase 1 + reweight: returns (h, reweighted dgraph). h stays on
+        the backend's device (a [V] row is 16 MB at RMAT-22); phase-3
+        arithmetic consumes it in place and np.asarray materializes on
+        demand. No negative weights -> h = 0 is already valid, skip."""
+        if not graph.has_negative_weights:
+            return np.zeros(graph.num_nodes, graph.dtype), dgraph
+        with phase_timer(stats, "bellman_ford"):
+            bf = self.backend.bellman_ford(dgraph, source=None)
+        stats.accumulate(bf, phase="bellman_ford")
+        if bf.negative_cycle:
+            raise NegativeCycleError(
+                "negative-weight cycle detected during reweighting"
+            )
+        if not bf.converged:
+            raise ConvergenceError(
+                "Bellman-Ford hit max_iterations while still improving; "
+                "raise SolverConfig.max_iterations (or leave it None)"
+            )
+        h = bf.dist
+        with phase_timer(stats, "reweight"):
+            dgraph = self.backend.reweight(dgraph, h)
+        return h, dgraph
 
     def _source_batches(
         self, sources: np.ndarray, dgraph: Any = None

@@ -1,0 +1,33 @@
+"""Device-aware array reductions, shared by solver / CLI / benchmarks.
+
+Rows from device backends stay resident on device (SURVEY.md §7: RMAT-22
+rows must never be forced to host wholesale); every reduction here runs in
+the namespace where the rows live, so reducing a device-resident [B, V]
+block moves only the (small) result to the host.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def xp(rows):
+    """numpy for host arrays, jax.numpy for device arrays."""
+    if isinstance(rows, np.ndarray):
+        return np
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def finite_frac(rows) -> float:
+    """Fraction of finite entries."""
+    m = xp(rows)
+    return float(m.isfinite(rows).mean())
+
+
+def finite_checksum(rows) -> float:
+    """Sum of finite entries (the streamed-rows reduction of the RMAT
+    benchmark config)."""
+    m = xp(rows)
+    return float(m.where(m.isfinite(rows), rows, 0.0).sum())

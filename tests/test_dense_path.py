@@ -20,7 +20,9 @@ def solve(g, sources=None, **kw):
 
 def test_dense_equals_sparse_full_apsp():
     g = random_dag(60, 0.1, negative_fraction=0.4, seed=31)
-    dense = solve(g, dense_threshold=1024).matrix
+    # dense_min_density=0: force the dense path for a graph below the
+    # default density gate, so the equivalence is actually exercised.
+    dense = solve(g, dense_threshold=1024, dense_min_density=0).matrix
     sparse = solve(g, dense_threshold=0).matrix
     np.testing.assert_allclose(dense, sparse, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(dense, oracle_apsp(g), rtol=1e-4, atol=1e-4)

@@ -1,0 +1,76 @@
+"""Device-residency contract of solver results (SURVEY.md §7 "RMAT-22
+output size": rows stream / stay on device, never forced to host
+wholesale).
+
+On the CPU-mesh test platform jax arrays are still device arrays, so the
+`np.ndarray` vs `jax.Array` distinction is fully testable here.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import random_dag
+from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+
+@pytest.fixture(scope="module")
+def neg_graph():
+    # DAG: negative weights without negative cycles.
+    return random_dag(120, 0.05, negative_fraction=0.3, seed=11)
+
+
+def _solve(graph, **cfg):
+    return ParallelJohnsonSolver(SolverConfig(backend="jax", **cfg)).solve(graph)
+
+
+def test_single_batch_rows_stay_on_device(neg_graph):
+    res = _solve(neg_graph)
+    assert isinstance(res.dist, jax.Array)
+    # potentials came from the device Bellman-Ford pass
+    assert isinstance(res.potentials, jax.Array)
+    # and np.asarray materializes a host copy on demand
+    host = np.asarray(res.dist)
+    assert isinstance(host, np.ndarray) and host.shape[0] == neg_graph.num_nodes
+
+
+def test_multi_batch_rows_stream_to_host(neg_graph):
+    # Batching exists because all rows together exceed the device budget —
+    # accumulating device buffers across batches would defeat it.
+    res = _solve(neg_graph, source_batch_size=48)
+    assert isinstance(res.dist, np.ndarray)
+
+
+def test_checkpointed_rows_are_host_side(neg_graph, tmp_path):
+    res = _solve(neg_graph, checkpoint_dir=str(tmp_path), source_batch_size=48)
+    assert isinstance(res.dist, np.ndarray)
+    resumed = _solve(neg_graph, checkpoint_dir=str(tmp_path),
+                     source_batch_size=48)
+    assert resumed.stats.batches_resumed > 0
+    assert isinstance(resumed.dist, np.ndarray)
+    np.testing.assert_allclose(res.dist, resumed.dist)
+
+
+def test_unreweight_matches_oracle_in_both_residencies(neg_graph):
+    # The phase-3 arithmetic must not silently promote host rows back to
+    # device (or corrupt either path): both must equal the numpy oracle.
+    dev = _solve(neg_graph)
+    host = _solve(neg_graph, source_batch_size=48)
+    oracle = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(neg_graph)
+    np.testing.assert_allclose(np.asarray(dev.dist), oracle.dist,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(host.dist, oracle.dist, rtol=1e-4, atol=1e-4)
+
+
+def test_sssp_row_on_device_and_path_walk(neg_graph):
+    solver = ParallelJohnsonSolver(SolverConfig(backend="jax"))
+    res = solver.sssp(neg_graph, 0, predecessors=True)
+    assert isinstance(res.dist, jax.Array)
+    # path() must materialize the pred row once and return host ints
+    finite = np.flatnonzero(np.isfinite(np.asarray(res.dist)[0]))
+    target = int(finite[-1])
+    path = res.path(0, target)
+    assert path == [] or (path[0] == 0 and path[-1] == target)
+    assert all(isinstance(v, int) for v in path)

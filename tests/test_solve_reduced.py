@@ -1,0 +1,73 @@
+"""Streaming row-reduction mode (SURVEY.md §7 "RMAT-22 output size":
+reduce rows on device, never materialize the matrix)."""
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import random_dag, rmat
+from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+
+@pytest.fixture(scope="module", params=["jax", "numpy"])
+def solver(request):
+    return ParallelJohnsonSolver(SolverConfig(backend=request.param))
+
+
+def _oracle_checksum(solver, g, sources):
+    res = solver.solve(g, sources=sources)
+    d = np.asarray(res.dist)
+    return float(np.where(np.isfinite(d), d, 0.0).sum())
+
+
+def test_checksum_matches_solve(solver):
+    g = random_dag(150, 0.04, negative_fraction=0.3, seed=3)
+    sources = np.arange(0, 150, 3)
+    red = solver.solve_reduced(g, sources=sources, reduce_rows="checksum")
+    assert len(red.values) >= 1
+    np.testing.assert_allclose(
+        sum(red.values), _oracle_checksum(solver, g, sources), rtol=1e-4
+    )
+
+
+def test_multi_batch_streaming(solver):
+    g = rmat(8, 8, seed=1)  # non-negative: no reweighting
+    sources = np.arange(64)
+    cfg = SolverConfig(backend=solver.config.backend, source_batch_size=20)
+    s2 = ParallelJohnsonSolver(cfg)
+    red = s2.solve_reduced(g, sources=sources, reduce_rows="checksum")
+    assert len(red.values) == 4  # ceil(64 / 20)
+    np.testing.assert_allclose(
+        sum(red.values), _oracle_checksum(solver, g, sources), rtol=1e-4
+    )
+
+
+def test_vector_reducers(solver):
+    g = rmat(7, 8, seed=2)
+    sources = np.arange(32)
+    ecc = solver.solve_reduced(g, sources=sources, reduce_rows="eccentricity")
+    reach = solver.solve_reduced(g, sources=sources, reduce_rows="reach_count")
+    ecc_all = np.concatenate(ecc.values)
+    reach_all = np.concatenate(reach.values)
+    assert ecc_all.shape == (32,) and reach_all.shape == (32,)
+    d = np.asarray(solver.solve(g, sources=sources).dist)
+    np.testing.assert_allclose(
+        reach_all, np.isfinite(d).sum(axis=1)
+    )
+    finite_max = np.max(np.where(np.isfinite(d), d, -np.inf), axis=1)
+    np.testing.assert_allclose(ecc_all, finite_max, rtol=1e-5)
+
+
+def test_custom_callable_reducer():
+    g = rmat(7, 8, seed=4)
+    solver = ParallelJohnsonSolver(SolverConfig(backend="jax"))
+    seen = []
+
+    def spy(rows, batch):
+        seen.append((type(rows).__name__, len(batch)))
+        return 0
+
+    solver.solve_reduced(g, sources=np.arange(16), reduce_rows=spy)
+    assert seen and seen[0][1] == 16
+    # rows reached the reducer as a device array, not a host copy
+    assert seen[0][0] != "ndarray"
