@@ -22,7 +22,13 @@ budget for nothing.
 
 Env knobs: PJ_BENCH_SCALE (default 16), PJ_BENCH_SOURCES (128),
 PJ_BENCH_REPEATS (3), PJ_BENCH_DEVICE_TIMEOUT (total seconds, 1500),
-PJ_BENCH_STAGE_TIMEOUT (per-stage seconds, 600).
+PJ_BENCH_STAGE_TIMEOUT (per-stage seconds, 600),
+PJ_BENCH_FIRST_STAGE_TIMEOUT (seconds until the first heartbeat, 180 —
+a healthy tunnel answers jax.devices() in seconds, so a wedged one
+should fail fast instead of eating the whole budget),
+PJ_BENCH_CPU_SCALE (fallback graph scale, 13 — the CPU fallback must
+finish within the driver's budget even on a 1-core container; the
+metric tag records the actual scale run).
 """
 
 from __future__ import annotations
@@ -169,10 +175,13 @@ def _graceful_stop(p: subprocess.Popen) -> None:
 def _tpu_attempt(
     scale: int, n_sources: int, repeats: int,
     total_timeout: float, stage_timeout: float,
+    first_stage_timeout: float | None = None,
     _cmd: list[str] | None = None,
 ) -> dict | None:
     """Run the child, watching STAGE heartbeats. Returns the measured dict,
     or None on timeout/failure (with ``_clean_failure`` noted for retry).
+    ``first_stage_timeout`` bounds the wait for the FIRST heartbeat (device
+    init — seconds when healthy, forever when the tunnel is wedged).
     ``_cmd`` overrides the child command line (watchdog tests)."""
     cmd = _cmd or [
         sys.executable, os.path.abspath(__file__), "--device-inner",
@@ -186,7 +195,7 @@ def _tpu_attempt(
     )
     fd = p.stdout.fileno()
     deadline = time.monotonic() + total_timeout
-    stage_deadline = time.monotonic() + stage_timeout
+    stage_deadline = time.monotonic() + (first_stage_timeout or stage_timeout)
     measured = None
     timed_out = False
     buf = b""
@@ -244,6 +253,12 @@ def main() -> None:
     repeats = int(os.environ.get("PJ_BENCH_REPEATS", "1" if smoke else "3"))
     total_timeout = float(os.environ.get("PJ_BENCH_DEVICE_TIMEOUT", "1500"))
     stage_timeout = float(os.environ.get("PJ_BENCH_STAGE_TIMEOUT", "600"))
+    first_stage_timeout = float(
+        os.environ.get("PJ_BENCH_FIRST_STAGE_TIMEOUT", "180")
+    )
+    cpu_scale = min(
+        scale, int(os.environ.get("PJ_BENCH_CPU_SCALE", "13"))
+    )
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from paralleljohnson_tpu.utils.platform import honor_cpu_platform_request
@@ -255,13 +270,15 @@ def main() -> None:
         return
 
     measured = _tpu_attempt(
-        scale, n_sources, repeats, total_timeout, stage_timeout
+        scale, n_sources, repeats, total_timeout, stage_timeout,
+        first_stage_timeout,
     )
     if measured is not None and measured.get("_clean_failure"):
         print("WARNING: TPU child crashed cleanly; retrying once",
               file=sys.stderr)
         measured = _tpu_attempt(
-            scale, n_sources, repeats, total_timeout, stage_timeout
+            scale, n_sources, repeats, total_timeout, stage_timeout,
+            first_stage_timeout,
         )
         if measured is not None and measured.get("_clean_failure"):
             measured = None
@@ -269,16 +286,21 @@ def main() -> None:
         _emit(measured, tag)
         return
 
+    # CPU fallback at a CPU-feasible scale: the full scale-16 config on a
+    # 1-core container would blow the driver's budget and leave NO metric
+    # at all; the tag records the scale actually run, so the number stays
+    # honest and comparable to nothing it isn't.
     print(
-        "WARNING: TPU attempt failed; falling back to CPU (metric renamed)",
+        "WARNING: TPU attempt failed; falling back to CPU "
+        f"(scale {scale} -> {cpu_scale}, metric renamed)",
         file=sys.stderr,
     )
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    _emit(_run_config(scale, n_sources, repeats, ramp=False),
-          tag + ",cpu-fallback")
+    cpu_tag = f"rmat{cpu_scale}x{n_sources}src,cpu-fallback"
+    _emit(_run_config(cpu_scale, n_sources, repeats, ramp=False), cpu_tag)
 
 
 if __name__ == "__main__":

@@ -163,15 +163,19 @@ def bench_ego_fb_nsource(backend: str, preset: str) -> BenchRecord:
 def bench_rmat_apsp(backend: str, preset: str) -> BenchRecord:
     """Config 4 (BASELINE.json:10): Johnson APSP on R-MAT (full: scale 20;
     scale 22 via PJ_BENCH_RMAT_SCALE). The full distance matrix is not
-    materializable at scale 22 (~70 PB, SURVEY.md §7); per the attested
+    materializable at scale 22 (~70 TB, SURVEY.md §7); per the attested
     metric the harness solves a source subset and reduces rows to a
     checksum — rows stream through, never accumulate."""
     import os
 
     from paralleljohnson_tpu.graphs import rmat
 
-    scale = int(os.environ.get("PJ_BENCH_RMAT_SCALE", 0)) or _sz(
-        "rmat_apsp", "scale", preset)
+    default_scale = _sz("rmat_apsp", "scale", preset)
+    scale = int(os.environ.get("PJ_BENCH_RMAT_SCALE", 0)) or default_scale
+    # A non-default scale gets its own row name so e.g. the RMAT-22 run
+    # never overwrites the scale-20 row in BASELINE.md (rows merge by
+    # (config, backend, preset)).
+    name = "rmat_apsp" if scale == default_scale else f"rmat_apsp_s{scale}"
     n_sources = _sz("rmat_apsp", "sources", preset)
     g = rmat(scale, 16, seed=42)
     rng = np.random.default_rng(1)
@@ -184,7 +188,7 @@ def bench_rmat_apsp(backend: str, preset: str) -> BenchRecord:
     wall = time.perf_counter() - t0
     checksum = float(sum(res.values))
     return BenchRecord(
-        "rmat_apsp", backend, preset, wall,
+        name, backend, preset, wall,
         res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
         {"scale": scale, "nodes": g.num_nodes, "edges": g.num_real_edges,
          "sources": n_sources, "rows_checksum": checksum},
@@ -200,14 +204,42 @@ def bench_batch_small(backend: str, preset: str) -> BenchRecord:
     nodes = 64 if preset == "smoke" else 256
     graphs = random_graph_batch(count, nodes, 8.0 / nodes, seed=0)
     solver = _solver(backend)
-    solver.solve_batch(graphs[: max(2, count // 16)])  # warm
-    t0 = time.perf_counter()
-    results = solver.solve_batch(graphs)
-    wall = time.perf_counter() - t0
-    # The vectorized path shares ONE stats object across results; the
-    # per-graph fallback (backends without batch_apsp) gives each result
-    # its own — sum over distinct objects so both report the whole batch.
-    edges = sum(s.edges_relaxed for s in {id(r.stats): r.stats for r in results}.values())
+    try:
+        # Time the vectorized batch kernel itself, with results left where
+        # the backend computed them (the [count, V, V] block is ~2.6 GB at
+        # the full preset — downloading it is not part of the solve).
+        # Completion is guaranteed by the iteration-count sync inside
+        # batch_apsp plus an explicit block on device arrays.
+        from paralleljohnson_tpu.graphs import stack_graphs
+
+        batch = stack_graphs(graphs)
+        if backend == "jax":
+            # Full-shape warm: the jit cache is shape-keyed. Host backends
+            # have no compile cache — a full warm would just double the
+            # (minutes-long at the full preset) run for nothing.
+            solver.backend.batch_apsp(batch)
+        else:
+            solver.backend.batch_apsp(stack_graphs(graphs[: max(2, count // 16)]))
+        t0 = time.perf_counter()
+        res = solver.backend.batch_apsp(batch)
+        if not isinstance(res.dist, np.ndarray):
+            import jax
+
+            jax.block_until_ready(res.dist)
+        wall = time.perf_counter() - t0
+        edges = res.edges_relaxed
+    except NotImplementedError:
+        # Backends without a vectorized path: time the per-graph fallback.
+        solver.solve_batch(graphs[: max(2, count // 16)])  # warm
+        t0 = time.perf_counter()
+        results = solver.solve_batch(graphs)
+        wall = time.perf_counter() - t0
+        # The per-graph fallback gives each result its own stats object;
+        # sum over distinct objects to report the whole batch.
+        edges = sum(
+            s.edges_relaxed
+            for s in {id(r.stats): r.stats for r in results}.values()
+        )
     return BenchRecord(
         "batch_small", backend, preset, wall,
         edges, edges / wall, _n_chips(),

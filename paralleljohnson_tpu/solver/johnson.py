@@ -201,7 +201,7 @@ class ParallelJohnsonSolver:
     ) -> "ReducedResult":
         """Johnson APSP with per-batch on-device row reduction — the
         streaming mode the attested RMAT-22 config requires (SURVEY.md §7:
-        a scale-22 distance matrix is ~70 PB; rows must be reduced or
+        a scale-22 distance matrix is ~70 TB; rows must be reduced or
         streamed, never stored).
 
         ``reduce_rows(dist_rows, batch_sources)`` is called once per source
@@ -219,7 +219,13 @@ class ParallelJohnsonSolver:
         point of this mode is that rows are never materialized).
         """
         if isinstance(reduce_rows, str):
-            reduce_rows = _ROW_REDUCERS[reduce_rows]
+            try:
+                reduce_rows = _ROW_REDUCERS[reduce_rows]
+            except KeyError:
+                raise ValueError(
+                    f"unknown reducer {reduce_rows!r}; expected one of "
+                    f"{sorted(_ROW_REDUCERS)} or a callable"
+                ) from None
         stats = SolverStats()
         v = graph.num_nodes
         sources = (

@@ -1,0 +1,44 @@
+#!/bin/bash
+# On-chip measurement pass (run when the device tunnel is healthy).
+# Each stage is independently timeboxed so one wedge doesn't eat the rest;
+# BASELINE.md rows merge per (config, backend, preset) — TPU rows replace
+# the CPU-labeled placeholders.
+set -u
+cd "$(dirname "$0")/.."
+unset JAX_PLATFORMS XLA_FLAGS
+LOG=${1:-/tmp/tpu_full_run.log}
+: > "$LOG"
+
+run() {  # run <seconds> <label> <cmd...>
+  local t=$1 label=$2; shift 2
+  echo "=== $label ===" | tee -a "$LOG"
+  timeout --signal=TERM --kill-after=30 "$t" "$@" 2>&1 | grep -v WARNING | tail -6 | tee -a "$LOG"
+  echo "--- rc=$? ---" | tee -a "$LOG"
+}
+
+# 0) probe
+run 120 probe python -c "import jax,numpy as np; print('probe', int(jax.jit(lambda x:x+1)(np.int32(1))))" || exit 1
+
+# 1) driver metric
+run 1200 bench.py python bench.py
+
+# 2) full-preset jax rows on TPU (light configs first, then the heavy two)
+run 1800 jax-full-light python -m paralleljohnson_tpu.cli bench er1k_apsp dimacs_ny_bf ego_fb_nsource --backend jax --preset full --update-baseline BASELINE.md
+run 2400 jax-full-rmat20 python -m paralleljohnson_tpu.cli bench rmat_apsp --backend jax --preset full --update-baseline BASELINE.md
+run 2400 jax-full-batch python -m paralleljohnson_tpu.cli bench batch_small --backend jax --preset full --update-baseline BASELINE.md
+
+# 3) RMAT-22 streamed (the headline scale)
+PJ_BENCH_RMAT_SCALE=22 run 3000 jax-rmat22 python -m paralleljohnson_tpu.cli bench rmat_apsp --backend jax --preset full --update-baseline BASELINE.md
+
+# 4) grid SSSP frontier timing (VERDICT #4 evidence)
+run 900 grid-timing python scripts/tpu_grid.py
+
+# 5) on-chip profiler traces, one per kernel family (VERDICT #6 artifact)
+mkdir -p bench_artifacts
+run 900 profile-fanout python -m paralleljohnson_tpu.cli solve "rmat:scale=14,efactor=16,seed=42" --num-sources 64 --profile bench_artifacts/trace_fanout --json
+run 900 profile-bf python -m paralleljohnson_tpu.cli sssp "grid:rows=96,cols=96,neg=0.2,seed=7" --source 0 --profile bench_artifacts/trace_bf --json
+
+# 6) edge-chunk tuning sweep
+run 900 chunk-tune python scripts/tpu_micro2.py 16 128
+
+echo "ALL STAGES DONE (log: $LOG)"

@@ -46,13 +46,16 @@ def test_stage_timeout_kills_silent_child():
 def test_heartbeats_extend_stage_deadline():
     """Three 1s stages under a 3s stage timeout but > stage-timeout total
     runtime: heartbeats must keep the watchdog from firing."""
+    # Stage gaps sit well under stage_timeout even on a loaded 1-core
+    # container (child startup alone can take seconds under contention),
+    # while total runtime comfortably exceeds it.
     measured = bench._tpu_attempt(
-        0, 0, 0, total_timeout=60, stage_timeout=3,
+        0, 0, 0, total_timeout=120, stage_timeout=10,
         _cmd=_fake_child(
             "import time\n"
             "for i in range(4):\n"
             "    print(f'STAGE step {i}', flush=True)\n"
-            "    time.sleep(1)\n"
+            "    time.sleep(3)\n"
             "print('RESULT {\"edges_per_sec\": 1.0, \"dt\": 1.0, "
             "\"t_ref\": 1.0, \"oracle_ok\": true}', flush=True)\n"
         ),
@@ -82,3 +85,36 @@ def test_clean_crash_flagged_for_retry():
         _cmd=_fake_child("raise SystemExit(3)"),
     )
     assert measured == {"_clean_failure": True}
+
+
+def test_first_stage_timeout_fails_fast():
+    """A child that never emits its first heartbeat (wedged device init)
+    must be cut off by the tighter first-stage deadline, not the full
+    stage timeout."""
+    import time
+
+    t0 = time.monotonic()
+    measured = bench._tpu_attempt(
+        0, 0, 0, total_timeout=120, stage_timeout=60,
+        first_stage_timeout=5,
+        _cmd=_fake_child("import time; time.sleep(600)"),
+    )
+    assert measured is None
+    assert time.monotonic() - t0 < 45  # far below stage_timeout
+
+
+def test_first_heartbeat_switches_to_stage_timeout():
+    """After the first heartbeat, the normal (longer) stage timeout
+    applies — a slow-but-heartbeating child is not cut off."""
+    measured = bench._tpu_attempt(
+        0, 0, 0, total_timeout=120, stage_timeout=30,
+        first_stage_timeout=8,
+        _cmd=_fake_child(
+            "import time\n"
+            "print('STAGE devices ok', flush=True)\n"
+            "time.sleep(12)\n"  # > first_stage_timeout, < stage_timeout
+            "print('RESULT {\"edges_per_sec\": 1.0, \"dt\": 1.0, "
+            "\"t_ref\": 1.0, \"oracle_ok\": true}', flush=True)\n"
+        ),
+    )
+    assert measured is not None
