@@ -124,6 +124,11 @@ def main(argv: list[str] | None = None) -> int:
                          help="comma-separated source vertices (default: all)")
     p_solve.add_argument("--num-sources", type=int, default=None,
                          help="solve the first K sources only")
+    p_solve.add_argument("--reduce", default=None, metavar="REDUCER",
+                         choices=["checksum", "eccentricity", "reach_count"],
+                         help="streaming mode: reduce each source batch's "
+                              "rows on device instead of materializing the "
+                              "distance matrix (RMAT-22-scale solves)")
     _add_common(p_solve)
 
     p_sssp = sub.add_parser("sssp", help="single-source Bellman-Ford")
@@ -198,6 +203,41 @@ def main(argv: list[str] | None = None) -> int:
                 sources = np.array([int(s) for s in args.sources.split(",")])
             elif args.num_sources is not None:
                 sources = np.arange(args.num_sources)
+            if args.reduce is not None:
+                unsupported = [
+                    flag for flag, on in [
+                        ("--predecessors", args.predecessors),
+                        ("--output", args.output is not None),
+                        ("--validate", args.validate),
+                    ] if on
+                ]
+                if unsupported:
+                    # Reject rather than silently drop: rows are reduced on
+                    # device and never materialized, so there is nothing to
+                    # save or oracle-check.
+                    print(
+                        f"error: --reduce does not support "
+                        f"{', '.join(unsupported)}",
+                        file=sys.stderr,
+                    )
+                    return 1
+                with device_trace(args.profile):
+                    red = ParallelJohnsonSolver(_config(args)).solve_reduced(
+                        g, sources=sources, reduce_rows=args.reduce
+                    )
+                if args.log_stats:
+                    from paralleljohnson_tpu.utils.profiling import log_stats
+
+                    log_stats(red.stats, label="solve--reduce")
+                vals = [
+                    v.tolist() if hasattr(v, "tolist") else v
+                    for v in red.values
+                ]
+                payload = {"reducer": args.reduce, "batches": len(vals),
+                           "values": vals, **red.stats.as_dict()}
+                print(json.dumps(payload) if args.as_json else
+                      f"{args.reduce}: {vals}")
+                return 0
             with device_trace(args.profile):
                 res = ParallelJohnsonSolver(_config(args)).solve(
                     g, sources=sources, predecessors=args.predecessors

@@ -3,17 +3,19 @@
 # Each stage is independently timeboxed so one wedge doesn't eat the rest;
 # BASELINE.md rows merge per (config, backend, preset) — TPU rows replace
 # the CPU-labeled placeholders.
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 unset JAX_PLATFORMS XLA_FLAGS
 LOG=${1:-/tmp/tpu_full_run.log}
 : > "$LOG"
 
-run() {  # run <seconds> <label> <cmd...>
-  local t=$1 label=$2; shift 2
+run() {  # run <seconds> <label> <cmd...>  -> returns the timed command's rc
+  local t=$1 label=$2 rc; shift 2
   echo "=== $label ===" | tee -a "$LOG"
   timeout --signal=TERM --kill-after=30 "$t" "$@" 2>&1 | grep -v WARNING | tail -6 | tee -a "$LOG"
-  echo "--- rc=$? ---" | tee -a "$LOG"
+  rc=${PIPESTATUS[0]}
+  echo "--- rc=$rc ---" | tee -a "$LOG"
+  return "$rc"
 }
 
 # 0) probe
@@ -27,8 +29,12 @@ run 1800 jax-full-light python -m paralleljohnson_tpu.cli bench er1k_apsp dimacs
 run 2400 jax-full-rmat20 python -m paralleljohnson_tpu.cli bench rmat_apsp --backend jax --preset full --update-baseline BASELINE.md
 run 2400 jax-full-batch python -m paralleljohnson_tpu.cli bench batch_small --backend jax --preset full --update-baseline BASELINE.md
 
-# 3) RMAT-22 streamed (the headline scale)
-PJ_BENCH_RMAT_SCALE=22 run 3000 jax-rmat22 python -m paralleljohnson_tpu.cli bench rmat_apsp --backend jax --preset full --update-baseline BASELINE.md
+# 3) RMAT-22 streamed (the headline scale). Subshell: env-prefixing a
+# shell FUNCTION has version-dependent persistence semantics in bash.
+(
+  export PJ_BENCH_RMAT_SCALE=22
+  run 3000 jax-rmat22 python -m paralleljohnson_tpu.cli bench rmat_apsp --backend jax --preset full --update-baseline BASELINE.md
+)
 
 # 4) grid SSSP frontier timing (VERDICT #4 evidence)
 run 900 grid-timing python scripts/tpu_grid.py

@@ -139,3 +139,35 @@ def test_cli_frontier_and_layout_flags(capsys):
     rc = main(["solve", "er:n=32,p=0.1,seed=2", "--fanout-layout",
                "source_major", "--mesh-shape", "1", "--json"])
     assert rc == 0
+
+
+def test_solve_reduce_streaming(capsys):
+    from paralleljohnson_tpu.cli import main
+
+    rc = main(["solve", "er:n=80,p=0.08,seed=2", "--num-sources", "24",
+               "--reduce", "checksum", "--batch-size", "10", "--json"])
+    assert rc == 0
+    import json as _json
+
+    payload = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["reducer"] == "checksum"
+    assert payload["batches"] == 3  # ceil(24 / 10)
+    assert all(isinstance(v, float) for v in payload["values"])
+
+
+def test_solve_reduce_rejects_predecessors(capsys):
+    from paralleljohnson_tpu.cli import main
+
+    rc = main(["solve", "er:n=40,p=0.1,seed=1", "--reduce", "checksum",
+               "--predecessors"])
+    assert rc == 1
+
+
+def test_solve_reduce_rejects_output_and_validate(capsys):
+    from paralleljohnson_tpu.cli import main
+
+    rc = main(["solve", "er:n=40,p=0.1,seed=1", "--reduce", "checksum",
+               "--output", "/tmp/x.npz", "--validate"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "--output" in err and "--validate" in err

@@ -1,0 +1,37 @@
+"""The examples/ directory must stay runnable (smoke, CPU platform)."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+EXAMPLES = sorted((REPO / "examples").glob("*.py"))
+
+
+@pytest.mark.parametrize("script", EXAMPLES, ids=lambda p: p.name)
+def test_example_runs(script):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PJ_EXAMPLE_N"] = "100"
+    # Single CPU device: the conftest's 8-fake-device XLA_FLAGS would make
+    # each example pay sharded-path compiles in a cold subprocess.
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    # Exactly the repo on PYTHONPATH: the harness's own PYTHONPATH may
+    # carry a TPU-plugin sitecustomize that monkeypatches backend
+    # selection and dials the device tunnel even under JAX_PLATFORMS=cpu
+    # (the utils/platform.py trap) — examples are written for stock jax.
+    env["PYTHONPATH"] = str(REPO)
+    # 02 takes a scale argument; keep it tiny for CI.
+    args = ["10"] if "streaming" in script.name else []
+    out = subprocess.run(
+        [sys.executable, str(script), *args],
+        capture_output=True, text=True, timeout=300, env=env, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip()
