@@ -23,9 +23,9 @@ import jax
 import numpy as np
 
 import paralleljohnson_tpu as pj
+from paralleljohnson_tpu.utils.platform import honor_cpu_platform_request
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+honor_cpu_platform_request()
 
 print("devices:", jax.devices())
 

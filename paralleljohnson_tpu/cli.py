@@ -209,6 +209,7 @@ def main(argv: list[str] | None = None) -> int:
                         ("--predecessors", args.predecessors),
                         ("--output", args.output is not None),
                         ("--validate", args.validate),
+                        ("--checkpoint-dir", args.checkpoint_dir is not None),
                     ] if on
                 ]
                 if unsupported:

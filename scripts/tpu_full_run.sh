@@ -9,12 +9,14 @@ unset JAX_PLATFORMS XLA_FLAGS
 LOG=${1:-/tmp/tpu_full_run.log}
 : > "$LOG"
 
+FAILED_STAGES=""
 run() {  # run <seconds> <label> <cmd...>  -> returns the timed command's rc
   local t=$1 label=$2 rc; shift 2
   echo "=== $label ===" | tee -a "$LOG"
   timeout --signal=TERM --kill-after=30 "$t" "$@" 2>&1 | grep -v WARNING | tail -6 | tee -a "$LOG"
   rc=${PIPESTATUS[0]}
   echo "--- rc=$rc ---" | tee -a "$LOG"
+  [ "$rc" -ne 0 ] && FAILED_STAGES="$FAILED_STAGES $label"
   return "$rc"
 }
 
@@ -34,7 +36,7 @@ run 2400 jax-full-batch python -m paralleljohnson_tpu.cli bench batch_small --ba
 (
   export PJ_BENCH_RMAT_SCALE=22
   run 3000 jax-rmat22 python -m paralleljohnson_tpu.cli bench rmat_apsp --backend jax --preset full --update-baseline BASELINE.md
-)
+) || FAILED_STAGES="$FAILED_STAGES jax-rmat22"
 
 # 4) grid SSSP frontier timing (VERDICT #4 evidence)
 run 900 grid-timing python scripts/tpu_grid.py
@@ -47,4 +49,8 @@ run 900 profile-bf python -m paralleljohnson_tpu.cli sssp "grid:rows=96,cols=96,
 # 6) edge-chunk tuning sweep
 run 900 chunk-tune python scripts/tpu_micro2.py 16 128
 
+if [ -n "$FAILED_STAGES" ]; then
+  echo "STAGES FAILED:$FAILED_STAGES (log: $LOG)" | tee -a "$LOG"
+  exit 1
+fi
 echo "ALL STAGES DONE (log: $LOG)"

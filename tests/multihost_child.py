@@ -1,0 +1,68 @@
+"""Child process for the two-process multi-host integration test.
+
+Each process owns 2 fake CPU devices; jax.distributed glues them into one
+4-device global mesh. Run by tests/test_multihost.py — not a test itself.
+"""
+
+import os
+import sys
+
+
+def main(process_id: int, num_processes: int, port: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from paralleljohnson_tpu.parallel import multihost
+    from paralleljohnson_tpu.parallel.mesh import sharded_fanout
+
+    assert multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    info = multihost.process_info()
+    assert info["process_count"] == num_processes, info
+    assert info["global_devices"] == 2 * num_processes, info
+
+    from paralleljohnson_tpu.graphs import erdos_renyi
+
+    g = erdos_renyi(48, 0.12, seed=5)  # same graph on every process
+    mesh = multihost.global_mesh()
+    import jax.numpy as jnp
+
+    b = 10  # off-multiple of 4 devices: exercises host-side padding
+    srcs = np.arange(b)
+    garr = multihost.global_sources(mesh, srcs)
+    dist, iters, improving, row_sweeps = sharded_fanout(
+        mesh, garr,
+        jnp.asarray(g.src), jnp.asarray(g.indices), jnp.asarray(g.weights),
+        num_nodes=g.num_nodes, max_iter=g.num_nodes,
+        replicate=True,  # all_gather -> replicated rows, checkable anywhere
+        with_row_sweeps=True, n_real_rows=b,
+    )
+    assert not bool(improving)
+    # replicate=True: every process holds the full rows.
+    rows = np.asarray(dist)[:b]
+
+    import scipy.sparse as sp
+    import scipy.sparse.csgraph as csgraph
+
+    mat = sp.csr_matrix(
+        (g.weights.astype(np.float64), g.indices, g.indptr),
+        shape=(g.num_nodes, g.num_nodes),
+    )
+    oracle = csgraph.dijkstra(mat, directed=True, indices=srcs)
+    assert np.allclose(rows, oracle, rtol=1e-5, atol=1e-5), "oracle mismatch"
+    # Exact accounting: 10 real rows billed, at most max-sweeps each —
+    # and identical on every process (the process_allgather branch).
+    assert b <= row_sweeps <= int(iters) * b, (row_sweeps, int(iters))
+    print(f"MHOK pid={process_id} row_sweeps={row_sweeps} iters={int(iters)}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))

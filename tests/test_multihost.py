@@ -1,0 +1,61 @@
+"""Two-process multi-host integration (SURVEY.md §5 "Distributed
+communication backend"): jax.distributed over a localhost coordinator, two
+processes x two fake CPU devices each = one 4-device global mesh, the
+sharded fan-out with the explicit all_gather, host-padded off-multiple
+batch, and the multi-host row-sweep accounting (process_allgather branch).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+CHILD = Path(__file__).with_name("multihost_child.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_sharded_fanout():
+    port = _free_port()
+    nprocs = 2
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO)  # stock jax: no plugin sitecustomize
+    env["XLA_FLAGS"] = " ".join(
+        [f for f in env.get("XLA_FLAGS", "").split()
+         if "xla_force_host_platform_device_count" not in f]
+        + ["--xla_force_host_platform_device_count=2"]
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(CHILD), str(i), str(nprocs), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(REPO),
+        )
+        for i in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+        assert "MHOK" in out, out[-1000:]
+    # Same exact accounting on both processes.
+    lines = sorted(
+        line for out in outs for line in out.splitlines()
+        if line.startswith("MHOK")
+    )
+    sweeps = {line.split("row_sweeps=")[1] for line in lines}
+    assert len(sweeps) == 1, lines
