@@ -187,11 +187,22 @@ def _tpu_attempt(
         sys.executable, os.path.abspath(__file__), "--device-inner",
         str(scale), str(n_sources), str(repeats),
     ]
+    # Persistent jax compilation cache: every remote compile through the
+    # single-tenant tunnel is a wedge opportunity and 20-40 s of latency;
+    # a warm cache turns retries and repeat runs into cache hits. Harmless
+    # if the PJRT backend can't serialize executables (jax skips caching).
+    env = dict(os.environ)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.expanduser("~/.cache/pj_jax_cache"),  # user-scoped: a
+        # world-predictable /tmp path invites cache poisoning on shared
+        # hosts and breaks when another user owns it
+    )
     # bufsize=0 + raw os.read: select() watches the fd directly, so a
     # buffered-TextIOWrapper line can never sit invisible past a select
     # wakeup and starve the stage watchdog.
     p = subprocess.Popen(
-        cmd, stdout=subprocess.PIPE, stderr=sys.stderr, bufsize=0,
+        cmd, stdout=subprocess.PIPE, stderr=sys.stderr, bufsize=0, env=env,
     )
     fd = p.stdout.fileno()
     deadline = time.monotonic() + total_timeout

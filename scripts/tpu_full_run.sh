@@ -6,6 +6,9 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 unset JAX_PLATFORMS XLA_FLAGS
+# Warm executable cache across stages/retries: fewer remote compiles =
+# fewer tunnel-wedge opportunities (no-op if the backend can't serialize).
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-$HOME/.cache/pj_jax_cache}
 LOG=${1:-/tmp/tpu_full_run.log}
 : > "$LOG"
 
