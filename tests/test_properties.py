@@ -82,3 +82,38 @@ def test_reweighted_nonnegative():
     h = bf.dist
     rw = be.download_graph(be.reweight(dg, h))
     assert np.all(rw.weights >= 0)
+
+
+@settings(max_examples=25, deadline=None)
+@given(graphs(negative=True), st.integers(0, 3))
+def test_layouts_and_frontier_agree(g, knob):
+    """Every kernel-routing knob computes the same distances: fan-out
+    layouts, forced frontier, forced dense — all against the numpy
+    oracle backend on the same random negative-weight DAG."""
+    cfgs = [
+        SolverConfig(backend="jax", fanout_layout="source_major"),
+        SolverConfig(backend="jax", fanout_layout="vertex_major"),
+        SolverConfig(backend="jax", frontier=True),
+        SolverConfig(backend="jax", dense_threshold=64, dense_min_density=0),
+    ]
+    want = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g).matrix
+    got = ParallelJohnsonSolver(cfgs[knob]).solve(g).matrix
+    np.testing.assert_allclose(
+        np.asarray(got), want, rtol=1e-4, atol=1e-4
+    )
+
+
+@settings(max_examples=20, deadline=None)
+@given(graphs(negative=True), st.integers(1, 5))
+def test_solve_reduced_checksum_invariant(g, bs):
+    """Streaming reduction is batch-size invariant and equals the full
+    solve's finite checksum."""
+    solver = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", source_batch_size=bs * 4)
+    )
+    red = solver.solve_reduced(g, reduce_rows="checksum")
+    full = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g)
+    d = np.asarray(full.dist)
+    want = float(np.where(np.isfinite(d), d, 0.0).sum())
+    got = float(sum(red.values))
+    assert abs(got - want) <= 1e-3 * max(1.0, abs(want)), (got, want)

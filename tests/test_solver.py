@@ -159,3 +159,24 @@ def test_source_batch_heuristic(monkeypatch):
     from conftest import oracle_apsp
 
     np.testing.assert_allclose(res.matrix, oracle_apsp(g), rtol=1e-5)
+
+
+def test_self_loops_across_backends():
+    """A negative self-loop is a negative cycle; a positive one is
+    harmless; parallel edges resolve to the minimum weight."""
+    import pytest
+
+    from paralleljohnson_tpu import NegativeCycleError
+    from paralleljohnson_tpu.graphs import CSRGraph
+
+    g_neg = CSRGraph.from_edges([0, 1], [0, 2], [-1.0, 2.0], 3)
+    g_pos = CSRGraph.from_edges([0, 0, 1], [0, 1, 2], [5.0, 1.0, 2.0], 3)
+    g_par = CSRGraph.from_edges([0, 0, 1], [1, 1, 2], [7.0, 1.0, 2.0], 3)
+    for backend in ("numpy", "jax", "cpp"):
+        solver = ParallelJohnsonSolver(SolverConfig(backend=backend))
+        with pytest.raises(NegativeCycleError):
+            solver.solve(g_neg)
+        d = np.asarray(solver.solve(g_pos).dist)
+        assert d[0, 0] == 0.0 and abs(d[0, 2] - 3.0) < 1e-5
+        d = np.asarray(solver.solve(g_par).dist)
+        assert abs(d[0, 2] - 3.0) < 1e-5, (backend, d[0])
