@@ -333,6 +333,30 @@ class JaxBackend(Backend):
         v = dgraph.num_nodes
         return int(min(v, max(1024, v // 8)))
 
+    def _edge_mesh(self):
+        """Mesh over the ``"edges"`` axis (same devices as the fan-out
+        mesh), for edge-sharded single-source Bellman-Ford."""
+        from paralleljohnson_tpu.parallel import make_edge_mesh
+
+        cached = getattr(self, "_edge_mesh_cache", None)
+        if cached is None:
+            cached = make_edge_mesh(self.config.mesh_shape)
+            self._edge_mesh_cache = cached
+        return cached
+
+    def _use_edge_shard(self, dgraph: JaxDeviceGraph) -> bool:
+        """Edge sharding is the only way a multi-device mesh helps a B=1
+        solve. Precedence: an explicit ``edge_shard=True`` wins (the
+        documented scale-out escape hatch for edge lists beyond one
+        chip's HBM); ``"auto"`` defers to the frontier path on
+        low-degree graphs where frontier compaction is work-optimal."""
+        flag = self.config.edge_shard
+        if flag is False or self._mesh().devices.size <= 1:
+            return False
+        if flag is True:
+            return True
+        return not self._use_frontier(dgraph)
+
     def bellman_ford(self, dgraph: JaxDeviceGraph, source: int | None) -> KernelResult:
         v = dgraph.num_nodes
         if source is None:
@@ -341,6 +365,27 @@ class JaxBackend(Backend):
             dist0 = jnp.full(v, jnp.inf, self._dtype).at[source].set(0.0)
         max_iter = self.config.max_iterations or v
         chunk = _edge_chunk_for(1, dgraph.src.shape[0])
+        if self._use_edge_shard(dgraph):
+            from paralleljohnson_tpu.parallel import edge_sharded_bellman_ford
+
+            emesh = self._edge_mesh()
+            dist, iters, improving = edge_sharded_bellman_ford(
+                emesh, dist0, dgraph.src, dgraph.dst, dgraph.weights,
+                max_iter=max_iter,
+                edge_chunk=_edge_chunk_for(
+                    1, -(-dgraph.src.shape[0] // emesh.devices.size)
+                ),
+            )
+            iters = int(iters)
+            improving = bool(improving)
+            return KernelResult(
+                dist=dist,
+                negative_cycle=improving and max_iter >= v,
+                converged=not improving,
+                iterations=iters,
+                # Each round relaxes the full edge list (across shards).
+                edges_relaxed=iters * dgraph.num_real_edges,
+            )
         if self._use_frontier(dgraph):
             dist, iters, improving, examined = _bf_frontier_kernel(
                 dist0, dgraph.src, dgraph.dst, dgraph.weights,

@@ -41,6 +41,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    choices=["auto", "true", "false"],
                    help="frontier-compacted Bellman-Ford for high-diameter "
                         "graphs: auto (low-degree graphs) / force / off")
+    p.add_argument("--edge-shard", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="shard the edge list across the mesh for "
+                        "single-source Bellman-Ford (auto: whenever the "
+                        "mesh has >1 device)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--predecessors", action="store_true",
                    help="also compute shortest-path trees (saved to --output)")
@@ -72,6 +77,7 @@ def _config(args) -> "SolverConfig":
         use_pallas=tristate[args.use_pallas],
         fanout_layout=args.fanout_layout,
         frontier=tristate[args.frontier],
+        edge_shard=tristate[args.edge_shard],
         checkpoint_dir=args.checkpoint_dir,
         validate=args.validate,
     )

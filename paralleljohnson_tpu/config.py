@@ -57,6 +57,13 @@ class SolverConfig:
       frontier_capacity: static frontier-id buffer size (rounds whose
         active set exceeds it fall back to one full sweep); ``None``
         sizes it from V (see ``JaxBackend._frontier_capacity``).
+      edge_shard: shard the EDGE LIST across the mesh for single-source
+        Bellman-Ford (dist replicated, one pmin all-reduce per sweep) —
+        the scale-out axis when the edge list exceeds one chip's HBM,
+        and the only way a multi-chip mesh helps a B=1 solve. ``"auto"``
+        enables it whenever the mesh has >1 device and the frontier path
+        is not active (frontier is work-optimal on low-degree graphs);
+        True forces (given >1 device), False keeps single-chip sweeps.
       checkpoint_dir: if set, per-source-batch distance rows are saved here
         and resumed after preemption (SURVEY.md §5 checkpoint/resume).
       validate: cross-check results against the scipy oracle (slow; tests).
@@ -74,6 +81,7 @@ class SolverConfig:
     fanout_layout: str = "auto"
     frontier: bool | str = "auto"
     frontier_capacity: int | None = None
+    edge_shard: bool | str = "auto"
     checkpoint_dir: str | None = None
     validate: bool = False
 
@@ -96,4 +104,8 @@ class SolverConfig:
         if self.frontier not in (True, False, "auto"):
             raise ValueError(
                 f"frontier must be True/False/'auto', got {self.frontier!r}"
+            )
+        if self.edge_shard not in (True, False, "auto"):
+            raise ValueError(
+                f"edge_shard must be True/False/'auto', got {self.edge_shard!r}"
             )

@@ -1,6 +1,17 @@
 """Mesh/sharding layer: source parallelism + ICI/DCN collectives."""
 
 from paralleljohnson_tpu.parallel import multihost
-from paralleljohnson_tpu.parallel.mesh import make_mesh, sharded_fanout
+from paralleljohnson_tpu.parallel.mesh import (
+    edge_sharded_bellman_ford,
+    make_edge_mesh,
+    make_mesh,
+    sharded_fanout,
+)
 
-__all__ = ["make_mesh", "multihost", "sharded_fanout"]
+__all__ = [
+    "edge_sharded_bellman_ford",
+    "make_edge_mesh",
+    "make_mesh",
+    "multihost",
+    "sharded_fanout",
+]

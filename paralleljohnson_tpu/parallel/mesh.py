@@ -33,11 +33,14 @@ except ImportError:  # pragma: no cover
 from paralleljohnson_tpu.ops import relax
 
 
-def make_mesh(mesh_shape: tuple[int, ...] | None = None) -> Mesh:
-    """1-D device mesh over the ``"sources"`` axis.
+def make_mesh(
+    mesh_shape: tuple[int, ...] | None = None, axis_name: str = "sources"
+) -> Mesh:
+    """1-D device mesh over ``axis_name`` ("sources" for the fan-out,
+    "edges" for edge-sharded Bellman-Ford).
 
     ``mesh_shape=None`` uses every visible device; ``(n,)`` uses the first
-    n. Johnson's fan-out has a single parallel dimension (sources), so the
+    n. Johnson's kernels each have a single parallel dimension, so the
     mesh is 1-D by design — no model/pipeline axis exists in this domain
     (SURVEY.md §2: TP/PP/EP are N/A).
     """
@@ -50,7 +53,7 @@ def make_mesh(mesh_shape: tuple[int, ...] | None = None) -> Mesh:
                 f"only {devices.size} visible"
             )
         devices = devices[:n]
-    return Mesh(devices, axis_names=("sources",))
+    return Mesh(devices, axis_names=(axis_name,))
 
 
 @functools.lru_cache(maxsize=32)
@@ -116,6 +119,84 @@ def _sharded_fanout_fn(mesh: Mesh, num_nodes: int, max_iter: int,
         check_vma=not replicate,
     )
     return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=32)
+def _edge_sharded_bf_fn(mesh: Mesh, num_nodes: int, max_iter: int,
+                        edge_chunk: int):
+    """Edge-sharded Bellman-Ford: the scale-out axis for graphs whose
+    EDGE LIST exceeds one chip's HBM (beyond the attested replicated-CSR
+    design — SURVEY.md §7 notes this as the stretch direction; e.g.
+    rmat-26 is ~1 G edges = 12 GB of COO buffers).
+
+    Layout: edges split on the 1-D mesh axis, dist [B, V] (or [V])
+    replicated. Each sweep relaxes the local edge shard, then a ``pmin``
+    all-reduce merges the per-shard relaxations — one [B, V] collective
+    per sweep over ICI. Monotone relaxation makes the merge exact: the
+    pmin of per-shard relaxed copies equals a full-edge-list sweep with
+    Jacobi (not chunk-Gauss-Seidel) visibility, so convergence needs the
+    same <= |V| rounds and the negative-cycle bound holds unchanged.
+    """
+
+    def shard_body(dist0, s, t, wt):
+        def cond(state):
+            _, i, improving = state
+            return improving & (i < max_iter)
+
+        def body(state):
+            d, i, _ = state
+            nd = relax.relax_sweep(d, s, t, wt, edge_chunk=edge_chunk)
+            nd = jax.lax.pmin(nd, "edges")
+            return nd, i + 1, jnp.any(nd < d)
+
+        improving0 = jnp.any(jnp.isfinite(dist0))
+        dist, iters, improving = jax.lax.while_loop(
+            cond, body, (dist0, jnp.int32(0), improving0)
+        )
+        improving = jax.lax.pmax(improving.astype(jnp.int32), "edges")
+        return dist, iters, improving
+
+    mapped = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P("edges"), P("edges"), P("edges")),
+        out_specs=(P(), P(), P()),
+        check_vma=False,  # the pmin result is replicated; vma can't infer it
+    )
+    return jax.jit(mapped)
+
+
+def edge_sharded_bellman_ford(
+    mesh: Mesh,
+    dist0,
+    src,
+    dst,
+    w,
+    *,
+    max_iter: int,
+    edge_chunk: int = 1 << 20,
+):
+    """Bellman-Ford with the EDGE LIST sharded across ``mesh`` (axis name
+    "edges" — pass a mesh from :func:`make_edge_mesh`). ``dist0`` is
+    replicated ([V] or [B, V]); edges are padded to a mesh multiple with
+    (0, 0, +inf) no-ops. Returns (dist, iterations, still_improving).
+    """
+    n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    e = src.shape[0]
+    pad = (-e) % n
+    if pad:
+        src = jnp.concatenate([src, jnp.zeros(pad, src.dtype)])
+        dst = jnp.concatenate([dst, jnp.zeros(pad, dst.dtype)])
+        w = jnp.concatenate([w, jnp.full(pad, jnp.inf, w.dtype)])
+    fn = _edge_sharded_bf_fn(mesh, int(dist0.shape[-1]), int(max_iter),
+                             int(edge_chunk))
+    dist, iters, improving = fn(dist0, src, dst, w)
+    return dist, iters, improving.astype(bool)
+
+
+def make_edge_mesh(mesh_shape: tuple[int, ...] | None = None) -> Mesh:
+    """1-D device mesh over an ``"edges"`` axis (edge-sharded kernels)."""
+    return make_mesh(mesh_shape, axis_name="edges")
 
 
 def sharded_fanout(
