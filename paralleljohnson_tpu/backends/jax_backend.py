@@ -284,9 +284,10 @@ class JaxBackend(Backend):
         carry, the update, and XLA temporaries."""
         v = max(dgraph.num_nodes, 1)
         itemsize = jnp.dtype(self._dtype).itemsize
-        n = self._mesh().devices.size
-        # Per-DEVICE budget: the batch shards over the mesh, so the global
-        # B is n x what one device can hold.
+        # Per-DEVICE budget: row blocks shard over the "sources" axis only
+        # (on a 2-D mesh they replicate over "edges"), so the global B is
+        # n_sources x what one device can hold.
+        n = self._sources_axis_size()
         b = (self._memory_budget_bytes() // (6 * v * itemsize)) * n
         b = int(max(1, min(b, 1 << 16)))
         if b > n:
@@ -452,6 +453,14 @@ class JaxBackend(Backend):
         sources = jnp.asarray(sources, jnp.int32)
         max_iter = self.config.max_iterations or v
         mesh = self._mesh()
+        if "edges" in mesh.axis_names:
+            # Predecessor tracking needs the source-major argmin sweep,
+            # which has no edges-sharded merge; run the pred fan-out on a
+            # 1-D "sources" mesh over the SAME devices instead of
+            # crashing (the 2-D accounting expects a sources-only vec).
+            from paralleljohnson_tpu.parallel import make_mesh
+
+            mesh = make_mesh((mesh.devices.size,))
         if mesh.devices.size > 1:
             from paralleljohnson_tpu.parallel import sharded_fanout
 
@@ -490,14 +499,26 @@ class JaxBackend(Backend):
         return bool(flag), bool(flag) and not on_tpu
 
     def _mesh(self):
-        """The fan-out mesh: >1 device shards sources; 1 device = local."""
-        from paralleljohnson_tpu.parallel import make_mesh
+        """The fan-out mesh. mesh_shape=(n,) or None: 1-D over "sources";
+        mesh_shape=(n_s, n_e): 2-D ("sources", "edges") — rows AND edge
+        slices sharded simultaneously (sharded_fanout_2d)."""
+        from paralleljohnson_tpu.parallel import make_mesh, make_mesh_2d
 
         cached = getattr(self, "_mesh_cache", None)
         if cached is None:
-            cached = make_mesh(self.config.mesh_shape)
+            shape = self.config.mesh_shape
+            if shape is not None and len(shape) == 2:
+                cached = make_mesh_2d(shape)
+            else:
+                cached = make_mesh(shape)
             self._mesh_cache = cached
         return cached
+
+    def _sources_axis_size(self) -> int:
+        """Devices along the "sources" axis (the axis [B, V] row blocks
+        shard over; on a 2-D mesh rows replicate over "edges")."""
+        mesh = self._mesh()
+        return int(mesh.shape.get("sources", mesh.devices.size))
 
     def _resolve_layout(self) -> str:
         """``fanout_layout`` with ``"auto"`` resolved to the measured winner.
@@ -517,7 +538,26 @@ class JaxBackend(Backend):
         max_iter = self.config.max_iterations or v
         mesh = self._mesh()
         layout = self._resolve_layout()
-        if mesh.devices.size > 1:
+        if "edges" in mesh.axis_names:
+            # 2-D ("sources", "edges") mesh: rows AND edge slices sharded.
+            from paralleljohnson_tpu.parallel import sharded_fanout_2d
+
+            ns = int(mesh.shape["sources"])
+            ne = int(mesh.shape["edges"])
+            chunk = _edge_chunk_for(
+                -(-sources.shape[0] // ns),
+                -(-dgraph.src.shape[0] // ne),
+            )
+            edges = (
+                dgraph.by_dst() if layout == "vertex_major"
+                else (dgraph.src, dgraph.dst, dgraph.weights)
+            )
+            dist, iters, improving, row_sweeps = sharded_fanout_2d(
+                mesh, sources, *edges,
+                num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                layout=layout, with_row_sweeps=True,
+            )
+        elif mesh.devices.size > 1:
             from paralleljohnson_tpu.parallel import sharded_fanout
 
             # Ceil: sharded_fanout pads the batch up to a mesh multiple, so

@@ -44,8 +44,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--edge-shard", default="auto",
                    choices=["auto", "true", "false"],
                    help="shard the edge list across the mesh for "
-                        "single-source Bellman-Ford (auto: whenever the "
-                        "mesh has >1 device)")
+                        "single-source Bellman-Ford (auto: mesh >1 device "
+                        "AND the frontier path is not active — frontier "
+                        "wins on low-degree graphs; true forces)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--predecessors", action="store_true",
                    help="also compute shortest-path trees (saved to --output)")

@@ -22,10 +22,12 @@ class SolverConfig:
       precision: ``"f32"`` or ``"f64"`` (f64 only meaningful off-TPU).
       source_batch_size: sources solved per device batch in the N-source
         phase; ``None`` picks a batch that fits VMEM/HBM heuristically.
-      mesh_shape: devices along the ``("sources",)`` mesh axis; ``None``
-        uses every visible device. Consumed by
-        :mod:`paralleljohnson_tpu.parallel` when the jax backend shards the
-        fan-out.
+      mesh_shape: ``None`` or ``(n,)``: n devices along a 1-D
+        ``("sources",)`` mesh (fan-out rows sharded, CSR replicated).
+        ``(n_s, n_e)``: a 2-D ``("sources", "edges")`` mesh — rows shard
+        over n_s devices AND the edge list shards over n_e, for graphs
+        whose edges exceed one chip's HBM while still fanning out wide.
+        Consumed by :mod:`paralleljohnson_tpu.parallel`.
       max_iterations: cap on relaxation sweeps; ``None`` = |V| (the
         Bellman-Ford bound).
       dense_threshold: graphs with V <= threshold are ELIGIBLE for the

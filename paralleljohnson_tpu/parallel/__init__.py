@@ -5,13 +5,17 @@ from paralleljohnson_tpu.parallel.mesh import (
     edge_sharded_bellman_ford,
     make_edge_mesh,
     make_mesh,
+    make_mesh_2d,
     sharded_fanout,
+    sharded_fanout_2d,
 )
 
 __all__ = [
     "edge_sharded_bellman_ford",
     "make_edge_mesh",
     "make_mesh",
+    "make_mesh_2d",
     "multihost",
     "sharded_fanout",
+    "sharded_fanout_2d",
 ]

@@ -56,6 +56,57 @@ def make_mesh(
     return Mesh(devices, axis_names=(axis_name,))
 
 
+def _pad_sources(sources, n: int):
+    """Pad a source batch to a multiple of ``n`` mesh shards, duplicating
+    ``sources[0]``: padding rows participate in the pmax'd still-improving
+    flag, and an arbitrary vertex-0 row could need more sweeps than every
+    requested source, turning a converged fan-out into a spurious
+    ConvergenceError. Guards the multi-process footgun of eager-padding a
+    non-fully-addressable global array. Returns (padded, pad)."""
+    b = sources.shape[0]
+    pad = (-b) % n
+    if pad:
+        if isinstance(sources, jax.Array) and not sources.is_fully_addressable:
+            raise ValueError(
+                "off-multiple source batch arrived as a non-fully-"
+                "addressable global array; pad on the host before building "
+                "it (multihost.global_sources does this automatically)"
+            )
+        sources = jnp.concatenate(
+            [sources, jnp.full(pad, sources[0], jnp.int32)]
+        )
+    return sources, pad
+
+
+def _fetch_shard_vec(iters_vec) -> np.ndarray:
+    """Host copy of the tiny per-shard sweep-count vector, multi-host-safe
+    (shards of a mesh-sharded output live on other hosts in a
+    multi-process run)."""
+    if iters_vec.is_fully_addressable:
+        return np.asarray(iters_vec)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.process_allgather(iters_vec, tiled=True)
+    )
+
+
+def _row_sweeps_exact(vec: np.ndarray, stride: int, n_groups: int,
+                      per_group: int, b_real: int) -> int:
+    """Exact, overflow-free accounting in Python ints: each source group's
+    sweep count x its REAL row count (an int32 product on device could
+    wrap). ``vec`` holds one entry per mesh shard; source group g reads
+    entry g*stride (on a 2-D mesh every edges shard of a group reports
+    the same lockstep count). Padding rows sit at the TAIL and may span
+    several groups (11 rows on 8 groups -> per_group 2, pad 5 across
+    groups 5-7), so clip per group."""
+    return sum(
+        int(vec[g * stride])
+        * max(0, min(per_group, b_real - g * per_group))
+        for g in range(n_groups)
+    )
+
+
 @functools.lru_cache(maxsize=32)
 def _sharded_fanout_fn(mesh: Mesh, num_nodes: int, max_iter: int,
                        edge_chunk: int, replicate: bool,
@@ -199,6 +250,133 @@ def make_edge_mesh(mesh_shape: tuple[int, ...] | None = None) -> Mesh:
     return make_mesh(mesh_shape, axis_name="edges")
 
 
+def make_mesh_2d(mesh_shape: tuple[int, int]) -> Mesh:
+    """2-D ``("sources", "edges")`` mesh: sources axis for fan-out
+    throughput, edges axis for edge lists beyond one chip's HBM — the two
+    scale-out dimensions of this domain, composed."""
+    ns, ne = int(mesh_shape[0]), int(mesh_shape[1])
+    devices = np.asarray(jax.devices())
+    if ns * ne > devices.size:
+        raise ValueError(
+            f"mesh_shape {mesh_shape} needs {ns * ne} devices; "
+            f"only {devices.size} visible"
+        )
+    return Mesh(devices[: ns * ne].reshape(ns, ne),
+                axis_names=("sources", "edges"))
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_fanout_2d_fn(mesh: Mesh, num_nodes: int, max_iter: int,
+                          edge_chunk: int, layout: str = "source_major"):
+    """Fan-out over a 2-D ("sources", "edges") mesh: each shard holds a
+    [B/n_s, V] row block and an E/n_e edge slice. Per sweep: relax the
+    local edges, then pmin over the "edges" axis merges the partial
+    relaxations (exact — monotone relaxation, Jacobi visibility). Source
+    groups run the fixpoint loop independently (no cross-"sources"
+    collective inside the loop); within a group the pmin keeps edge
+    shards lockstep, so the data-dependent trip count is well defined.
+    Rows come back sharded on "sources", replicated over "edges".
+    """
+
+    vm = layout == "vertex_major"
+
+    def shard_body(srcs, s, t, wt):
+        d0 = relax.multi_source_init(srcs, num_nodes, dtype=wt.dtype)
+        if vm:
+            d0 = d0.T  # [V, B_shard]; shard slices of a globally
+            # dst-sorted edge list stay dst-sorted, so the sorted segment
+            # reduction is valid per shard.
+
+        def cond(state):
+            _, i, improving = state
+            return improving & (i < max_iter)
+
+        def body(state):
+            d, i, _ = state
+            if vm:
+                nd = relax.relax_sweep_vm(d, s, t, wt, edge_chunk=edge_chunk)
+            else:
+                nd = relax.relax_sweep(d, s, t, wt, edge_chunk=edge_chunk)
+            nd = jax.lax.pmin(nd, "edges")
+            return nd, i + 1, jnp.any(nd < d)
+
+        improving0 = jnp.any(jnp.isfinite(d0))
+        d, iters, improving = jax.lax.while_loop(
+            cond, body, (d0, jnp.int32(0), improving0)
+        )
+        if vm:
+            d = d.T
+        iters_vec = iters[None]  # [1] per shard -> [n_s * n_e] global
+        iters = jax.lax.pmax(iters, ("sources", "edges"))
+        improving = jax.lax.pmax(
+            improving.astype(jnp.int32), ("sources", "edges")
+        )
+        return d, iters, improving, iters_vec
+
+    mapped = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P("sources"), P("edges"), P("edges"), P("edges")),
+        out_specs=(P("sources", None), P(), P(), P(("sources", "edges"))),
+        check_vma=False,  # pmin/pmax results are replicated over "edges"
+    )
+    return jax.jit(mapped)
+
+
+def sharded_fanout_2d(
+    mesh: Mesh,
+    sources,
+    src,
+    dst,
+    w,
+    *,
+    num_nodes: int,
+    max_iter: int,
+    edge_chunk: int = 1 << 20,
+    layout: str = "source_major",
+    with_row_sweeps: bool = False,
+):
+    """N-source fan-out with sources AND edges sharded over a 2-D mesh
+    (from :func:`make_mesh_2d`). Pads sources to a multiple of the
+    "sources" axis (duplicating ``sources[0]``) and edges to a multiple
+    of the "edges" axis ((0, 0, +inf) no-ops).
+
+    ``layout="vertex_major"``: the caller MUST pass globally dst-sorted
+    edges (``JaxDeviceGraph.by_dst``) — contiguous shard slices of a
+    sorted list stay sorted, so each shard runs the sorted segment
+    reduction on its slice. Tail pad edges are (0, V-1, +inf) for this
+    layout: ``indices_are_sorted=True`` makes an out-of-order index
+    undefined behavior, so the pad must preserve monotone dst.
+
+    Returns (dist[B, V], iterations, still_improving[, row_sweeps])."""
+    ns = mesh.shape["sources"]
+    ne = mesh.shape["edges"]
+    sources = jnp.asarray(sources, jnp.int32)
+    b = sources.shape[0]
+    sources, spad = _pad_sources(sources, ns)
+    epad = (-src.shape[0]) % ne
+    if epad:
+        pad_dst = num_nodes - 1 if layout == "vertex_major" else 0
+        src = jnp.concatenate([src, jnp.zeros(epad, src.dtype)])
+        dst = jnp.concatenate(
+            [dst, jnp.full(epad, pad_dst, dst.dtype)]
+        )
+        w = jnp.concatenate([w, jnp.full(epad, jnp.inf, w.dtype)])
+    fn = _sharded_fanout_2d_fn(mesh, int(num_nodes), int(max_iter),
+                               int(edge_chunk), str(layout))
+    d, iters, improving, iters_vec = fn(sources, src, dst, w)
+    out = (d[:b], iters, improving.astype(bool))
+    if with_row_sweeps:
+        # Per source group g, every edges shard reports the same sweep
+        # count (lockstep) — read entry g*ne.
+        row_sweeps = _row_sweeps_exact(
+            _fetch_shard_vec(iters_vec), stride=ne, n_groups=ns,
+            per_group=(b + spad) // ns, b_real=b,
+        )
+        out = out + (row_sweeps,)
+    return out
+
+
 def sharded_fanout(
     mesh: Mesh,
     sources,
@@ -241,19 +419,7 @@ def sharded_fanout(
     n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     sources = jnp.asarray(sources, jnp.int32)
     b = sources.shape[0]
-    pad = (-b) % n
-    if pad:
-        if isinstance(sources, jax.Array) and not sources.is_fully_addressable:
-            raise ValueError(
-                "off-multiple source batch arrived as a non-fully-"
-                "addressable global array; pad on the host before building "
-                "it (multihost.global_sources does this automatically)"
-            )
-        # Pad with a duplicate of a real source, not vertex 0: padding rows
-        # participate in the pmax'd still-improving flag, and an arbitrary
-        # vertex 0 row could need more sweeps than every requested source,
-        # turning a converged fan-out into a spurious ConvergenceError.
-        sources = jnp.concatenate([sources, jnp.full(pad, sources[0], jnp.int32)])
+    sources, pad = _pad_sources(sources, n)
     acct_pad = pad + (b - n_real_rows if n_real_rows is not None else 0)
     fn = _sharded_fanout_fn(mesh, num_nodes, max_iter, int(edge_chunk),
                             bool(replicate), bool(with_pred), str(layout))
@@ -264,29 +430,11 @@ def sharded_fanout(
         d, iters, improving, iters_vec = fn(sources, src, dst, w)
         out = (d[:b], iters, improving.astype(bool))
     if with_row_sweeps:
-        # Exact, overflow-free accounting in Python ints: each shard's
-        # sweep count x its REAL row count (an int32 product on device
-        # could wrap). Padding rows (locally added and/or the caller's
-        # pre-padded tail, ``acct_pad`` total) sit at the TAIL and may
-        # span several shards (11 rows on 8 devices -> per_shard 2, pad 5
-        # across shards 5-7), so clip per shard.
-        per_shard = (b + pad) // n
-        b_real = b + pad - acct_pad
-        if iters_vec.is_fully_addressable:
-            shard_iters = np.asarray(iters_vec)
-        else:
-            # Multi-process: shards of the P("sources") vector live on
-            # other hosts; allgather the (tiny, [n]) vector so every host
-            # computes the same exact total.
-            from jax.experimental import multihost_utils
-
-            shard_iters = np.asarray(
-                multihost_utils.process_allgather(iters_vec, tiled=True)
-            )
-        row_sweeps = sum(
-            int(shard_iters[i])
-            * max(0, min(per_shard, b_real - i * per_shard))
-            for i in range(n)
+        # acct_pad covers locally-added padding and/or the caller's
+        # pre-padded tail (n_real_rows).
+        row_sweeps = _row_sweeps_exact(
+            _fetch_shard_vec(iters_vec), stride=1, n_groups=n,
+            per_group=(b + pad) // n, b_real=b + pad - acct_pad,
         )
         out = out + (row_sweeps,)
     return out
