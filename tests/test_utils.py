@@ -1,0 +1,78 @@
+"""Direct coverage for utils: profiling (device_trace, log_stats),
+reductions, and SolverStats accounting semantics."""
+
+import json
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu.utils.metrics import SolverStats, phase_timer
+from paralleljohnson_tpu.utils.profiling import device_trace, log_stats
+from paralleljohnson_tpu.utils.reductions import (
+    finite_checksum,
+    finite_frac,
+    xp,
+)
+
+
+def test_device_trace_writes_profile(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    with device_trace(str(tmp_path / "trace")):
+        jax.block_until_ready(jnp.arange(8) * 2)
+    files = list((tmp_path / "trace").rglob("*"))
+    assert files, "jax.profiler trace produced no artifacts"
+
+
+def test_device_trace_none_is_noop():
+    with device_trace(None):
+        pass  # no directory, no profiler session
+
+
+def test_log_stats_emits_parseable_json(capsys):
+    stats = SolverStats()
+    with phase_timer(stats, "fanout"):
+        pass
+    stats.edges_relaxed = 123
+    stats.edges_relaxed_by_phase["fanout"] = 123
+    log_stats(stats, label="unit")
+    err = capsys.readouterr().err.strip().splitlines()[-1]
+    payload = json.loads(err)
+    assert payload["event"] == "pjtpu.unit"
+    assert payload["edges_relaxed"] == 123
+    assert "fanout" in payload["phase_seconds"]
+
+
+def test_reductions_host_and_device_agree():
+    import jax.numpy as jnp
+
+    host = np.array([[0.0, np.inf, 3.0], [1.0, 2.0, np.inf]], np.float32)
+    dev = jnp.asarray(host)
+    assert xp(host) is np
+    assert xp(dev) is jnp
+    assert finite_frac(host) == pytest.approx(4 / 6)
+    assert finite_frac(dev) == pytest.approx(4 / 6)
+    assert finite_checksum(host) == pytest.approx(6.0)
+    assert finite_checksum(dev) == pytest.approx(6.0)
+
+
+def test_solver_stats_accumulate_and_rate():
+    from paralleljohnson_tpu.backends.base import KernelResult
+
+    stats = SolverStats()
+    with phase_timer(stats, "fanout"):
+        pass
+    stats.accumulate(
+        KernelResult(dist=np.zeros(3), iterations=4, edges_relaxed=100),
+        phase="fanout",
+    )
+    stats.accumulate(
+        KernelResult(dist=np.zeros(3), iterations=2, edges_relaxed=50),
+        phase="fanout",
+    )
+    assert stats.edges_relaxed == 150
+    assert stats.iterations_by_phase["fanout"] == 6
+    assert stats.edges_relaxed_per_second() >= 0
+    d = stats.as_dict()
+    assert d["edges_relaxed"] == 150
