@@ -9,14 +9,17 @@ the JaxBackend. ``vs_baseline`` is the wall-clock speedup over the
 scipy heap-Dijkstra path on the same graph + sources (the CPU reference
 stand-in; the reference publishes no numbers, BASELINE.json:13).
 
-Tunnel-fragility hardening (round-2): the single-tenant remote-compile
-tunnel wedges on killed clients and on huge first fusions, so the TPU
-attempt runs in a CHILD process that ramps shapes gradually (tiny probe
-op -> scale-10 graph -> scale-13 -> target), emitting a ``STAGE`` line
-after each step; the parent enforces a per-stage watchdog and a total
-budget, shuts the child down gracefully (SIGTERM, then wait) on
-timeout, and only then falls back to CPU with the metric honestly
-renamed. A clean child crash (not a timeout) gets one retry — after a
+Tunnel-fragility hardening (round-2, extended round-3): the
+single-tenant remote-compile tunnel wedges on killed clients and on
+huge first fusions, so the TPU attempt runs in a CHILD process that
+ramps shapes gradually (tiny probe op -> scale-10 graph -> scale-13 ->
+target). Each rung is a FULL timed measurement emitting its own
+``RESULT`` line, so a wedge partway up the ramp still leaves the best
+completed on-chip number (tagged ``tpu-rung`` with its actual scale)
+instead of a CPU fallback. The parent enforces a per-stage watchdog and
+a total budget, shuts the child down gracefully (SIGTERM, then wait) on
+timeout, and falls back to CPU only if NO rung completed. A clean child
+crash (not a timeout) with no results gets one retry — after a
 watchdog kill the tunnel is likely wedged and retrying would burn the
 budget for nothing.
 
@@ -42,7 +45,10 @@ import time
 
 import numpy as np
 
-RAMP_SCALES = (10, 13)  # warm-up graph scales before the target
+# Ramp rungs before the target: each is a FULL timed measurement that can
+# become the published tpu-rung metric if the target wedges — rung config
+# changes change published numbers, they are not mere warm-up.
+RAMP_SCALES = (10, 13)
 
 
 _IS_CHILD = False  # set in --device-inner mode
@@ -55,33 +61,27 @@ def _stage(msg: str) -> None:
           file=sys.stdout if _IS_CHILD else sys.stderr)
 
 
-def _run_config(scale: int, n_sources: int, repeats: int, *, ramp: bool) -> dict:
+def _run_config(
+    scale: int, n_sources: int, repeats: int, *,
+    dense_threshold: int | None = None, label: str = "target",
+) -> dict:
     """Build the graph, run the fan-out on the current jax platform, and
-    return the measured result dict. Shared by the child (TPU) and the
-    parent's CPU fallback."""
+    return the measured result dict. Shared by the child (TPU) — once per
+    ramp rung and once for the target — and the parent's CPU fallback.
+
+    The TARGET runs under the default config so the metric stays comparable
+    across rounds and platforms; the ramp rungs pass ``dense_threshold=0``
+    so they compile (and measure) the sparse fan-out kernel the target will
+    use (rmat(10) has exactly 1024 nodes, which would otherwise hit the
+    unrelated dense min-plus branch)."""
     from paralleljohnson_tpu.backends import get_backend
     from paralleljohnson_tpu.config import SolverConfig
     from paralleljohnson_tpu.graphs import rmat
 
-    # The TARGET always runs under the default config so the metric stays
-    # comparable across rounds and platforms; only the warm-up rungs force
-    # dense_threshold=0, so they compile the sparse fan-out kernel the
-    # target will use (rmat(10) has exactly 1024 nodes, which would
-    # otherwise hit the unrelated dense min-plus branch).
-    backend = get_backend("jax", SolverConfig())
-
-    if ramp:
-        # Grow compiled-fusion sizes gradually: a huge first XLA program is
-        # a known tunnel-wedge trigger on this device lease.
-        warm_backend = get_backend("jax", SolverConfig(dense_threshold=0))
-        for s in RAMP_SCALES:
-            if s >= scale:
-                break
-            gw = rmat(s, 16, seed=42)
-            dgw = warm_backend.upload(gw)
-            srcs = np.arange(min(16, gw.num_nodes), dtype=np.int64)
-            warm_backend.multi_source(dgw, srcs)
-            _stage(f"warm scale={s} ok")
+    cfg = SolverConfig() if dense_threshold is None else SolverConfig(
+        dense_threshold=dense_threshold
+    )
+    backend = get_backend("jax", cfg)
 
     g = rmat(scale, 16, seed=42)
     rng = np.random.default_rng(0)
@@ -91,7 +91,7 @@ def _run_config(scale: int, n_sources: int, repeats: int, *, ramp: bool) -> dict
 
     dgraph = backend.upload(g)
     res = backend.multi_source(dgraph, sources)  # compile + warm caches
-    _stage(f"target scale={scale} compiled")
+    _stage(f"{label} scale={scale} compiled")
     # Time DEVICE compute: block_until_ready guarantees the [B, V] rows are
     # materialized in device memory before the clock stops (the KernelResult
     # sync on iterations/converged already forces the while_loop to finish).
@@ -135,32 +135,72 @@ def _run_config(scale: int, n_sources: int, repeats: int, *, ramp: bool) -> dict
         "dt": dt,
         "t_ref": t_ref,
         "oracle_ok": bool(ok),
+        "scale": scale,
+        "n_sources": n_sources,
+        "platform": jax.default_backend(),
+        "repeats": repeats,
+        # Rungs force the sparse kernel (dense_threshold=0); record it so
+        # rung numbers aren't mistaken for default-config measurements.
+        "config": "default" if dense_threshold is None else "sparse-forced",
     }
 
 
 def _emit(measured: dict, tag: str) -> None:
-    print(
-        json.dumps(
-            {
-                "metric": f"edges_relaxed_per_sec_per_chip[{tag}]",
-                "value": round(measured["edges_per_sec"], 1),
-                "unit": "edges/s",
-                "vs_baseline": round(measured["t_ref"] / measured["dt"], 3),
-            }
-        )
-    )
+    """ONE JSON line for the driver. ``detail`` carries platform + scale so
+    the metric series stays interpretable across platform flips (a CPU
+    fallback and an on-chip rung are distinguishable without reading
+    stderr)."""
+    out = {
+        "metric": f"edges_relaxed_per_sec_per_chip[{tag}]",
+        "value": round(measured["edges_per_sec"], 1),
+        "unit": "edges/s",
+        "vs_baseline": round(measured["t_ref"] / measured["dt"], 3),
+    }
+    detail = {
+        k: measured[k]
+        for k in ("platform", "scale", "n_sources", "dt", "t_ref",
+                  "oracle_ok", "repeats", "config")
+        if k in measured
+    }
+    if detail:
+        out["detail"] = detail
+    print(json.dumps(out))
 
 
 def _child_main(scale: int, n_sources: int, repeats: int) -> None:
-    """TPU attempt, run in a child process on the default (axon) platform."""
+    """TPU attempt, run in a child process on the default (axon) platform.
+
+    Every ramp rung is a FULL timed measurement that emits its own RESULT
+    line (tagged with its scale), not just a warm-up: if the tunnel wedges
+    partway up the ramp, the parent still holds the best completed on-chip
+    measurement instead of falling back to CPU. The rungs double as the
+    gradual fusion-size ramp (a huge first XLA program is a known
+    tunnel-wedge trigger on this device lease)."""
     import jax
 
     dev = jax.devices()[0]
+    # Guard the metric series: if the TPU plugin silently failed to load,
+    # jax falls back to CPU devices and every RESULT would be published
+    # under a tag claiming TPU. Crash instead (positive exit code = clean
+    # failure; the parent falls back to CPU with an honest tag). Not an
+    # assert: those vanish under PYTHONOPTIMIZE.
+    if dev.platform == "cpu":
+        raise SystemExit("child expected a TPU, got CPU devices")
     _stage(f"devices ok: {dev.platform}")
     # Trivial op first: confirms the compile path works before any big fusion.
-    assert int(jax.jit(lambda x: x + 1)(np.int32(1))) == 2
+    if int(jax.jit(lambda x: x + 1)(np.int32(1))) != 2:
+        raise SystemExit("probe op returned a wrong value")
     _stage("probe op ok")
-    measured = _run_config(scale, n_sources, repeats, ramp=True)
+    for s in RAMP_SCALES:
+        if s >= scale:
+            break
+        rung = _run_config(
+            s, min(n_sources, 2 ** s), 1, dense_threshold=0, label="rung"
+        )
+        print("RESULT " + json.dumps(rung), flush=True)
+        _stage(f"rung scale={s} measured")
+    measured = _run_config(scale, n_sources, repeats)
+    measured["final"] = True
     print("RESULT " + json.dumps(measured), flush=True)
 
 
@@ -178,8 +218,11 @@ def _tpu_attempt(
     first_stage_timeout: float | None = None,
     _cmd: list[str] | None = None,
 ) -> dict | None:
-    """Run the child, watching STAGE heartbeats. Returns the measured dict,
-    or None on timeout/failure (with ``_clean_failure`` noted for retry).
+    """Run the child, watching STAGE heartbeats and collecting RESULT lines
+    (one per ramp rung + one final). Returns the best measurement seen —
+    the final target if it completed, else the highest-scale rung — or None
+    on a resultless timeout, or ``{"_clean_failure": True}`` on a clean
+    crash with no results (worth one retry).
     ``first_stage_timeout`` bounds the wait for the FIRST heartbeat (device
     init — seconds when healthy, forever when the tunnel is wedged).
     ``_cmd`` overrides the child command line (watchdog tests)."""
@@ -207,7 +250,7 @@ def _tpu_attempt(
     fd = p.stdout.fileno()
     deadline = time.monotonic() + total_timeout
     stage_deadline = time.monotonic() + (first_stage_timeout or stage_timeout)
-    measured = None
+    results: list[dict] = []
     timed_out = False
     buf = b""
     try:
@@ -237,24 +280,58 @@ def _tpu_attempt(
                     stage_deadline = time.monotonic() + stage_timeout
                     print(f"[tpu] {line[6:]}", file=sys.stderr)
                 elif line.startswith("RESULT "):
-                    measured = json.loads(line[7:])
+                    # A RESULT is progress too — reset the stage watchdog.
+                    stage_deadline = time.monotonic() + stage_timeout
+                    results.append(json.loads(line[7:]))
         if eof:
             p.wait(30)
     except subprocess.TimeoutExpired:
         pass
     finally:
         _graceful_stop(p)
-    if measured is not None:
-        # A parsed RESULT is a complete, valid measurement even if the
-        # child subsequently wedged in device teardown and had to be
-        # stopped — don't discard a real TPU number for a teardown hang.
-        return measured
     # Only a positive exit code is a CLEAN crash worth retrying; negative
     # means killed by _graceful_stop (e.g. EOF then teardown wedge), and
     # retrying against a just-wedged tunnel burns the budget for nothing.
-    if not timed_out and p.returncode is not None and p.returncode > 0:
+    clean_crash = (
+        not timed_out and p.returncode is not None and p.returncode > 0
+    )
+    if results:
+        # Any parsed RESULT is a complete, valid on-chip measurement even
+        # if the child subsequently wedged (mid-ramp or in device teardown)
+        # and had to be stopped — don't discard a real TPU number. Prefer
+        # the final target; else the highest-scale rung that finished.
+        final = [r for r in results if r.get("final")]
+        best = final[-1] if final else max(
+            results, key=lambda r: r.get("scale", -1)
+        )
+        if clean_crash and not final:
+            # Crash mid-ramp on a healthy tunnel: flag for retry (which may
+            # reach the target) but keep the rung as the retry's floor.
+            best = dict(best, _clean_failure=True)
+        return best
+    if clean_crash:
         return {"_clean_failure": True}
     return None
+
+
+def _strip_retry_flag(m: dict | None) -> dict | None:
+    """A usable measurement (has edges_per_sec) with the retry flag
+    removed; None for no-result attempts (including bare
+    ``{"_clean_failure": True}``)."""
+    if m is None or "edges_per_sec" not in m:
+        return None
+    return {k: v for k, v in m.items() if k != "_clean_failure"}
+
+
+def _pick_best(floor: dict | None, retry: dict | None) -> dict | None:
+    """Merge a crashed first attempt's rung (``floor``) with the retry's
+    result: a completed final target always wins; otherwise the
+    higher-scale rung."""
+    if retry is None:
+        return floor
+    if floor is None or retry.get("final"):
+        return retry
+    return floor if floor.get("scale", -1) > retry.get("scale", -1) else retry
 
 
 def main() -> None:
@@ -277,7 +354,7 @@ def main() -> None:
     tag = f"rmat{scale}x{n_sources}src"
     if honor_cpu_platform_request():
         # Explicit CPU request (CI/smoke): run in-process, no device dance.
-        _emit(_run_config(scale, n_sources, repeats, ramp=False), tag + ",cpu")
+        _emit(_run_config(scale, n_sources, repeats), tag + ",cpu")
         return
 
     measured = _tpu_attempt(
@@ -285,15 +362,23 @@ def main() -> None:
         first_stage_timeout,
     )
     if measured is not None and measured.get("_clean_failure"):
+        # A rung captured before the crash is the retry's floor: if the
+        # retry does no better, emit the rung rather than nothing.
+        floor = _strip_retry_flag(measured)
         print("WARNING: TPU child crashed cleanly; retrying once",
               file=sys.stderr)
-        measured = _tpu_attempt(
+        retry = _strip_retry_flag(_tpu_attempt(
             scale, n_sources, repeats, total_timeout, stage_timeout,
             first_stage_timeout,
-        )
-        if measured is not None and measured.get("_clean_failure"):
-            measured = None
+        ))
+        measured = _pick_best(floor, retry)
     if measured is not None:
+        if not measured.get("final") and "scale" in measured:
+            # The target wedged mid-ramp; emit the best completed on-chip
+            # rung, honestly tagged with the scale that actually ran.
+            tag = (
+                f"rmat{measured['scale']}x{measured['n_sources']}src,tpu-rung"
+            )
         _emit(measured, tag)
         return
 
@@ -311,7 +396,7 @@ def main() -> None:
 
     jax.config.update("jax_platforms", "cpu")
     cpu_tag = f"rmat{cpu_scale}x{n_sources}src,cpu-fallback"
-    _emit(_run_config(cpu_scale, n_sources, repeats, ramp=False), cpu_tag)
+    _emit(_run_config(cpu_scale, n_sources, repeats), cpu_tag)
 
 
 if __name__ == "__main__":

@@ -79,12 +79,65 @@ def test_burst_lines_do_not_starve_watchdog():
     assert measured is not None
 
 
+def test_best_rung_kept_when_target_wedges():
+    """A wedge partway up the ramp returns the highest-scale completed
+    rung measurement, not None (round-3: no more resultless CPU
+    fallbacks when some on-chip rung finished)."""
+    measured = bench._tpu_attempt(
+        0, 0, 0, total_timeout=90, stage_timeout=12,
+        _cmd=_fake_child(
+            "import time\n"
+            "print('RESULT {\"edges_per_sec\": 1.0, \"dt\": 1.0, "
+            "\"t_ref\": 1.0, \"oracle_ok\": true, \"scale\": 10, "
+            "\"n_sources\": 128}', flush=True)\n"
+            "print('RESULT {\"edges_per_sec\": 2.0, \"dt\": 1.0, "
+            "\"t_ref\": 1.0, \"oracle_ok\": true, \"scale\": 13, "
+            "\"n_sources\": 128}', flush=True)\n"
+            "time.sleep(600)\n"  # wedge before the target completes
+        ),
+    )
+    assert measured is not None and measured["scale"] == 13
+    assert not measured.get("final")
+
+
+def test_final_result_preferred_over_rungs():
+    measured = bench._tpu_attempt(
+        0, 0, 0, total_timeout=60, stage_timeout=10,
+        _cmd=_fake_child(
+            "print('RESULT {\"edges_per_sec\": 9.0, \"dt\": 1.0, "
+            "\"t_ref\": 1.0, \"oracle_ok\": true, \"scale\": 13, "
+            "\"n_sources\": 128}', flush=True)\n"
+            "print('RESULT {\"edges_per_sec\": 4.0, \"dt\": 1.0, "
+            "\"t_ref\": 1.0, \"oracle_ok\": true, \"scale\": 16, "
+            "\"n_sources\": 128, \"final\": true}', flush=True)\n"
+        ),
+    )
+    assert measured is not None and measured.get("final")
+    assert measured["scale"] == 16
+
+
 def test_clean_crash_flagged_for_retry():
     measured = bench._tpu_attempt(
         0, 0, 0, total_timeout=30, stage_timeout=10,
         _cmd=_fake_child("raise SystemExit(3)"),
     )
     assert measured == {"_clean_failure": True}
+
+
+def test_clean_crash_after_rung_keeps_rung_and_retry_flag():
+    """A clean crash mid-ramp (healthy tunnel) must still request the
+    retry, but carry the completed rung as the retry's floor."""
+    measured = bench._tpu_attempt(
+        0, 0, 0, total_timeout=30, stage_timeout=10,
+        _cmd=_fake_child(
+            "print('RESULT {\"edges_per_sec\": 7.0, \"dt\": 1.0, "
+            "\"t_ref\": 1.0, \"oracle_ok\": true, \"scale\": 10, "
+            "\"n_sources\": 128}', flush=True)\n"
+            "raise SystemExit(3)\n"
+        ),
+    )
+    assert measured is not None
+    assert measured.get("_clean_failure") and measured["edges_per_sec"] == 7.0
 
 
 def test_first_stage_timeout_fails_fast():
@@ -101,6 +154,26 @@ def test_first_stage_timeout_fails_fast():
     )
     assert measured is None
     assert time.monotonic() - t0 < 45  # far below stage_timeout
+
+
+def test_retry_merge_semantics():
+    """main()'s crash-retry merge: final target beats any rung; otherwise
+    the higher-scale rung wins; no-result attempts strip to None."""
+    rung10 = {"edges_per_sec": 1.0, "scale": 10}
+    rung13 = {"edges_per_sec": 2.0, "scale": 13}
+    final16 = {"edges_per_sec": 3.0, "scale": 16, "final": True}
+
+    assert bench._strip_retry_flag(None) is None
+    assert bench._strip_retry_flag({"_clean_failure": True}) is None
+    stripped = bench._strip_retry_flag(dict(rung10, _clean_failure=True))
+    assert stripped == rung10
+
+    assert bench._pick_best(rung13, None) is rung13
+    assert bench._pick_best(None, rung10) is rung10
+    assert bench._pick_best(rung13, final16) is final16
+    assert bench._pick_best(rung13, rung10) is rung13  # higher scale wins
+    assert bench._pick_best(rung10, rung13) is rung13
+    assert bench._pick_best(None, None) is None
 
 
 def test_first_heartbeat_switches_to_stage_timeout():
