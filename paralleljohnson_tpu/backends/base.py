@@ -42,7 +42,10 @@ class KernelResult:
     edges_relaxed: edge relaxations performed — the attested instrumentation
       metric (BASELINE.json:2 "edges-relaxed/sec/chip"). Convention: a sweep
       counts every edge it scans; heap Dijkstra counts edges scanned from
-      settled vertices.
+      settled vertices; the dense min-plus regimes count candidate min-plus
+      operations (B x V^2 per iteration, V^3 per squaring) since their work
+      is independent of E. See the BASELINE.md convention note before
+      comparing across backends/regimes.
     """
 
     dist: Any  # np.ndarray or a device array (see docstring)

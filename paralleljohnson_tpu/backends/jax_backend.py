@@ -583,7 +583,19 @@ class JaxBackend(Backend):
                 num_nodes=v, max_iter=max_iter,
                 use_pallas=use_pallas, interpret=interpret,
             )
-            row_sweeps = int(iters) * int(sources.shape[0])
+            # Honest work accounting for the dense regimes (BASELINE.md
+            # convention note): candidate min-plus operations, NOT E edge
+            # scans — per-iteration cost from the kernel's own regime
+            # decision so the two can never drift.
+            work_per_iter = relax.dense_fanout_regime(
+                v, int(sources.shape[0])
+            )[1]
+            return KernelResult(
+                dist=dist,
+                converged=not bool(improving),
+                iterations=int(iters),
+                edges_relaxed=int(iters) * work_per_iter,
+            )
         elif layout == "vertex_major":
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
             src_bd, dst_bd, w_bd = dgraph.by_dst()

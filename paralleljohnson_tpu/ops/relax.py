@@ -466,14 +466,17 @@ def dense_fanout(a, sources, *, max_iter: int, k_block: int = 128, mp=None):
       - B <  V/2: iterate D <- D (x) A to fixpoint under while_loop
         (diameter iterations of cost B*V^2).
 
-    Returns (dist[B, V], iterations, still_improving). Weights must be
-    non-negative (post-reweighting), so still_improving after ``max_iter``
-    means unconverged, never a negative cycle.
+    Returns (dist[B, V], iterations, still_improving). Honest work
+    accounting is ``int(iterations) * dense_fanout_regime(v, b)[1]`` —
+    the regime decision and its per-iteration cost share one source of
+    truth. Weights must be non-negative (post-reweighting), so
+    still_improving after ``max_iter`` means unconverged, never a
+    negative cycle.
     """
     mp = mp or functools.partial(minplus, k_block=k_block)
     v = a.shape[0]
     b = sources.shape[0]
-    if 2 * b >= v:
+    if dense_fanout_regime(v, b)[0] == "squaring":
         full, steps = apsp_minplus_squaring(a, mp=mp)
         return full[sources, :], steps, jnp.bool_(False)
 
@@ -489,3 +492,14 @@ def dense_fanout(a, sources, *, max_iter: int, k_block: int = 128, mp=None):
         return nd, i + 1, jnp.any(nd < d)
 
     return lax.while_loop(cond, body, (d0, jnp.int32(0), jnp.bool_(True)))
+
+
+def dense_fanout_regime(v: int, b: int) -> tuple[str, int]:
+    """(regime, work_per_iter) for :func:`dense_fanout` at static shapes
+    (V, B): ``("squaring", V^3)`` when most rows are wanted anyway
+    (2B >= V), else ``("iterate", B*V^2)`` — candidate min-plus ops per
+    reported iteration. Single source of truth for the regime pick AND
+    its work accounting (they must never drift apart)."""
+    if 2 * b >= v:
+        return "squaring", v * v * v
+    return "iterate", b * v * v

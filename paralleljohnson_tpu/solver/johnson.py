@@ -70,9 +70,14 @@ class SolveResult:
 
     @property
     def matrix(self) -> np.ndarray:
-        """Distance matrix ordered by source vertex id (full APSP only)."""
+        """Distance matrix ordered by source vertex id (full APSP only).
+
+        This is the explicit HOST-materialization point: ``dist`` may be a
+        device array (see its docstring), and indexing it with a host
+        permutation would otherwise yield another device array. Use
+        ``result.dist`` directly to stay on device."""
         order = np.argsort(self.sources)
-        return self.dist[order]
+        return np.asarray(self.dist)[order]
 
     def path(self, source: int, target: int) -> list[int]:
         """Vertex sequence of a shortest ``source -> target`` path (empty if
@@ -216,8 +221,17 @@ class ParallelJohnsonSolver:
         Returns :class:`ReducedResult` with ``values`` = the per-batch
         reduction results in batch order. Negative-cycle/convergence
         semantics match :meth:`solve`; checkpointing is not applied (the
-        point of this mode is that rows are never materialized).
+        point of this mode is that rows are never materialized), and
+        ``config.validate`` is rejected for the same reason — the scipy
+        oracle would need the full matrix (mirrors the CLI's
+        --validate/--reduce exclusion).
         """
+        if self.config.validate:
+            raise ValueError(
+                "config.validate is incompatible with solve_reduced: "
+                "streaming mode never materializes the rows the oracle "
+                "check needs"
+            )
         if isinstance(reduce_rows, str):
             try:
                 reduce_rows = _ROW_REDUCERS[reduce_rows]

@@ -28,6 +28,16 @@ def finite_frac(rows) -> float:
 
 def finite_checksum(rows) -> float:
     """Sum of finite entries (the streamed-rows reduction of the RMAT
-    benchmark config)."""
+    benchmark config).
+
+    Accumulates per-ROW partial sums in the rows' dtype on device, then
+    combines them in float64 on the host: at RMAT-22 scale (~5e8 finite
+    f32 entries, totals ~1.25e9) a flat f32 accumulation is sensitive to
+    reduction order — BASELINE.md shows jax-vs-cpp checksums diverging in
+    the 7th digit. Per-row sums (~V terms each) keep the device reduction
+    cheap while the f64 host combine removes the cross-row order
+    sensitivity. (TPUs have no native f64; summing on host in f64 over
+    [B] partials costs nothing.)"""
     m = xp(rows)
-    return float(m.where(m.isfinite(rows), rows, 0.0).sum())
+    row_sums = m.where(m.isfinite(rows), rows, 0.0).sum(axis=-1)
+    return float(np.asarray(row_sums, dtype=np.float64).sum())

@@ -1,0 +1,91 @@
+"""On-chip probe: what does the XLA row-gather rate depend on, and how
+fast are the candidate replacements? Informs the round-3 sweep-kernel
+design (VERDICT r2 missing #3).
+
+METHODOLOGY (learned the hard way on this device lease):
+``jax.block_until_ready`` does NOT synchronize through the axon remote
+tunnel — timings taken with it are pure dispatch overhead (22 TB/s
+"bandwidths"). Every measurement here (a) chains ``ITERS`` dependent
+iterations inside one jit so per-call overhead amortizes, and (b) syncs
+by downloading a scalar (``float(...)``), which does block.
+
+Measured 2026-07-30 on the v5e (kept for the record; see BASELINE.md):
+  - XLA row gather from [V, B] f32 runs at a fixed ~70-92 Mrows/s for
+    B=128 (~10 cycles/row; 36-47 GB/s) at V=2^16 AND V=2^20 — the rate
+    is per-ROW, so wide rows buy bandwidth: B=512 gathers at 44 Mrows/s
+    = 90 GB/s.
+  - One full vm sweep (gather + sorted segment_min + min) at rmat-16
+    shape: 18.4 ms; at rmat-20 shape (V=2^20, E=2^24): 255 ms — ~12x
+    less than the ~3.1 s/sweep the production fan-out measured, so the
+    production gap is chunking/carry overhead, not the gather itself.
+
+Run: python scripts/tpu_gather_probe.py  (needs the live tunnel)
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+ITERS = 10
+HBM_BUDGET = 12 << 30  # leave headroom under the v5e's 15.75 GB limit
+
+
+def timed(fn, *args):
+    """Amortized per-iteration seconds; scalar download = hard sync."""
+    float(fn(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best / ITERS
+
+
+@jax.jit
+def loop_gather(d, i):
+    def body(k, acc):
+        cand = d[(i + k) % d.shape[0], :]
+        return jnp.minimum(acc, cand.min(axis=0))
+    return lax.fori_loop(
+        0, ITERS, body, jnp.full((d.shape[1],), jnp.inf)
+    ).sum()
+
+
+@jax.jit
+def loop_sweep(d, i_s, ww):
+    def body(k, dd):
+        cand = dd[i_s, :] + (ww[:, None] + k)
+        upd = jax.ops.segment_min(
+            cand, i_s, num_segments=dd.shape[0], indices_are_sorted=True
+        )
+        return jnp.minimum(dd, upd)
+    return lax.fori_loop(0, ITERS, body, d).sum()
+
+
+def main():
+    rng = np.random.default_rng(0)
+    print("device:", jax.devices()[0], flush=True)
+    for v_log, e_log, b in [(16, 20, 128), (20, 24, 128), (16, 22, 512)]:
+        v, e = 1 << v_log, 1 << e_log
+        # The [E, B] candidate block is the peak temp; gate on the budget.
+        if e * b * 4 * 2 > HBM_BUDGET:
+            print(f"V=2^{v_log} E=2^{e_log} B={b}: skipped (exceeds HBM budget)")
+            continue
+        dist = jnp.asarray(rng.random((v, b), dtype=np.float32))
+        idx = jnp.asarray(rng.integers(0, v, e, dtype=np.int32))
+        idx_s = jnp.sort(idx)
+        w = jnp.asarray(rng.random(e, dtype=np.float32))
+        dt = timed(loop_gather, dist, idx)
+        print(f"V=2^{v_log} E=2^{e_log} B={b}: gather   {dt*1e3:8.2f} ms/it "
+              f"({e/dt/1e6:8.1f} Mrows/s, {e*b*4/dt/1e9:6.1f} GB/s)", flush=True)
+        dt = timed(loop_sweep, dist, idx_s, w)
+        print(f"V=2^{v_log} E=2^{e_log} B={b}: vm sweep {dt*1e3:8.2f} ms/it "
+              f"({e/dt/1e6:8.1f} Medges/s)", flush=True)
+        del dist, idx, idx_s, w
+
+
+if __name__ == "__main__":
+    main()

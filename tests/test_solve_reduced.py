@@ -71,3 +71,18 @@ def test_custom_callable_reducer():
     assert seen and seen[0][1] == 16
     # rows reached the reducer as a device array, not a host copy
     assert seen[0][0] != "ndarray"
+
+
+def test_solve_reduced_rejects_validate():
+    """config.validate needs the full matrix; streaming mode must refuse it
+    (mirrors the CLI --validate/--reduce exclusion)."""
+    import pytest
+
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.graphs import erdos_renyi
+    from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+    g = erdos_renyi(64, 0.1, seed=3)
+    solver = ParallelJohnsonSolver(SolverConfig(backend="jax", validate=True))
+    with pytest.raises(ValueError, match="validate"):
+        solver.solve_reduced(g, reduce_rows="checksum")
