@@ -20,6 +20,10 @@ from paralleljohnson_tpu.backends.base import Backend, KernelResult, register_ba
 from paralleljohnson_tpu.graphs import CSRGraph
 from paralleljohnson_tpu.ops import relax
 
+# Inner-fixpoint cap of the blocked Gauss-Seidel kernels: bounds extra
+# per-block propagation per visit (never correctness — see ops/gauss_seidel).
+GS_INNER_CAP = 64
+
 
 @dataclasses.dataclass(frozen=True)
 class JaxDeviceGraph:
@@ -36,6 +40,12 @@ class JaxDeviceGraph:
     indptr: np.ndarray  # host-side int32[V+1] (row structure, rarely needed)
     num_nodes: int
     num_real_edges: int
+    # Reference to the uploaded host CSR (no copy — the caller's arrays).
+    # Consumed by host preprocessing (Gauss-Seidel RCM layout); cleared by
+    # reweight(), whose new weights exist only on device.
+    host_graph: CSRGraph | None = dataclasses.field(
+        default=None, compare=False, repr=False
+    )
     _by_dst_cache: dict = dataclasses.field(
         default_factory=dict, compare=False, repr=False
     )
@@ -70,6 +80,37 @@ class JaxDeviceGraph:
             self._by_dst_cache["max_deg"] = cached
         return cached
 
+    def gs_layout(self, vb: int) -> dict | None:
+        """Device-resident blocked Gauss-Seidel layout (RCM relabeling +
+        dst-block edge buckets — ``ops.gauss_seidel.build_gs_layout``),
+        built lazily from the host CSR and cached. None when the host
+        graph is unavailable (post-reweight)."""
+        if self.host_graph is None:
+            return None
+        cached = self._by_dst_cache.get(("gs", vb))
+        if cached is None:
+            from paralleljohnson_tpu.ops.gauss_seidel import build_gs_layout
+
+            g = self.host_graph
+            host = build_gs_layout(
+                g.indptr, g.indices, g.weights, g.num_nodes, vb=vb
+            )
+            cached = {
+                "rank_host": host["rank"],
+                "rank": jnp.asarray(host["rank"], jnp.int32),
+                "src_blk": jnp.asarray(host["src_blk"], jnp.int32),
+                "dstl_blk": jnp.asarray(host["dstl_blk"], jnp.int32),
+                "w_blk": jnp.asarray(host["w_blk"], self.weights.dtype),
+                "real_edges_blk": jnp.asarray(
+                    host["real_edges_blk"], jnp.float32
+                ),
+                "vb": host["vb"],
+                "v_pad": host["v_pad"],
+                "halo": host["halo"],
+            }
+            self._by_dst_cache[("gs", vb)] = cached
+        return cached
+
 
 def _edge_chunk_for(batch: int, num_edges: int, budget_elems: int = 1 << 26) -> int:
     """Bound the [B, chunk] relaxation intermediate to ~``budget_elems``
@@ -101,6 +142,46 @@ def _bf_frontier_kernel(
         max_degree=max_degree, num_real_edges=num_real_edges,
         edge_chunk=edge_chunk,
     )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("vb", "halo", "max_outer", "inner_cap")
+)
+def _gs_kernel(
+    dist0, src_blk, dstl_blk, w_blk, real_edges_blk, rank, *,
+    vb: int, halo: int, max_outer: int, inner_cap: int,
+):
+    """Blocked Gauss-Seidel SSSP in relabeled ids; returns dist already
+    mapped back to ORIGINAL vertex labels."""
+    from paralleljohnson_tpu.ops.gauss_seidel import sssp_gs_blocks
+
+    dist, rounds, improving, examined = sssp_gs_blocks(
+        dist0, src_blk, dstl_blk, w_blk, real_edges_blk,
+        vb=vb, halo=halo, max_outer=max_outer, inner_cap=inner_cap,
+    )
+    return dist[rank], rounds, improving, examined
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("v_pad", "vb", "halo", "max_outer", "inner_cap"),
+)
+def _gs_fanout_kernel(
+    sources, src_blk, dstl_blk, w_blk, real_edges_blk, rank, *,
+    v_pad: int, vb: int, halo: int, max_outer: int, inner_cap: int,
+):
+    """Blocked Gauss-Seidel fan-out (vertex-major, relabeled ids);
+    returns dist [B, V-original-labels]."""
+    from paralleljohnson_tpu.ops.gauss_seidel import fanout_gs_blocks
+
+    b = sources.shape[0]
+    dist0 = jnp.full((v_pad, b), jnp.inf, w_blk.dtype)
+    dist0 = dist0.at[rank[sources], jnp.arange(b)].set(0.0)
+    dist, rounds, improving, examined = fanout_gs_blocks(
+        dist0, src_blk, dstl_blk, w_blk, real_edges_blk,
+        vb=vb, halo=halo, max_outer=max_outer, inner_cap=inner_cap,
+    )
+    return dist[rank, :].T, rounds, improving, examined
 
 
 @functools.partial(
@@ -251,6 +332,7 @@ class JaxBackend(Backend):
             indptr=graph.indptr,
             num_nodes=graph.num_nodes,
             num_real_edges=graph.num_real_edges,
+            host_graph=graph,
         )
 
     def download_graph(self, dgraph: JaxDeviceGraph) -> CSRGraph:
@@ -308,15 +390,22 @@ class JaxBackend(Backend):
             return False
         return dgraph.num_real_edges >= self.config.dense_min_density * v * v
 
+    @staticmethod
+    def _low_degree_family(dgraph: JaxDeviceGraph) -> bool:
+        """The road/grid graph family both the frontier and Gauss-Seidel
+        paths target: non-tiny, low max out-degree (hub-heavy graphs
+        would pad every gather tile to the hub degree). One definition so
+        the two routes can never drift apart."""
+        return dgraph.num_nodes >= 512 and 0 < dgraph.max_degree <= 32
+
     def _use_frontier(self, dgraph: JaxDeviceGraph) -> bool:
         """Frontier compaction pays when the out-edge gather tile
-        (capacity x max_degree) is small next to E — low-max-degree,
-        non-tiny graphs (road networks, grids). Hub-heavy graphs (R-MAT)
-        would pad every frontier row to the hub degree."""
+        (capacity x max_degree) is small next to E — the low-degree
+        family (road networks, grids)."""
         flag = self.config.frontier
         if flag != "auto":
             return bool(flag)
-        return dgraph.num_nodes >= 512 and 0 < dgraph.max_degree <= 32
+        return self._low_degree_family(dgraph)
 
     def _frontier_capacity(self, dgraph: JaxDeviceGraph) -> int:
         """Static frontier-id buffer size: big enough that road/grid
@@ -345,18 +434,39 @@ class JaxBackend(Backend):
             self._edge_mesh_cache = cached
         return cached
 
+    def _use_gs(self, dgraph: JaxDeviceGraph) -> bool:
+        """Blocked Gauss-Seidel targets the same low-max-degree graph
+        family as the frontier path (road/grid); "auto" picks it on TPU,
+        where the frontier's per-round fixed cost (~15 ms of scatter +
+        nonzero, BASELINE.md round-3 notes) makes round COUNT the only
+        lever — on CPU the frontier's compacted work measures faster.
+        Requires the host CSR (pre-reweight) for the RCM preprocessing."""
+        flag = self.config.gauss_seidel
+        if flag is False or dgraph.host_graph is None:
+            return False
+        if flag is True:
+            return True
+        if self.config.frontier is True:
+            # An explicitly forced frontier path wins over gauss_seidel
+            # "auto" — "True forces" must hold for either flag.
+            return False
+        return (
+            jax.default_backend() == "tpu"
+            and self._low_degree_family(dgraph)
+        )
+
     def _use_edge_shard(self, dgraph: JaxDeviceGraph) -> bool:
         """Edge sharding is the only way a multi-device mesh helps a B=1
         solve. Precedence: an explicit ``edge_shard=True`` wins (the
         documented scale-out escape hatch for edge lists beyond one
-        chip's HBM); ``"auto"`` defers to the frontier path on
-        low-degree graphs where frontier compaction is work-optimal."""
+        chip's HBM); ``"auto"`` defers to the frontier/Gauss-Seidel
+        paths on low-degree graphs where they are work-optimal."""
         flag = self.config.edge_shard
         if flag is False or self._mesh().devices.size <= 1:
             return False
         if flag is True:
             return True
-        return not self._use_frontier(dgraph)
+        return not (self._use_frontier(dgraph) or self._use_gs(dgraph))
 
     def bellman_ford(self, dgraph: JaxDeviceGraph, source: int | None) -> KernelResult:
         v = dgraph.num_nodes
@@ -386,6 +496,29 @@ class JaxBackend(Backend):
                 iterations=iters,
                 # Each round relaxes the full edge list (across shards).
                 edges_relaxed=iters * dgraph.num_real_edges,
+            )
+        if self._use_gs(dgraph):
+            bundle = dgraph.gs_layout(self.config.gs_block_size)
+            dist0_gs = jnp.full(bundle["v_pad"], jnp.inf, self._dtype)
+            if source is None:
+                # Virtual source: 0 at every REAL vertex, +inf pads.
+                dist0_gs = dist0_gs.at[: v].set(0.0)
+            else:
+                dist0_gs = dist0_gs.at[int(bundle["rank_host"][source])].set(0.0)
+            dist, rounds, improving, examined = _gs_kernel(
+                dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
+                bundle["w_blk"], bundle["real_edges_blk"], bundle["rank"],
+                vb=bundle["vb"], halo=bundle["halo"],
+                max_outer=max_iter, inner_cap=GS_INNER_CAP,
+            )
+            iters = int(rounds)
+            improving = bool(improving)
+            return KernelResult(
+                dist=dist,
+                negative_cycle=improving and max_iter >= v,
+                converged=not improving,
+                iterations=iters,
+                edges_relaxed=int(examined),
             )
         if self._use_frontier(dgraph):
             dist, iters, improving, examined = _bf_frontier_kernel(
@@ -490,12 +623,17 @@ class JaxBackend(Backend):
         )
 
     def _pallas_mode(self) -> tuple[bool, bool]:
-        """(use_pallas, interpret): "auto" = compiled Pallas on TPU only;
-        True forces it anywhere (interpret-mode off-TPU, for CI)."""
+        """(use_pallas, interpret): "auto" = the measured winner, which on
+        the real chip is the XLA blocked min-plus — the Pallas tile kernel
+        measured 88.3 ms vs XLA's 77.3 ms at V=2048 (transpose-bound; see
+        ops/pallas_kernels.py notes and BASELINE.md round-2 rows), so
+        shipping it as the TPU default contradicted measure-then-decide.
+        Pallas stays an explicit opt-in: use_pallas=True forces it
+        anywhere (compiled on TPU, interpret-mode off-TPU for CI)."""
         flag = self.config.use_pallas
         on_tpu = jax.default_backend() == "tpu"
         if flag == "auto":
-            return on_tpu, False
+            return False, False
         return bool(flag), bool(flag) and not on_tpu
 
     def _mesh(self):
@@ -538,6 +676,15 @@ class JaxBackend(Backend):
         max_iter = self.config.max_iterations or v
         mesh = self._mesh()
         layout = self._resolve_layout()
+        if self.config.gauss_seidel is True and mesh.devices.size > 1:
+            # The blocked GS fan-out is single-device (its sequential
+            # block schedule is the algorithm); refuse loudly rather than
+            # silently running the sharded sweeps under a forced flag.
+            raise NotImplementedError(
+                "gauss_seidel=True fan-out is single-device; set "
+                "mesh_shape=(1,) (or leave gauss_seidel='auto' to use "
+                "the sharded sweep path on this mesh)"
+            )
         if "edges" in mesh.axis_names:
             # 2-D ("sources", "edges") mesh: rows AND edge slices sharded.
             from paralleljohnson_tpu.parallel import sharded_fanout_2d
@@ -575,6 +722,21 @@ class JaxBackend(Backend):
                 mesh, sources, *edges,
                 num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
                 layout=layout, with_row_sweeps=True,
+            )
+        elif self._use_gs(dgraph):
+            bundle = dgraph.gs_layout(self.config.gs_block_size)
+            dist, rounds, improving, examined = _gs_fanout_kernel(
+                sources, bundle["src_blk"], bundle["dstl_blk"],
+                bundle["w_blk"], bundle["real_edges_blk"], bundle["rank"],
+                v_pad=bundle["v_pad"], vb=bundle["vb"],
+                halo=bundle["halo"], max_outer=max_iter,
+                inner_cap=GS_INNER_CAP,
+            )
+            return KernelResult(
+                dist=dist,
+                converged=not bool(improving),
+                iterations=int(rounds),
+                edges_relaxed=int(examined),
             )
         elif self._use_dense(dgraph):
             use_pallas, interpret = self._pallas_mode()
@@ -629,6 +791,9 @@ class JaxBackend(Backend):
             # dataclasses.replace would carry the old cache over — the
             # dst-sorted weights must be re-derived from the new weights.
             _by_dst_cache={},
+            # The host CSR still holds PRE-reweight weights; the GS layout
+            # must not be built from it for the reweighted graph.
+            host_graph=None,
         )
 
     def batch_apsp(self, batch: dict[str, np.ndarray]) -> KernelResult:

@@ -42,8 +42,11 @@ class SolverConfig:
         V^2/16 edges the sparse path wins even on small graphs). 0 makes
         ``dense_threshold`` alone decide (tests).
       edge_pad_multiple: pad E to this multiple for stable jit shapes.
-      use_pallas: ``"auto"`` (Pallas dense kernels on TPU, XLA elsewhere),
-        ``True`` (force, interpret-mode off-TPU — tests), or ``False``.
+      use_pallas: ``"auto"`` (the measured winner — currently the XLA
+        blocked min-plus everywhere; the Pallas tile kernel measured
+        slower on-chip, see ``ops/pallas_kernels.py``), ``True`` (force
+        Pallas: compiled on TPU, interpret-mode off-TPU — tests), or
+        ``False``.
       fanout_layout: sparse fan-out data layout — ``"vertex_major"``
         (dist [V, B], dst-sorted edges, sorted segment reduction: no
         scatter on TPU), ``"source_major"`` (dist [B, V], flattened-id
@@ -59,6 +62,21 @@ class SolverConfig:
       frontier_capacity: static frontier-id buffer size (rounds whose
         active set exceeds it fall back to one full sweep); ``None``
         sizes it from V (see ``JaxBackend._frontier_capacity``).
+      gauss_seidel: blocked Gauss-Seidel SSSP over an RCM-relabeled,
+        destination-block-bucketed edge layout — the high-diameter
+        round-COUNT mitigation (outer rounds ~ path direction changes,
+        not diameter; see ``ops.gauss_seidel``). ``"auto"`` enables it on
+        TPU for the same low-max-degree graphs the frontier path targets
+        (on CPU the frontier path measures faster; on TPU the frontier's
+        per-round scatter+nonzero cost dominates). True forces (given the
+        host graph is available) — except the FAN-OUT on a multi-device
+        mesh, which raises: the sequential block schedule is single
+        device; "auto" defers to the sharded sweep paths there. An
+        explicit ``frontier=True`` beats gauss_seidel="auto".
+        False disables.
+      gs_block_size: vertices per Gauss-Seidel block (the inner-fixpoint
+        unit; bigger blocks = fewer, larger device ops but more inner
+        iterations per block).
       edge_shard: shard the EDGE LIST across the mesh for single-source
         Bellman-Ford (dist replicated, one pmin all-reduce per sweep) —
         the scale-out axis when the edge list exceeds one chip's HBM,
@@ -83,6 +101,8 @@ class SolverConfig:
     fanout_layout: str = "auto"
     frontier: bool | str = "auto"
     frontier_capacity: int | None = None
+    gauss_seidel: bool | str = "auto"
+    gs_block_size: int = 4096
     edge_shard: bool | str = "auto"
     checkpoint_dir: str | None = None
     validate: bool = False
@@ -106,6 +126,11 @@ class SolverConfig:
         if self.frontier not in (True, False, "auto"):
             raise ValueError(
                 f"frontier must be True/False/'auto', got {self.frontier!r}"
+            )
+        if self.gauss_seidel not in (True, False, "auto"):
+            raise ValueError(
+                "gauss_seidel must be True/False/'auto', "
+                f"got {self.gauss_seidel!r}"
             )
         if self.edge_shard not in (True, False, "auto"):
             raise ValueError(
