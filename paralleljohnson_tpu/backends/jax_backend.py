@@ -24,6 +24,11 @@ from paralleljohnson_tpu.ops import relax
 # per-block propagation per visit (never correctness — see ops/gauss_seidel).
 GS_INNER_CAP = 64
 
+# Dst-block size of the blocked vertex-major fan-out; graphs with V above
+# this route to the blocked sweep (below it, plain full-V segments are
+# already this small). [VM_BLOCK, B] update slices are 32 MB at B=128.
+VM_BLOCK = 1 << 16
+
 
 @dataclasses.dataclass(frozen=True)
 class JaxDeviceGraph:
@@ -41,12 +46,21 @@ class JaxDeviceGraph:
     num_nodes: int
     num_real_edges: int
     # Reference to the uploaded host CSR (no copy — the caller's arrays).
-    # Consumed by host preprocessing (Gauss-Seidel RCM layout); cleared by
-    # reweight(), whose new weights exist only on device.
+    # Consumed by host preprocessing (Gauss-Seidel RCM layout, dst-blocked
+    # fan-out layout). After reweight() the STRUCTURE stays valid but the
+    # host weights are stale (the reweighted weights exist only on
+    # device) — host_weights_stale gates the consumers that read them.
     host_graph: CSRGraph | None = dataclasses.field(
         default=None, compare=False, repr=False
     )
+    host_weights_stale: bool = False
     _by_dst_cache: dict = dataclasses.field(
+        default_factory=dict, compare=False, repr=False
+    )
+    # Weight-INDEPENDENT preprocessing (dst-blocked chunk structure),
+    # keyed by layout params. reweight() carries this dict object over,
+    # so the host-side sort/bucketing runs once per graph structure.
+    _struct_cache: dict = dataclasses.field(
         default_factory=dict, compare=False, repr=False
     )
 
@@ -80,12 +94,48 @@ class JaxDeviceGraph:
             self._by_dst_cache["max_deg"] = cached
         return cached
 
+    def vm_blocked_layout(self, vb: int, ec: int) -> dict | None:
+        """Device-resident dst-blocked fan-out layout
+        (``ops.relax.build_vm_blocked_layout``): weight-independent chunk
+        structure cached across reweight in ``_struct_cache``; the chunk
+        weights are gathered from the CURRENT device weights (so the
+        layout serves the reweighted graph too) and cached per instance.
+        None when no host structure is available."""
+        if self.host_graph is None:
+            return None
+        key = ("vmb", vb, ec)
+        struct = self._struct_cache.get(key)
+        if struct is None:
+            g = self.host_graph
+            host = relax.build_vm_blocked_layout(
+                g.indptr, g.indices, g.num_nodes, vb=vb, ec=ec
+            )
+            struct = {
+                "src_ck": jnp.asarray(host["src_ck"], jnp.int32),
+                "dstl_ck": jnp.asarray(host["dstl_ck"], jnp.int32),
+                "base_ck": jnp.asarray(host["base_ck"], jnp.int32),
+                "edge_order": jnp.asarray(host["edge_order"], jnp.int32),
+                "vb": vb,
+                "v_pad": vb * max(1, -(-self.num_nodes // vb)),
+            }
+            self._struct_cache[key] = struct
+        w_ck = self._by_dst_cache.get(key)
+        if w_ck is None:
+            order = struct["edge_order"]
+            w_ck = jnp.where(
+                order >= 0,
+                self.weights[jnp.maximum(order, 0)],
+                jnp.inf,
+            ).astype(self.weights.dtype)
+            self._by_dst_cache[key] = w_ck
+        return {**struct, "w_ck": w_ck}
+
     def gs_layout(self, vb: int) -> dict | None:
         """Device-resident blocked Gauss-Seidel layout (RCM relabeling +
         dst-block edge buckets — ``ops.gauss_seidel.build_gs_layout``),
         built lazily from the host CSR and cached. None when the host
-        graph is unavailable (post-reweight)."""
-        if self.host_graph is None:
+        weights are unavailable (post-reweight: the builder reads them)."""
+        if self.host_graph is None or self.host_weights_stale:
             return None
         cached = self._by_dst_cache.get(("gs", vb))
         if cached is None:
@@ -194,6 +244,26 @@ def _fanout_kernel(
     return relax.bellman_ford_sweeps(
         dist0, src, dst, w, max_iter=max_iter, edge_chunk=edge_chunk
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_nodes", "v_pad", "vb", "max_iter"),
+)
+def _fanout_vm_blocked_kernel(
+    sources, src_ck, dstl_ck, w_ck, base_ck, *,
+    num_nodes: int, v_pad: int, vb: int, max_iter: int,
+):
+    """Dst-blocked vertex-major fan-out (ops.relax dst-blocked sweep):
+    avoids the full-V per-chunk segment writes of the plain vm kernel at
+    large V. Returns dist [B, V] (pad rows trimmed)."""
+    b = sources.shape[0]
+    dist0 = jnp.full((v_pad, b), jnp.inf, w_ck.dtype)
+    dist0 = dist0.at[sources, jnp.arange(b)].set(0.0)
+    dist, iters, improving = relax.bellman_ford_sweeps_vm_blocked(
+        dist0, src_ck, dstl_ck, w_ck, base_ck, vb=vb, max_iter=max_iter
+    )
+    return dist[:num_nodes].T, iters, improving
 
 
 @functools.partial(
@@ -440,9 +510,14 @@ class JaxBackend(Backend):
         where the frontier's per-round fixed cost (~15 ms of scatter +
         nonzero, BASELINE.md round-3 notes) makes round COUNT the only
         lever — on CPU the frontier's compacted work measures faster.
-        Requires the host CSR (pre-reweight) for the RCM preprocessing."""
+        Requires the host CSR with VALID weights (the RCM layout builder
+        reads them — post-reweight they are stale)."""
         flag = self.config.gauss_seidel
-        if flag is False or dgraph.host_graph is None:
+        if (
+            flag is False
+            or dgraph.host_graph is None
+            or dgraph.host_weights_stale
+        ):
             return False
         if flag is True:
             return True
@@ -676,10 +751,17 @@ class JaxBackend(Backend):
         max_iter = self.config.max_iterations or v
         mesh = self._mesh()
         layout = self._resolve_layout()
-        if self.config.gauss_seidel is True and mesh.devices.size > 1:
+        if (
+            self.config.gauss_seidel is True
+            and mesh.devices.size > 1
+            and self._use_gs(dgraph)
+        ):
             # The blocked GS fan-out is single-device (its sequential
             # block schedule is the algorithm); refuse loudly rather than
             # silently running the sharded sweeps under a forced flag.
+            # When GS is ineligible anyway (post-reweight stale host
+            # weights), the sharded fallback is the correct path — don't
+            # fail a full Johnson solve at its fan-out phase.
             raise NotImplementedError(
                 "gauss_seidel=True fan-out is single-device; set "
                 "mesh_shape=(1,) (or leave gauss_seidel='auto' to use "
@@ -760,11 +842,32 @@ class JaxBackend(Backend):
             )
         elif layout == "vertex_major":
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
-            src_bd, dst_bd, w_bd = dgraph.by_dst()
-            dist, iters, improving = _fanout_vm_kernel(
-                sources, src_bd, dst_bd, w_bd,
-                num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+            # The layout's chunk size is derived from the batch size
+            # ROUNDED UP to a power of two, so ragged final batches
+            # (e.g. 104 of 128) reuse the canonical layout instead of
+            # triggering an O(E) host rebuild + duplicate device upload.
+            lay_chunk = _edge_chunk_for(
+                1 << max(0, int(sources.shape[0]) - 1).bit_length(),
+                dgraph.src.shape[0],
             )
+            lay = (
+                dgraph.vm_blocked_layout(VM_BLOCK, lay_chunk)
+                if v > VM_BLOCK else None
+            )
+            if lay is not None:
+                # Large graphs: dst-blocked sweep — per-chunk segment
+                # writes are [vb, B], not [V, B] (see ops.relax notes).
+                dist, iters, improving = _fanout_vm_blocked_kernel(
+                    sources, lay["src_ck"], lay["dstl_ck"], lay["w_ck"],
+                    lay["base_ck"], num_nodes=v, v_pad=lay["v_pad"],
+                    vb=lay["vb"], max_iter=max_iter,
+                )
+            else:
+                src_bd, dst_bd, w_bd = dgraph.by_dst()
+                dist, iters, improving = _fanout_vm_kernel(
+                    sources, src_bd, dst_bd, w_bd,
+                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                )
             row_sweeps = int(iters) * int(sources.shape[0])
         else:
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
@@ -789,11 +892,14 @@ class JaxBackend(Backend):
             dgraph,
             weights=_reweight_kernel(dgraph.weights, dgraph.src, dgraph.dst, h),
             # dataclasses.replace would carry the old cache over — the
-            # dst-sorted weights must be re-derived from the new weights.
+            # dst-sorted / chunk weights must be re-derived from the new
+            # weights. _struct_cache (weight-independent) is deliberately
+            # carried: replace() keeps the same dict object.
             _by_dst_cache={},
-            # The host CSR still holds PRE-reweight weights; the GS layout
-            # must not be built from it for the reweighted graph.
-            host_graph=None,
+            # The host CSR still holds PRE-reweight weights; consumers
+            # that read them (GS layout) are gated off by this flag while
+            # structure-only consumers keep working.
+            host_weights_stale=True,
         )
 
     def batch_apsp(self, batch: dict[str, np.ndarray]) -> KernelResult:

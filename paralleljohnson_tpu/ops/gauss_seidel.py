@@ -245,17 +245,15 @@ def build_gs_layout(
     rank = np.empty(v, np.int32)
     rank[perm] = np.arange(v, dtype=np.int32)
 
+    from paralleljohnson_tpu.ops.relax import bucket_edges_by_dst_block
+
     src_n = rank[src]
     dst_n = rank[indices]
     nb = max(1, -(-v // vb))
     v_pad = nb * vb
-    block = dst_n // vb
-    halo = int(np.abs(src_n // vb - block).max()) if e else 0
-    order = np.lexsort((dst_n, block))
-    src_n, dst_n, w_n, block = (
-        src_n[order], dst_n[order], weights[order], block[order]
-    )
-    counts = np.bincount(block, minlength=nb)
+    halo = int(np.abs(src_n // vb - dst_n // vb).max()) if e else 0
+    order, counts = bucket_edges_by_dst_block(dst_n, vb, nb)
+    src_n, dst_n, w_n = src_n[order], dst_n[order], weights[order]
     em = int(max(counts.max(), 1))
     em = -(-em // pad_multiple) * pad_multiple
 

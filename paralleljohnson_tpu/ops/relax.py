@@ -204,6 +204,132 @@ def bellman_ford_sweeps_vm(
     return dist, iters, improving
 
 
+# -- dst-blocked vertex-major sweep (large-graph fan-out) --------------------
+#
+# The plain vm sweep streams edge chunks with lax.scan and segment_mins
+# every chunk into ALL V segments: at rmat-20 (V=2^20, B=128) each of the
+# ~32 chunks writes a full [V, B] = 537 MB update that is then min-merged
+# into the carry — ~50 GB of pure bookkeeping traffic per sweep. Measured
+# on-chip (BASELINE.md round-3 notes): the production kernel ran ~3.1 s/
+# sweep while one clean unchunked sweep of the same shapes is 255 ms.
+# Partitioning the dst-sorted edges by destination BLOCK (vb vertices)
+# at upload lets each chunk reduce into [vb, B] local segments and merge
+# one [vb, B] slice of the carry — the full-V write amplification is gone
+# while the [Ec, B] candidate intermediate stays bounded.
+
+
+def bucket_edges_by_dst_block(dst, vb: int, nb: int):
+    """(order, counts): edge permutation sorted by (dst block, dst) and
+    per-block edge counts. Single source of truth for the dst-block
+    bucketing shared by this module's layout builder and the blocked
+    Gauss-Seidel one (ops.gauss_seidel.build_gs_layout)."""
+    import numpy as _np
+
+    block = dst // vb
+    order = _np.lexsort((dst, block))
+    counts = _np.bincount(block, minlength=nb)
+    return order, counts
+
+
+def build_vm_blocked_layout(
+    indptr: np.ndarray, indices: np.ndarray, num_nodes: int, *,
+    vb: int, ec: int,
+):
+    """Host preprocessing (numpy, once per graph STRUCTURE): dst-sorted
+    edges bucketed by destination block of ``vb`` vertices, each block's
+    edges padded to a multiple of the chunk size ``ec``, flattened to
+    uniform chunks.
+
+    Weight-independent: emits ``edge_order`` (original CSR edge position
+    per slot, -1 for pads) so callers gather CURRENT device weights per
+    solve — the layout survives Johnson reweighting.
+
+    Returns dict with int32 arrays
+      src_ck  [NC, ec] global source ids (0 at pads)
+      dstl_ck [NC, ec] block-local dst ids, non-decreasing, ``vb`` = pad
+      base_ck [NC]     dst-block start vertex of each chunk
+      edge_order [NC, ec] original edge index, -1 = pad
+    and ``vb``.
+    """
+    import numpy as _np
+
+    v = num_nodes
+    src = _np.repeat(_np.arange(v, dtype=_np.int32), _np.diff(indptr))
+    dst = indices.astype(_np.int32)
+    nb = max(1, -(-v // vb))
+    order, counts = bucket_edges_by_dst_block(dst, vb, nb)
+    padded = -(-_np.maximum(counts, 1) // ec) * ec  # >=1 chunk per block
+    total = int(padded.sum())
+    src_f = _np.zeros(total, _np.int32)
+    dstl_f = _np.full(total, vb, _np.int32)
+    order_f = _np.full(total, -1, _np.int32)
+    base_f = _np.empty(total, _np.int32)
+    starts_in = _np.concatenate([[0], _np.cumsum(counts)])
+    starts_out = _np.concatenate([[0], _np.cumsum(padded)])
+    for j in range(nb):
+        c = int(counts[j])
+        o = int(starts_out[j])
+        sl = order[starts_in[j]: starts_in[j] + c]
+        src_f[o: o + c] = src[sl]
+        dstl_f[o: o + c] = dst[sl] - j * vb
+        order_f[o: o + c] = sl
+        base_f[o: o + int(padded[j])] = j * vb
+    nc = total // ec
+    return {
+        "src_ck": src_f.reshape(nc, ec),
+        "dstl_ck": dstl_f.reshape(nc, ec),
+        "base_ck": base_f.reshape(nc, ec)[:, 0].copy(),
+        "edge_order": order_f.reshape(nc, ec),
+        "vb": vb,
+    }
+
+
+def relax_sweep_vm_blocked(dist_vm, src_ck, dstl_ck, w_ck, base_ck, *, vb: int):
+    """One vertex-major sweep over dst-blocked chunks: each chunk
+    segment-reduces into its block's [vb, B] slice only. Later chunks see
+    earlier updates (chunk-level Gauss-Seidel), like the plain vm sweep."""
+    b = dist_vm.shape[1]
+
+    def body(d, chunk):
+        s, t, wt, base = chunk
+        cand = d[s, :] + wt[:, None]                  # [Ec, B]
+        upd = jax.ops.segment_min(
+            cand, t, num_segments=vb + 1, indices_are_sorted=True
+        )[:vb]
+        blk = lax.dynamic_slice(d, (base, 0), (vb, b))
+        return (
+            lax.dynamic_update_slice(d, jnp.minimum(blk, upd), (base, 0)),
+            None,
+        )
+
+    dist_vm, _ = lax.scan(body, dist_vm, (src_ck, dstl_ck, w_ck, base_ck))
+    return dist_vm
+
+
+def bellman_ford_sweeps_vm_blocked(
+    dist0_vm, src_ck, dstl_ck, w_ck, base_ck, *, vb: int, max_iter: int
+):
+    """Fixpoint iteration of :func:`relax_sweep_vm_blocked`. Same contract
+    as :func:`bellman_ford_sweeps_vm` (dist [V_pad, B]; V_pad = NB*vb,
+    pad rows +inf): returns (dist_vm, iterations, still_improving)."""
+
+    def cond(state):
+        _, i, improving = state
+        return improving & (i < max_iter)
+
+    def body(state):
+        d, i, _ = state
+        nd = relax_sweep_vm_blocked(
+            d, src_ck, dstl_ck, w_ck, base_ck, vb=vb
+        )
+        return nd, i + 1, jnp.any(nd < d)
+
+    improving0 = jnp.any(jnp.isfinite(dist0_vm))
+    return lax.while_loop(
+        cond, body, (dist0_vm, jnp.int32(0), improving0)
+    )
+
+
 def relax_sweep_pred(dist, pred, src, dst, w, *, edge_chunk: int = 1 << 20):
     """Like :func:`relax_sweep` but also maintains predecessors.
 

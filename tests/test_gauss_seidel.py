@@ -88,8 +88,9 @@ def test_gs_negative_cycle_detected():
 
 
 def test_gs_unavailable_after_reweight():
-    """reweight() clears the host graph; the GS route must fall through
-    instead of crashing."""
+    """reweight() keeps the host graph (structure stays valid) but marks
+    its weights stale; the GS route — whose layout builder reads host
+    weights — must fall through instead of crashing."""
     g = grid2d(12, 12, negative_fraction=0.2, seed=3)
     backend = _gs_backend(gs_block_size=64)
     dg = backend.upload(g)

@@ -1,0 +1,85 @@
+"""Dst-blocked vertex-major fan-out (ops.relax dst-blocked sweep) — the
+large-V fix for the plain vm kernel's full-V per-chunk segment writes
+(round-2 verdict missing #3 / round-3 BASELINE notes)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.csgraph as csgraph
+
+from paralleljohnson_tpu.backends import get_backend, jax_backend
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import rmat
+from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+
+@pytest.fixture
+def small_vm_block(monkeypatch):
+    """Shrink the routing threshold so CI-sized graphs hit the blocked
+    path (the real threshold is 2^16)."""
+    monkeypatch.setattr(jax_backend, "VM_BLOCK", 512)
+
+
+def _cfg(**kw):
+    return SolverConfig(
+        fanout_layout="vertex_major", frontier=False, gauss_seidel=False,
+        mesh_shape=(1,), **kw,
+    )
+
+
+def test_blocked_routes_and_matches_plain(small_vm_block):
+    g = rmat(11, 8, seed=3)  # V=2048 > shrunk threshold
+    b = get_backend("jax", _cfg())
+    dg = b.upload(g)
+    sources = np.array([0, 5, 999, 2047], np.int64)
+    res = b.multi_source(dg, sources)
+    assert ("vmb", 512, jax_backend._edge_chunk_for(4, dg.src.shape[0])) in (
+        dg._struct_cache
+    ), "blocked layout was not built/used"
+
+    plain = get_backend("jax", _cfg())
+    dgp = plain.upload(g)
+    jax_backend_vmblock = jax_backend.VM_BLOCK
+    jax_backend.VM_BLOCK = 1 << 30  # plain path
+    try:
+        ref = plain.multi_source(dgp, sources)
+    finally:
+        jax_backend.VM_BLOCK = jax_backend_vmblock
+    np.testing.assert_allclose(
+        np.asarray(res.dist), np.asarray(ref.dist), rtol=1e-5, atol=1e-4
+    )
+    # Chunk schedules differ (block-sorted vs dst-sorted order), so the
+    # Gauss-Seidel-at-chunk-level sweep counts may differ slightly.
+    assert abs(res.iterations - ref.iterations) <= 2
+
+    mat = sp.csr_matrix(
+        (g.weights.astype(np.float64), g.indices, g.indptr),
+        shape=(g.num_nodes, g.num_nodes),
+    )
+    want = csgraph.dijkstra(mat, directed=True, indices=sources)
+    np.testing.assert_allclose(np.asarray(res.dist), want, rtol=1e-4, atol=1e-3)
+
+
+def test_blocked_survives_reweight(small_vm_block):
+    """Full Johnson on a negative-weight graph: the fan-out runs on the
+    REWEIGHTED graph, whose weights exist only on device — the blocked
+    structure must be reused with device-gathered weights."""
+    from paralleljohnson_tpu.graphs import random_dag
+
+    g = random_dag(1500, 0.004, negative_fraction=0.4, seed=6)
+    solver = ParallelJohnsonSolver(_cfg(validate=True))
+    res = solver.solve(g, sources=np.arange(0, 1500, 97))
+    assert res.stats.edges_relaxed > 0  # validate=True already oracled it
+
+
+def test_structure_cache_shared_across_reweight(small_vm_block):
+    g = rmat(11, 8, seed=3)
+    b = get_backend("jax", _cfg())
+    dg = b.upload(g)
+    b.multi_source(dg, np.array([0, 1], np.int64))
+    h = np.zeros(g.num_nodes, np.float32)
+    dg2 = b.reweight(dg, h)
+    assert dg2._struct_cache is dg._struct_cache  # carried, not rebuilt
+    assert dg2.host_weights_stale and not b._use_gs(dg2)
+    res = b.multi_source(dg2, np.array([0, 1], np.int64))
+    assert res.converged
