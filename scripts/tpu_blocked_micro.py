@@ -1,0 +1,49 @@
+"""On-chip micro: dst-blocked vs plain vertex-major fan-out at rmat-20
+(and rmat-16 for the VERDICT #3 'sweep >= 3x faster' criterion).
+Timing methodology per scripts/tpu_gather_probe.py: sync by downloading
+scalars, never block_until_ready."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+import numpy as np
+
+from paralleljohnson_tpu.backends import get_backend, jax_backend as jb
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import rmat
+
+
+def solve_timed(backend, dg, sources):
+    res = backend.multi_source(dg, sources)  # compile+warm (int sync inside)
+    t0 = time.perf_counter()
+    res = backend.multi_source(dg, sources)
+    dt = time.perf_counter() - t0  # KernelResult int() conversions sync
+    return dt, res
+
+
+def main():
+    rng = np.random.default_rng(0)
+    for scale in (16, 20):
+        g = rmat(scale, 16, seed=42)
+        v = g.num_nodes
+        sources = np.sort(
+            rng.choice(v, size=128, replace=False)
+        ).astype(np.int64)
+        for tag, vm_block in (("blocked", 1 << 16), ("plain", 1 << 62)):
+            jb.VM_BLOCK = vm_block
+            backend = get_backend("jax", SolverConfig(mesh_shape=(1,)))
+            dg = backend.upload(g)
+            dt, res = solve_timed(backend, dg, sources)
+            print(
+                f"rmat{scale}x128 {tag}: {dt:.3f}s "
+                f"iters={res.iterations} "
+                f"({dt / max(res.iterations, 1) * 1e3:.0f} ms/sweep, "
+                f"{res.edges_relaxed / dt / 1e9:.2f} Gedges/s)",
+                flush=True,
+            )
+            del dg, backend
+
+
+if __name__ == "__main__":
+    main()

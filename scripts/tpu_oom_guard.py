@@ -1,0 +1,44 @@
+"""On-chip memory-heuristic guard (round-2 verdict next #10): run the
+rmat-20 x 128-source fan-out under the DEFAULT config on the real chip,
+assert it completes without OOM, and record the batch the fits-memory
+heuristic chose. Output lands in BASELINE.md notes."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+import numpy as np
+
+from paralleljohnson_tpu.backends import get_backend
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import rmat
+from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+
+def main():
+    g = rmat(20, 16, seed=42)
+    rng = np.random.default_rng(0)
+    sources = np.sort(
+        rng.choice(g.num_nodes, size=128, replace=False)
+    ).astype(np.int64)
+    cfg = SolverConfig()  # DEFAULT config — the guard's whole point
+    backend = get_backend("jax", cfg)
+    dg = backend.upload(g)
+    suggested = backend.suggested_source_batch(dg)
+    print(f"suggested_source_batch(rmat20) = {suggested}", flush=True)
+    solver = ParallelJohnsonSolver(cfg, backend=backend)
+    t0 = time.perf_counter()
+    res = solver.multi_source(g, sources)
+    dt = time.perf_counter() - t0
+    finite = float(np.isfinite(np.asarray(res.dist[:4])).mean())
+    print(
+        f"rmat20x128 default-config fan-out OK: {dt:.2f}s wall, "
+        f"iters={res.stats.iterations_by_phase['fanout']}, "
+        f"edges_relaxed={res.stats.edges_relaxed:,}, "
+        f"first-rows finite_frac={finite:.2f} — no OOM",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+#!/bin/bash
+# Round-3 on-chip measurement pass: the new kernels (dst-blocked fan-out,
+# blocked Gauss-Seidel) against the round-2 numbers, plus the rows the
+# first round-3 pass could not capture (rmat22 streamed). Run when the
+# device tunnel is healthy. Stages are independently timeboxed.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+unset JAX_PLATFORMS XLA_FLAGS
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-$HOME/.cache/pj_jax_cache}
+LOG=${1:-/tmp/tpu_round3_run.log}
+: > "$LOG"
+
+FAILED_STAGES=""
+run() {  # run <seconds> <label> <cmd...>
+  local t=$1 label=$2 rc; shift 2
+  echo "=== $label ===" | tee -a "$LOG"
+  timeout --signal=TERM --kill-after=30 "$t" "$@" 2>&1 | grep -v WARNING | tail -8 | tee -a "$LOG"
+  rc=${PIPESTATUS[0]}
+  echo "--- rc=$rc ---" | tee -a "$LOG"
+  [ "$rc" -ne 0 ] && FAILED_STAGES="$FAILED_STAGES $label"
+  return "$rc"
+}
+
+# 0) probe
+run 120 probe python -c "import jax,numpy as np; print('probe', int(jax.jit(lambda x:x+1)(np.int32(1))))" || exit 1
+
+# 1) blocked-fanout vs plain at rmat20 (the VERDICT #3 decision number)
+run 1800 blocked-vs-plain python scripts/tpu_blocked_micro.py
+
+# 2) GS vs frontier on the dimacs stand-in, on-chip (VERDICT #4 number)
+run 1200 gs-dimacs python scripts/tpu_gs_micro.py
+
+# 3) re-run the affected full-preset rows with the new kernels
+run 1800 jax-dimacs-full python -m paralleljohnson_tpu.cli bench dimacs_ny_bf --backend jax --preset full --update-baseline BASELINE.md
+run 2400 jax-rmat20-full python -m paralleljohnson_tpu.cli bench rmat_apsp --backend jax --preset full --update-baseline BASELINE.md
+
+# 4) rmat22 streamed retry (crashed the worker in the first pass)
+(
+  export PJ_BENCH_RMAT_SCALE=22
+  run 3000 jax-rmat22 python -m paralleljohnson_tpu.cli bench rmat_apsp --backend jax --preset full --update-baseline BASELINE.md
+) || FAILED_STAGES="$FAILED_STAGES jax-rmat22"
+
+# 5) driver metric (should reflect the blocked kernel now)
+run 1200 bench.py python bench.py
+
+# 6) memory-guard probe (VERDICT #10): rmat-20 x 128 fan-out, default
+#    config, assert no OOM + record suggested_source_batch
+run 1200 oom-guard python scripts/tpu_oom_guard.py
+
+if [ -n "$FAILED_STAGES" ]; then
+  echo "STAGES FAILED:$FAILED_STAGES (log: $LOG)" | tee -a "$LOG"
+  exit 1
+fi
+echo "ALL STAGES DONE (log: $LOG)"
