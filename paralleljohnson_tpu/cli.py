@@ -47,6 +47,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "single-source Bellman-Ford (auto: mesh >1 device "
                         "AND the frontier path is not active — frontier "
                         "wins on low-degree graphs; true forces)")
+    p.add_argument("--gauss-seidel", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="blocked Gauss-Seidel for high-diameter graphs "
+                        "(auto: low-degree graphs on TPU; rounds ~ path "
+                        "direction changes, not diameter)")
+    p.add_argument("--gs-block-size", type=int, default=4096,
+                   help="vertices per Gauss-Seidel block")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--predecessors", action="store_true",
                    help="also compute shortest-path trees (saved to --output)")
@@ -79,6 +86,8 @@ def _config(args) -> "SolverConfig":
         fanout_layout=args.fanout_layout,
         frontier=tristate[args.frontier],
         edge_shard=tristate[args.edge_shard],
+        gauss_seidel=tristate[args.gauss_seidel],
+        gs_block_size=args.gs_block_size,
         checkpoint_dir=args.checkpoint_dir,
         validate=args.validate,
     )

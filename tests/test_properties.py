@@ -85,17 +85,35 @@ def test_reweighted_nonnegative():
 
 
 @settings(max_examples=25, deadline=None)
-@given(graphs(negative=True), st.integers(0, 3))
+@given(graphs(negative=True), st.integers(0, 5))
 def test_layouts_and_frontier_agree(g, knob):
     """Every kernel-routing knob computes the same distances: fan-out
-    layouts, forced frontier, forced dense — all against the numpy
-    oracle backend on the same random negative-weight DAG."""
+    layouts, forced frontier, forced Gauss-Seidel (SSSP phase), the
+    dst-blocked fan-out, forced dense — all against the numpy oracle
+    backend on the same random negative-weight DAG."""
+    from paralleljohnson_tpu.backends import jax_backend
+
     cfgs = [
         SolverConfig(backend="jax", fanout_layout="source_major"),
         SolverConfig(backend="jax", fanout_layout="vertex_major"),
         SolverConfig(backend="jax", frontier=True),
         SolverConfig(backend="jax", dense_threshold=64, dense_min_density=0),
+        SolverConfig(backend="jax", gauss_seidel=True, frontier=False,
+                     gs_block_size=8, mesh_shape=(1,)),
+        SolverConfig(backend="jax", fanout_layout="vertex_major",
+                     mesh_shape=(1,)),  # + shrunk VM_BLOCK below
     ]
+    if knob == 5:
+        # Route the dst-blocked fan-out at toy scale.
+        old = jax_backend.VM_BLOCK
+        jax_backend.VM_BLOCK = 8
+        try:
+            got = ParallelJohnsonSolver(cfgs[knob]).solve(g).matrix
+        finally:
+            jax_backend.VM_BLOCK = old
+        want = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g).matrix
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+        return
     want = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g).matrix
     got = ParallelJohnsonSolver(cfgs[knob]).solve(g).matrix
     np.testing.assert_allclose(
