@@ -29,7 +29,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dense-threshold", type=int, default=1024)
     p.add_argument("--use-pallas", default="auto",
                    choices=["auto", "true", "false"],
-                   help="Pallas dense kernels: auto (TPU only) / force / off")
+                   help="dense min-plus impl: auto = measured winner (the "
+                        "XLA blocked product; the Pallas tile kernel "
+                        "measured slower on-chip), true = force Pallas "
+                        "(interpret-mode off-TPU), false = XLA")
     p.add_argument("--mesh-shape", default=None, metavar="N[,M...]",
                    help="devices along the sources mesh axis (e.g. 8); "
                         "default: all visible devices")

@@ -132,6 +132,10 @@ class SolverConfig:
                 "gauss_seidel must be True/False/'auto', "
                 f"got {self.gauss_seidel!r}"
             )
+        if self.gs_block_size < 1:
+            raise ValueError(
+                f"gs_block_size must be >= 1, got {self.gs_block_size}"
+            )
         if self.edge_shard not in (True, False, "auto"):
             raise ValueError(
                 f"edge_shard must be True/False/'auto', got {self.edge_shard!r}"

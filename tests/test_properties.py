@@ -100,8 +100,10 @@ def test_layouts_and_frontier_agree(g, knob):
         SolverConfig(backend="jax", dense_threshold=64, dense_min_density=0),
         SolverConfig(backend="jax", gauss_seidel=True, frontier=False,
                      gs_block_size=8, mesh_shape=(1,)),
+        # dense_threshold=0 so _use_dense can't shadow the dst-blocked
+        # route (checked first in multi_source); VM_BLOCK shrunk below.
         SolverConfig(backend="jax", fanout_layout="vertex_major",
-                     mesh_shape=(1,)),  # + shrunk VM_BLOCK below
+                     mesh_shape=(1,), dense_threshold=0),
     ]
     if knob == 5:
         # Route the dst-blocked fan-out at toy scale.
@@ -111,11 +113,9 @@ def test_layouts_and_frontier_agree(g, knob):
             got = ParallelJohnsonSolver(cfgs[knob]).solve(g).matrix
         finally:
             jax_backend.VM_BLOCK = old
-        want = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g).matrix
-        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
-        return
+    else:
+        got = ParallelJohnsonSolver(cfgs[knob]).solve(g).matrix
     want = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g).matrix
-    got = ParallelJohnsonSolver(cfgs[knob]).solve(g).matrix
     np.testing.assert_allclose(
         np.asarray(got), want, rtol=1e-4, atol=1e-4
     )
