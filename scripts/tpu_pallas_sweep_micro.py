@@ -1,0 +1,82 @@
+"""On-chip micro: Pallas VMEM-resident fan-out vs the XLA paths at
+rmat-16 x 128 sources (the driver-metric shape). Sweeps (vb, ec).
+Scalar-download sync per scripts/tpu_gather_probe.py methodology."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paralleljohnson_tpu.backends import get_backend, jax_backend as jb
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import rmat
+from paralleljohnson_tpu.ops.pallas_sweep import (
+    build_pallas_sweep_layout, pallas_fanout,
+)
+
+
+def main():
+    g = rmat(16, 16, seed=42)
+    v = g.num_nodes
+    rng = np.random.default_rng(0)
+    sources = np.sort(rng.choice(v, size=128, replace=False)).astype(np.int32)
+
+    # XLA baselines (plain + blocked routing).
+    for tag, vm_block in (("xla-plain", 1 << 62), ("xla-blocked", 1 << 14)):
+        jb.VM_BLOCK = vm_block
+        backend = get_backend("jax", SolverConfig(mesh_shape=(1,)))
+        dg = backend.upload(g)
+        res = backend.multi_source(dg, sources.astype(np.int64))
+        t0 = time.perf_counter()
+        res = backend.multi_source(dg, sources.astype(np.int64))
+        dt = time.perf_counter() - t0
+        print(f"{tag}: {dt:.3f}s iters={res.iterations} "
+              f"({dt / max(res.iterations, 1) * 1e3:.1f} ms/sweep)",
+              flush=True)
+        ref = np.asarray(res.dist)
+        del dg, backend
+
+    for vb, ec in [(2048, 2048), (4096, 2048), (4096, 4096), (8192, 4096)]:
+        try:
+            lay = build_pallas_sweep_layout(
+                g.indptr, g.indices, v, vb=vb, ec=ec
+            )
+            order = lay["edge_order"]
+            w = np.where(
+                order >= 0, g.weights[np.maximum(order, 0)], np.inf
+            ).astype(np.float32)
+            d0 = np.full((lay["v_pad"], 128), np.inf, np.float32)
+            d0[sources, np.arange(128)] = 0.0
+            args = [jnp.asarray(x) for x in (
+                d0, lay["srcl_ck"], lay["dstl_ck"], w, lay["runend_ck"],
+                lay["sb_ids"], lay["db_ids"], lay["first_ck"],
+            )]
+            run = jax.jit(
+                lambda *a: pallas_fanout(*a, vb=vb, max_iter=v)
+            )
+            dist, iters, improving = run(*args)
+            it = int(iters)  # sync
+            t0 = time.perf_counter()
+            dist, iters, improving = run(*args)
+            it = int(iters)
+            dt = time.perf_counter() - t0
+            d = np.asarray(dist[:v]).T
+            same_reach = bool(np.all(np.isfinite(d) == np.isfinite(ref)))
+            fin = np.isfinite(ref)
+            ok = same_reach and np.allclose(
+                d[fin], ref[fin], rtol=1e-4, atol=1e-3
+            )
+            nc = lay["srcl_ck"].shape[0]
+            print(f"pallas vb={vb} ec={ec} (nc={nc}): {dt:.3f}s "
+                  f"iters={it} ({dt / max(it, 1) * 1e3:.1f} ms/sweep) "
+                  f"agree={ok}", flush=True)
+        except Exception as e:
+            print(f"pallas vb={vb} ec={ec}: FAIL {str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,9 @@ run 2400 jax-rmat20-full python -m paralleljohnson_tpu.cli bench rmat_apsp --bac
   run 3000 jax-rmat22 python -m paralleljohnson_tpu.cli bench rmat_apsp --backend jax --preset full --update-baseline BASELINE.md
 ) || FAILED_STAGES="$FAILED_STAGES jax-rmat22"
 
+# 4b) pallas VMEM-resident sweep vs XLA (Mosaic compile + perf decision)
+run 1500 pallas-sweep python scripts/tpu_pallas_sweep_micro.py
+
 # 5) driver metric (should reflect the blocked kernel now)
 run 1200 bench.py python bench.py
 
