@@ -24,6 +24,10 @@ from paralleljohnson_tpu.ops import relax
 # per-block propagation per visit (never correctness — see ops/gauss_seidel).
 GS_INNER_CAP = 64
 
+# Edge count above which the dst-blocked layout is built on DEVICE
+# (sort + scatter) instead of host numpy + upload (see vm_blocked_layout).
+VMB_DEVICE_BUILD_MIN_EDGES = 1 << 22
+
 # Dst-block size of the blocked vertex-major fan-out; graphs with V above
 # this route to the blocked sweep (below it, plain full-V segments are
 # already this small). [VM_BLOCK, B] update slices are 32 MB at B=128.
@@ -104,29 +108,62 @@ class JaxDeviceGraph:
         if self.host_graph is None:
             return None
         key = ("vmb", vb, ec)
+        v_pad = vb * max(1, -(-self.num_nodes // vb))
+        e = self.num_real_edges
         struct = self._struct_cache.get(key)
         if struct is None:
             g = self.host_graph
-            host = relax.build_vm_blocked_layout(
-                g.indptr, g.indices, g.num_nodes, vb=vb, ec=ec
-            )
-            struct = {
-                "src_ck": jnp.asarray(host["src_ck"], jnp.int32),
-                "dstl_ck": jnp.asarray(host["dstl_ck"], jnp.int32),
-                "base_ck": jnp.asarray(host["base_ck"], jnp.int32),
-                "edge_order": jnp.asarray(host["edge_order"], jnp.int32),
-                "vb": vb,
-                "v_pad": vb * max(1, -(-self.num_nodes // vb)),
-            }
-            self._struct_cache[key] = struct
+            if e >= VMB_DEVICE_BUILD_MIN_EDGES:
+                # Large edge lists: sort + padded-slot scatter ON DEVICE
+                # — the host lexsort and the ~16E-byte layout upload
+                # through the device tunnel dominate at RMAT-22 scale.
+                # Only the per-block counts cross from the host.
+                nb = max(1, -(-self.num_nodes // vb))
+                counts = np.bincount(
+                    g.indices // vb, minlength=nb
+                ).astype(np.int64)
+                dev = relax.build_vm_blocked_layout_device(
+                    self.src[:e], self.dst[:e], self.weights[:e],
+                    counts, vb=vb, ec=ec,
+                )
+                struct = {
+                    "src_ck": dev["src_ck"],
+                    "dstl_ck": dev["dstl_ck"],
+                    "base_ck": dev["base_ck"],
+                    "order": dev["order"],
+                    "slots": dev["slots"],
+                    "vb": vb,
+                    "v_pad": v_pad,
+                }
+                self._struct_cache[key] = struct
+                self._by_dst_cache[key] = dev["w_ck"]
+            else:
+                host = relax.build_vm_blocked_layout(
+                    g.indptr, g.indices, g.num_nodes, vb=vb, ec=ec
+                )
+                struct = {
+                    "src_ck": jnp.asarray(host["src_ck"], jnp.int32),
+                    "dstl_ck": jnp.asarray(host["dstl_ck"], jnp.int32),
+                    "base_ck": jnp.asarray(host["base_ck"], jnp.int32),
+                    "edge_order": jnp.asarray(host["edge_order"], jnp.int32),
+                    "vb": vb,
+                    "v_pad": v_pad,
+                }
+                self._struct_cache[key] = struct
         w_ck = self._by_dst_cache.get(key)
         if w_ck is None:
-            order = struct["edge_order"]
-            w_ck = jnp.where(
-                order >= 0,
-                self.weights[jnp.maximum(order, 0)],
-                jnp.inf,
-            ).astype(self.weights.dtype)
+            if "order" in struct:
+                w_ck = relax.regather_vm_blocked_weights(
+                    self.weights, struct["order"], struct["slots"],
+                    struct["src_ck"].size, struct["src_ck"].shape,
+                )
+            else:
+                order = struct["edge_order"]
+                w_ck = jnp.where(
+                    order >= 0,
+                    self.weights[jnp.maximum(order, 0)],
+                    jnp.inf,
+                ).astype(self.weights.dtype)
             self._by_dst_cache[key] = w_ck
         return {**struct, "w_ck": w_ck}
 

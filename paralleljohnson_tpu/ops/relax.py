@@ -35,6 +35,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 INF = jnp.inf
@@ -282,6 +283,86 @@ def build_vm_blocked_layout(
         "edge_order": order_f.reshape(nc, ec),
         "vb": vb,
     }
+
+
+def build_vm_blocked_layout_device(
+    src, dst, weights, counts: np.ndarray, *, vb: int, ec: int,
+):
+    """Device-side equivalent of :func:`build_vm_blocked_layout` for
+    large edge lists: the host path pays an O(E log E) numpy lexsort plus
+    a host->device transfer of ~16E bytes of layout arrays — through a
+    slow device tunnel that dominates at RMAT-22 scale. Here the sort
+    (stable argsort by dst == the host's (block, dst) lexsort, since
+    block = dst // vb is monotone in dst) and the padded-slot scatter run
+    on device; only ``counts`` (per-block real edge counts, a cheap
+    host bincount over the host indices) crosses from the host.
+
+    src/dst/weights: device arrays over the REAL edges only (callers
+    slice any pad tail off first — ``counts`` must sum to their length).
+
+    Returns the same dict as the host builder, with device arrays, plus
+    ``w_ck`` built directly (device weights are already in hand) and
+    ``order``/``slots`` so new weights (post-reweight) can be re-placed
+    without re-sorting.
+    """
+    nb = counts.shape[0]
+    if int(counts.sum()) != int(dst.shape[0]):
+        # Silent corruption otherwise: wrong counts shift every slot and
+        # JAX scatters drop/wrap out-of-range indices without error.
+        raise ValueError(
+            f"counts sum ({int(counts.sum())}) != number of edges "
+            f"({int(dst.shape[0])}) — pass REAL edges only"
+        )
+    padded = -(-np.maximum(counts, 1) // ec) * ec
+    total = int(padded.sum())
+    starts_in = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    starts_out = np.concatenate([[0], np.cumsum(padded)])[:-1]
+    nc = total // ec
+    base_ck = np.repeat(
+        np.arange(nb, dtype=np.int32) * vb, (padded // ec).astype(np.int64)
+    )
+
+    order = jnp.argsort(dst, stable=True)
+    dst_s = dst[order]
+    block_s = dst_s // vb
+    # Slot of sorted edge p: starts_out[block] + (p - starts_in[block]).
+    p = jnp.arange(dst.shape[0], dtype=jnp.int32)
+    slots = (
+        jnp.asarray(starts_out, jnp.int32)[block_s]
+        + p - jnp.asarray(starts_in, jnp.int32)[block_s]
+    )
+
+    src_ck = _slot_scatter(src[order], slots, total, nc, ec, jnp.int32(0))
+    dstl_ck = _slot_scatter(
+        dst_s - block_s * vb, slots, total, nc, ec, jnp.int32(vb)
+    )
+    w_ck = regather_vm_blocked_weights(weights, order, slots, total, (nc, ec))
+    return {
+        "src_ck": src_ck,
+        "dstl_ck": dstl_ck,
+        "base_ck": jnp.asarray(base_ck, jnp.int32),
+        "w_ck": w_ck,
+        "order": order,  # for re-gathering weights after reweight
+        "slots": slots,
+        "vb": vb,
+    }
+
+
+def _slot_scatter(vals, slots, total: int, nc: int, ec: int, fill):
+    return jnp.full((total,), fill, vals.dtype).at[slots].set(
+        vals
+    ).reshape(nc, ec)
+
+
+def regather_vm_blocked_weights(weights, order, slots, total: int, shape):
+    """Place CURRENT device weights into the padded chunk slots (+inf
+    pads) of a device-built layout — one implementation shared by the
+    builder and the post-reweight re-gather so fills/slots never drift."""
+    nc, ec = shape
+    return _slot_scatter(
+        weights[order], slots, total, nc, ec,
+        jnp.asarray(jnp.inf, weights.dtype),
+    )
 
 
 def relax_sweep_vm_blocked(dist_vm, src_ck, dstl_ck, w_ck, base_ck, *, vb: int):
