@@ -46,6 +46,10 @@ class KernelResult:
       operations (B x V^2 per iteration, V^3 per squaring) since their work
       is independent of E. See the BASELINE.md convention note before
       comparing across backends/regimes.
+    route: the kernel route the backend resolved to (e.g. "gs",
+      "frontier", "vm-blocked", "dense-squaring", "sharded-1d") — flows
+      into SolverStats and benchmark rows so before/after kernel
+      comparisons stay reconstructable across measurement rounds.
     """
 
     dist: Any  # np.ndarray or a device array (see docstring)
@@ -54,6 +58,7 @@ class KernelResult:
     edges_relaxed: int = 0
     converged: bool = True
     pred: np.ndarray | None = None  # predecessor vertices, -1 = none
+    route: str | None = None  # resolved kernel route (see docstring)
 
 
 class Backend(abc.ABC):

@@ -90,6 +90,17 @@ def _solver(backend: str, **cfg_overrides):
     return ParallelJohnsonSolver(SolverConfig(backend=backend, **cfg_overrides))
 
 
+def _routes(res) -> dict:
+    """Compact resolved-kernel-route tag for a bench row's detail (e.g.
+    ``"bellman_ford:gs,fanout:vm-blocked"``) — keeps before/after kernel
+    comparisons reconstructable across measurement rounds (round-3
+    verdict weak #8). Empty for backends that don't report routes."""
+    routes = getattr(res.stats, "routes_by_phase", None)
+    if not routes:
+        return {}
+    return {"route": ",".join(f"{k}:{v}" for k, v in sorted(routes.items()))}
+
+
 # -- the five configs --------------------------------------------------------
 
 
@@ -109,7 +120,7 @@ def bench_er1k_apsp(backend: str, preset: str) -> BenchRecord:
         "er1k_apsp", backend, preset, wall,
         res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
         {"nodes": g.num_nodes, "edges": g.num_real_edges,
-         "finite_frac": _finite_frac(res.dist)},
+         "finite_frac": _finite_frac(res.dist), **_routes(res)},
     )
 
 
@@ -131,7 +142,7 @@ def bench_dimacs_ny_bf(backend: str, preset: str) -> BenchRecord:
         res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
         {"nodes": g.num_nodes, "edges": g.num_real_edges,
          "sweeps": res.stats.iterations_by_phase.get("bellman_ford", 0),
-         "reached_frac": _finite_frac(res.dist)},
+         "reached_frac": _finite_frac(res.dist), **_routes(res)},
     )
 
 
@@ -156,7 +167,7 @@ def bench_ego_fb_nsource(backend: str, preset: str) -> BenchRecord:
         "ego_fb_nsource", backend, preset, wall,
         res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
         {"nodes": g.num_nodes, "edges": g.num_real_edges,
-         "sources": len(sources)},
+         "sources": len(sources), **_routes(res)},
     )
 
 
@@ -191,7 +202,7 @@ def bench_rmat_apsp(backend: str, preset: str) -> BenchRecord:
         name, backend, preset, wall,
         res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
         {"scale": scale, "nodes": g.num_nodes, "edges": g.num_real_edges,
-         "sources": n_sources, "rows_checksum": checksum},
+         "sources": n_sources, "rows_checksum": checksum, **_routes(res)},
     )
 
 

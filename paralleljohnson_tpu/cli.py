@@ -29,10 +29,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dense-threshold", type=int, default=1024)
     p.add_argument("--use-pallas", default="auto",
                    choices=["auto", "true", "false"],
-                   help="dense min-plus impl: auto = measured winner (the "
-                        "XLA blocked product; the Pallas tile kernel "
-                        "measured slower on-chip), true = force Pallas "
-                        "(interpret-mode off-TPU), false = XLA")
+                   help="Pallas kernels: auto = measured winner (currently "
+                        "XLA everywhere: the dense tile kernel measured "
+                        "slower on-chip; the VMEM-resident fan-out sweep "
+                        "is pending on-chip numbers), true = force Pallas "
+                        "(dense min-plus AND the single-device "
+                        "vertex-major fan-out; interpret-mode off-TPU), "
+                        "false = XLA")
     p.add_argument("--mesh-shape", default=None, metavar="N[,M...]",
                    help="devices along the sources mesh axis (e.g. 8); "
                         "default: all visible devices")

@@ -43,10 +43,12 @@ class SolverConfig:
         ``dense_threshold`` alone decide (tests).
       edge_pad_multiple: pad E to this multiple for stable jit shapes.
       use_pallas: ``"auto"`` (the measured winner — currently the XLA
-        blocked min-plus everywhere; the Pallas tile kernel measured
-        slower on-chip, see ``ops/pallas_kernels.py``), ``True`` (force
-        Pallas: compiled on TPU, interpret-mode off-TPU — tests), or
-        ``False``.
+        paths everywhere: the dense Pallas tile kernel measured slower
+        on-chip, see ``ops/pallas_kernels.py``, and the VMEM-resident
+        fan-out sweep, ``ops/pallas_sweep.py``, awaits on-chip numbers),
+        ``True`` (force Pallas for the dense min-plus AND the
+        single-device vertex-major fan-out: compiled on TPU,
+        interpret-mode off-TPU — tests), or ``False``.
       fanout_layout: sparse fan-out data layout — ``"vertex_major"``
         (dist [V, B], dst-sorted edges, sorted segment reduction: no
         scatter on TPU), ``"source_major"`` (dist [B, V], flattened-id
@@ -69,10 +71,11 @@ class SolverConfig:
         TPU for the same low-max-degree graphs the frontier path targets
         (on CPU the frontier path measures faster; on TPU the frontier's
         per-round scatter+nonzero cost dominates). True forces (given the
-        host graph is available) — except the FAN-OUT on a multi-device
-        mesh, which raises: the sequential block schedule is single
-        device; "auto" defers to the sharded sweep paths there. An
-        explicit ``frontier=True`` beats gauss_seidel="auto".
+        host graph is available). The layout is weight-independent, so
+        the route survives Johnson reweighting; the fan-out composes
+        with a 1-D sources mesh (batch sharded, block schedule per
+        device) but NOT with an "edges" mesh axis (raises when forced).
+        An explicit ``frontier=True`` beats gauss_seidel="auto".
         False disables.
       gs_block_size: vertices per Gauss-Seidel block (the inner-fixpoint
         unit; bigger blocks = fewer, larger device ops but more inner

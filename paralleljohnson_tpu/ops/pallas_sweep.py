@@ -111,8 +111,17 @@ def build_pallas_sweep_layout(
                     d_loc = dst_s[sl] - dbi * vb
                     dstl_ck[c, :m] = d_loc
                     edge_order[c, :m] = order[sl]
-                    # Last occurrence of each local dst in this chunk.
-                    runend_ck[c, d_loc] = np.arange(m, dtype=np.int32)
+                    # Last occurrence of each local dst in this chunk:
+                    # d_loc is sorted, so run ends are the boundary
+                    # positions (explicit — not the fancy-assignment
+                    # duplicate-index ordering, which is an
+                    # implementation detail of numpy).
+                    is_end = np.empty(m, bool)
+                    is_end[:-1] = d_loc[:-1] != d_loc[1:]
+                    is_end[-1] = True
+                    runend_ck[c, d_loc[is_end]] = np.flatnonzero(
+                        is_end
+                    ).astype(np.int32)
                 sb_ids[c] = sbi
                 db_ids[c] = dbi
                 first_ck[c] = 1 if first else 0

@@ -8,6 +8,7 @@ from paralleljohnson_tpu.parallel.mesh import (
     make_mesh_2d,
     sharded_fanout,
     sharded_fanout_2d,
+    sharded_gs_fanout,
 )
 
 __all__ = [
@@ -18,4 +19,5 @@ __all__ = [
     "multihost",
     "sharded_fanout",
     "sharded_fanout_2d",
+    "sharded_gs_fanout",
 ]

@@ -31,6 +31,7 @@ class SolverStats:
     iterations_by_phase: dict = dataclasses.field(
         default_factory=lambda: defaultdict(int)
     )
+    routes_by_phase: dict = dataclasses.field(default_factory=dict)
     batches_resumed: int = 0
 
     def accumulate(self, result, phase: str) -> None:
@@ -38,6 +39,9 @@ class SolverStats:
         self.edges_relaxed += int(result.edges_relaxed)
         self.edges_relaxed_by_phase[phase] += int(result.edges_relaxed)
         self.iterations_by_phase[phase] += int(result.iterations)
+        route = getattr(result, "route", None)
+        if route:
+            self.routes_by_phase[phase] = route
 
     @property
     def total_seconds(self) -> float:
@@ -57,6 +61,7 @@ class SolverStats:
             "edges_relaxed": self.edges_relaxed,
             "edges_relaxed_by_phase": dict(self.edges_relaxed_by_phase),
             "iterations_by_phase": dict(self.iterations_by_phase),
+            "routes_by_phase": dict(self.routes_by_phase),
             "batches_resumed": self.batches_resumed,
             "total_seconds": self.total_seconds,
             "edges_relaxed_per_sec": self.edges_relaxed_per_second(),

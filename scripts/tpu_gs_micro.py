@@ -43,7 +43,40 @@ def main():
             ref = d
         ok = np.allclose(d, ref, rtol=1e-4, atol=1e-3)
         print(
-            f"{tag}: {dt:.3f}s iters={r.iterations} "
+            f"{tag}: {dt:.3f}s iters={r.iterations} route={r.route} "
+            f"examined={r.edges_relaxed:,} agree={ok}",
+            flush=True,
+        )
+        del dg, backend
+
+    # Full-Johnson phase-2 shape: the B=64 fan-out on the (now
+    # weight-independent-layout) GS route vs the sweep routes — the
+    # road-graph workload Johnson actually runs after reweighting.
+    print("fan-out B=64 (non-negative weights):", flush=True)
+    g2 = grid2d(515, 515, negative_fraction=0.0, seed=7)
+    sources = np.sort(
+        np.random.default_rng(0).choice(g2.num_nodes, 64, replace=False)
+    ).astype(np.int64)
+    ref = None
+    for tag, cfg in [
+        ("gs-fanout vb=16384", SolverConfig(
+            gauss_seidel=True, frontier=False, gs_block_size=16384,
+            mesh_shape=(1,))),
+        ("vm sweeps", SolverConfig(
+            gauss_seidel=False, frontier=False, mesh_shape=(1,))),
+    ]:
+        backend = get_backend("jax", cfg)
+        dg = backend.upload(g2)
+        r = backend.multi_source(dg, sources)  # warm
+        t0 = time.perf_counter()
+        r = backend.multi_source(dg, sources)
+        dt = time.perf_counter() - t0
+        d = np.asarray(r.dist)
+        if ref is None:
+            ref = d
+        ok = np.allclose(d, ref, rtol=1e-4, atol=1e-3)
+        print(
+            f"{tag}: {dt:.3f}s iters={r.iterations} route={r.route} "
             f"examined={r.edges_relaxed:,} agree={ok}",
             flush=True,
         )

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Probe the TPU tunnel periodically; the moment it is healthy, run the
+# round-3/4 measurement pass (scripts/tpu_round3_run.sh) to completion.
+# Single-tenant discipline: only this watcher dials the device while it
+# runs; everything else in the session must force CPU
+# (paralleljohnson_tpu.utils.platform.honor_cpu_platform_request).
+set -u
+cd "$(dirname "$0")/.."
+unset JAX_PLATFORMS XLA_FLAGS
+LOG=${1:-/tmp/tpu_watch.log}
+PASS_LOG=${2:-/tmp/tpu_round3_run.log}
+: > "$LOG"
+echo "watcher start $(date -u +%H:%M:%S)" | tee -a "$LOG"
+while true; do
+  if timeout --signal=TERM --kill-after=15 120 python -c \
+      "import jax,numpy as np; assert jax.default_backend()=='tpu'; print('probe-ok', int(jax.jit(lambda x:x+1)(np.int32(1))))" \
+      >> "$LOG" 2>&1; then
+    echo "TUNNEL HEALTHY $(date -u +%H:%M:%S) — firing measurement pass" | tee -a "$LOG"
+    bash scripts/tpu_round3_run.sh "$PASS_LOG"
+    rc=$?
+    echo "PASS DONE rc=$rc $(date -u +%H:%M:%S)" | tee -a "$LOG"
+    exit $rc
+  fi
+  echo "wedged $(date -u +%H:%M:%S); retry in 240s" >> "$LOG"
+  sleep 240
+done

@@ -89,6 +89,33 @@ def test_fixpoint_matches_oracle(maker, vb, ec):
     )
 
 
+def test_backend_route_use_pallas_true():
+    """use_pallas=True wires the VMEM sweep into multi_source (round-3
+    verdict weak #6): interpret mode off-TPU, oracle-correct, tagged
+    route 'pallas-vm'. Stays opt-in until on-chip measurement promotes
+    it (the decision tree in the module docstring)."""
+    from paralleljohnson_tpu.backends import get_backend
+    from paralleljohnson_tpu.config import SolverConfig
+
+    g = grid2d(18, 18, seed=8)
+    sources = np.array([0, 5, 100, 323], np.int64)
+    backend = get_backend(
+        "jax", SolverConfig(use_pallas=True, mesh_shape=(1,))
+    )
+    dg = backend.upload(g)
+    res = backend.multi_source(dg, sources)
+    assert res.route == "pallas-vm"
+    mat = sp.csr_matrix(
+        (g.weights.astype(np.float64), g.indices, g.indptr),
+        shape=(g.num_nodes, g.num_nodes),
+    )
+    want = csgraph.dijkstra(mat, directed=True, indices=sources)
+    np.testing.assert_allclose(
+        np.asarray(res.dist), want, rtol=1e-5, atol=1e-4
+    )
+    assert res.edges_relaxed > 0
+
+
 def test_layout_structure():
     g = rmat(8, 8, seed=1)
     vb, ec = 64, 128
