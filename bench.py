@@ -138,6 +138,7 @@ def _run_config(
         "scale": scale,
         "n_sources": n_sources,
         "platform": jax.default_backend(),
+        "route": getattr(res, "route", None),
         "repeats": repeats,
         # Rungs force the sparse kernel (dense_threshold=0); record it so
         # rung numbers aren't mistaken for default-config measurements.
@@ -159,8 +160,8 @@ def _emit(measured: dict, tag: str) -> None:
     detail = {
         k: measured[k]
         for k in ("platform", "scale", "n_sources", "dt", "t_ref",
-                  "oracle_ok", "repeats", "config")
-        if k in measured
+                  "oracle_ok", "route", "repeats", "config")
+        if k in measured and measured[k] is not None
     }
     if detail:
         out["detail"] = detail

@@ -396,6 +396,9 @@ def _fanout_pallas_kernel(
 # height — two [vb, B] f32 blocks at B=128 must fit VMEM (~16 MB/core)
 # with headroom, so vb caps at 8192 (4 MB per block).
 PALLAS_EC = 2048
+# The kernel's VMEM block specs are sized for this batch width; wider
+# fan-outs run as slices of it (tests shrink it to cover the slicing).
+PALLAS_BATCH_SLICE = 128
 
 
 def _pallas_vb(v: int) -> int:
@@ -1014,13 +1017,13 @@ class JaxBackend(Backend):
                 # pads to a 128 multiple with duplicate sources[0] rows
                 # (trimmed below). Interpret-mode CI keeps tiny batches.
                 b_real = int(sources.shape[0])
-                bk = b_real if interpret else 128
+                bk = PALLAS_BATCH_SLICE
                 dists, iters_list, improving = [], [], False
                 row_sweeps = 0
                 for lo in range(0, b_real, bk):
                     sl = sources[lo: lo + bk]
                     b_sl = int(sl.shape[0])
-                    pad = 0 if interpret else (-b_sl) % 128
+                    pad = 0 if interpret else (-b_sl) % bk
                     if pad:
                         sl = jnp.concatenate(
                             [sl, jnp.full(pad, sl[0], jnp.int32)]

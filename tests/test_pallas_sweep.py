@@ -116,6 +116,31 @@ def test_backend_route_use_pallas_true():
     assert res.edges_relaxed > 0
 
 
+def test_backend_route_batch_slicing(monkeypatch):
+    """Batches wider than the VMEM-sized slice run as slices (B=128 on
+    the real chip); shrink the slice constant to cover the multi-slice
+    stitching in interpret mode."""
+    from paralleljohnson_tpu.backends import get_backend, jax_backend as jb
+    from paralleljohnson_tpu.config import SolverConfig
+
+    monkeypatch.setattr(jb, "PALLAS_BATCH_SLICE", 3)
+    g = grid2d(12, 12, seed=5)
+    sources = np.array([0, 7, 50, 99, 120, 143, 1], np.int64)  # 7 = 2 full + ragged
+    backend = get_backend(
+        "jax", SolverConfig(use_pallas=True, mesh_shape=(1,))
+    )
+    res = backend.multi_source(backend.upload(g), sources)
+    assert res.route == "pallas-vm"
+    mat = sp.csr_matrix(
+        (g.weights.astype(np.float64), g.indices, g.indptr),
+        shape=(g.num_nodes, g.num_nodes),
+    )
+    want = csgraph.dijkstra(mat, directed=True, indices=sources)
+    np.testing.assert_allclose(
+        np.asarray(res.dist), want, rtol=1e-5, atol=1e-4
+    )
+
+
 def test_layout_structure():
     g = rmat(8, 8, seed=1)
     vb, ec = 64, 128
