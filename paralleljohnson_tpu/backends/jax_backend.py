@@ -652,7 +652,11 @@ class JaxBackend(Backend):
         Requires the host CSR STRUCTURE only (the layout is
         weight-independent; current device weights are gathered in)."""
         flag = self.config.gauss_seidel
-        if flag is False or dgraph.host_graph is None:
+        if (
+            flag is False
+            or dgraph.host_graph is None
+            or getattr(self, "_gs_disabled", False)
+        ):
             return False
         if flag is True:
             return True
@@ -663,6 +667,36 @@ class JaxBackend(Backend):
         return (
             jax.default_backend() == "tpu"
             and self._low_degree_family(dgraph)
+        )
+
+    def _auto_route_failed(
+        self, flag_attr: str, message: str, *, forced: bool
+    ) -> None:
+        """An auto-selected kernel route raised (call from an active
+        ``except`` block) — typically an XLA/Mosaic rejection or runtime
+        failure on a platform CI cannot cover (the round-3 verdict's
+        'TPU-gated default that never ran on TPU' risk). ``forced``:
+        propagate — the user asked for exactly this kernel. Otherwise:
+        warn once, set ``flag_attr`` on this backend instance so the
+        route is not retried, and let the caller fall through — an auto
+        default must degrade, not crash the solve."""
+        if forced:
+            raise
+        if not getattr(self, flag_attr, False):
+            setattr(self, flag_attr, True)
+            import sys
+            import traceback
+            import warnings
+
+            warnings.warn(message, RuntimeWarning, stacklevel=3)
+            traceback.print_exc(file=sys.stderr)
+
+    def _gs_auto_failed(self, dgraph: JaxDeviceGraph) -> None:
+        self._auto_route_failed(
+            "_gs_disabled",
+            "gauss_seidel='auto' kernel failed on this platform; "
+            "falling back to sweep routes for this backend instance",
+            forced=self.config.gauss_seidel is True,
         )
 
     def _use_edge_shard(self, dgraph: JaxDeviceGraph) -> bool:
@@ -709,31 +743,36 @@ class JaxBackend(Backend):
                 route="edge-sharded",
             )
         if self._use_gs(dgraph):
-            bundle = dgraph.gs_layout(self.config.gs_block_size)
-            dist0_gs = jnp.full(bundle["v_pad"], jnp.inf, self._dtype)
-            if source is None:
-                # Virtual source: 0 at every REAL vertex, +inf pads.
-                dist0_gs = dist0_gs.at[: v].set(0.0)
-            else:
-                dist0_gs = dist0_gs.at[int(bundle["rank_host"][source])].set(0.0)
-            dist, rounds, improving, iters_blk = _gs_kernel(
-                dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
-                bundle["w_blk"], bundle["rank"],
-                vb=bundle["vb"], halo=bundle["halo"],
-                max_outer=max_iter, inner_cap=GS_INNER_CAP,
-            )
-            iters = int(rounds)
-            improving = bool(improving)
-            return KernelResult(
-                dist=dist,
-                negative_cycle=improving and max_iter >= v,
-                converged=not improving,
-                iterations=iters,
-                edges_relaxed=_gs_examined_exact(
-                    iters_blk, bundle["real_edges_host"], 1
-                ),
-                route="gs",
-            )
+            try:
+                bundle = dgraph.gs_layout(self.config.gs_block_size)
+                dist0_gs = jnp.full(bundle["v_pad"], jnp.inf, self._dtype)
+                if source is None:
+                    # Virtual source: 0 at every REAL vertex, +inf pads.
+                    dist0_gs = dist0_gs.at[: v].set(0.0)
+                else:
+                    dist0_gs = dist0_gs.at[
+                        int(bundle["rank_host"][source])
+                    ].set(0.0)
+                dist, rounds, improving, iters_blk = _gs_kernel(
+                    dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
+                    bundle["w_blk"], bundle["rank"],
+                    vb=bundle["vb"], halo=bundle["halo"],
+                    max_outer=max_iter, inner_cap=GS_INNER_CAP,
+                )
+                iters = int(rounds)
+                improving = bool(improving)
+                return KernelResult(
+                    dist=dist,
+                    negative_cycle=improving and max_iter >= v,
+                    converged=not improving,
+                    iterations=iters,
+                    edges_relaxed=_gs_examined_exact(
+                        iters_blk, bundle["real_edges_host"], 1
+                    ),
+                    route="gs",
+                )
+            except Exception:
+                self._gs_auto_failed(dgraph)  # re-raises when forced
         if self._use_frontier(dgraph):
             dist, iters, improving, ex_hi, ex_lo = _bf_frontier_kernel(
                 dist0, dgraph.src, dgraph.dst, dgraph.weights,
@@ -902,6 +941,53 @@ class JaxBackend(Backend):
                 "1-D mesh_shape=(n,) (or leave gauss_seidel='auto' to "
                 "use the 2-D sharded sweep path on this mesh)"
             )
+        if "edges" not in mesh.axis_names and self._use_gs(dgraph):
+            # Both GS fan-out routes, tried ahead of the sweep chain:
+            # single-device blocked GS, or GS composed with source
+            # sharding (layout replicated, batch split, sequential block
+            # schedule per device, no per-round collectives —
+            # parallel.mesh.sharded_gs_fanout). "auto" falls back to the
+            # sweep routes below if the kernel fails (e.g. a Mosaic
+            # rejection of the nested-loop engine on a platform CI can't
+            # cover); a forced flag propagates the error.
+            try:
+                bundle = dgraph.gs_layout(self.config.gs_block_size)
+                if mesh.devices.size > 1:
+                    from paralleljohnson_tpu.parallel import (
+                        sharded_gs_fanout,
+                    )
+
+                    dist, rounds, improving, examined = sharded_gs_fanout(
+                        mesh, sources, bundle["src_blk"],
+                        bundle["dstl_blk"], bundle["w_blk"],
+                        bundle["rank"], v_pad=bundle["v_pad"],
+                        vb=bundle["vb"], halo=bundle["halo"],
+                        max_outer=max_iter, inner_cap=GS_INNER_CAP,
+                        real_edges_host=bundle["real_edges_host"],
+                    )
+                    gs_route = "gs-sharded"
+                else:
+                    dist, rounds, improving, iters_blk = _gs_fanout_kernel(
+                        sources, bundle["src_blk"], bundle["dstl_blk"],
+                        bundle["w_blk"], bundle["rank"],
+                        v_pad=bundle["v_pad"], vb=bundle["vb"],
+                        halo=bundle["halo"], max_outer=max_iter,
+                        inner_cap=GS_INNER_CAP,
+                    )
+                    examined = _gs_examined_exact(
+                        iters_blk, bundle["real_edges_host"],
+                        int(sources.shape[0]),
+                    )
+                    gs_route = "gs"
+                return KernelResult(
+                    dist=dist,
+                    converged=not bool(improving),
+                    iterations=int(rounds),
+                    edges_relaxed=examined,
+                    route=gs_route,
+                )
+            except Exception:
+                self._gs_auto_failed(dgraph)  # re-raises when forced
         if "edges" in mesh.axis_names:
             # 2-D ("sources", "edges") mesh: rows AND edge slices sharded.
             from paralleljohnson_tpu.parallel import sharded_fanout_2d
@@ -922,28 +1008,6 @@ class JaxBackend(Backend):
                 layout=layout, with_row_sweeps=True,
             )
             route = "sharded-2d"
-        elif mesh.devices.size > 1 and self._use_gs(dgraph):
-            # GS composes with source sharding: layout replicated, batch
-            # split, sequential block schedule per device, no per-round
-            # collectives (parallel.mesh.sharded_gs_fanout).
-            from paralleljohnson_tpu.parallel import sharded_gs_fanout
-
-            bundle = dgraph.gs_layout(self.config.gs_block_size)
-            dist, rounds, improving, examined = sharded_gs_fanout(
-                mesh, sources, bundle["src_blk"], bundle["dstl_blk"],
-                bundle["w_blk"], bundle["rank"],
-                v_pad=bundle["v_pad"], vb=bundle["vb"],
-                halo=bundle["halo"], max_outer=max_iter,
-                inner_cap=GS_INNER_CAP,
-                real_edges_host=bundle["real_edges_host"],
-            )
-            return KernelResult(
-                dist=dist,
-                converged=not bool(improving),
-                iterations=int(rounds),
-                edges_relaxed=examined,
-                route="gs-sharded",
-            )
         elif mesh.devices.size > 1:
             from paralleljohnson_tpu.parallel import sharded_fanout
 
@@ -964,25 +1028,6 @@ class JaxBackend(Backend):
                 layout=layout, with_row_sweeps=True,
             )
             route = "sharded-1d"
-        elif self._use_gs(dgraph):
-            bundle = dgraph.gs_layout(self.config.gs_block_size)
-            dist, rounds, improving, iters_blk = _gs_fanout_kernel(
-                sources, bundle["src_blk"], bundle["dstl_blk"],
-                bundle["w_blk"], bundle["rank"],
-                v_pad=bundle["v_pad"], vb=bundle["vb"],
-                halo=bundle["halo"], max_outer=max_iter,
-                inner_cap=GS_INNER_CAP,
-            )
-            return KernelResult(
-                dist=dist,
-                converged=not bool(improving),
-                iterations=int(rounds),
-                edges_relaxed=_gs_examined_exact(
-                    iters_blk, bundle["real_edges_host"],
-                    int(sources.shape[0]),
-                ),
-                route="gs",
-            )
         elif self._use_dense(dgraph):
             use_pallas, interpret = self._pallas_mode()
             dist, iters, improving = _dense_fanout_kernel(
@@ -1052,20 +1097,40 @@ class JaxBackend(Backend):
                     1 << max(0, int(sources.shape[0]) - 1).bit_length(),
                     dgraph.src.shape[0],
                 )
-                lay = (
-                    dgraph.vm_blocked_layout(VM_BLOCK, lay_chunk)
-                    if v > VM_BLOCK else None
-                )
-                if lay is not None:
+                route = None
+                if v > VM_BLOCK and not getattr(
+                    self, "_vmb_disabled", False
+                ):
                     # Large graphs: dst-blocked sweep — per-chunk segment
-                    # writes are [vb, B], not [V, B] (see ops.relax notes).
-                    dist, iters, improving = _fanout_vm_blocked_kernel(
-                        sources, lay["src_ck"], lay["dstl_ck"], lay["w_ck"],
-                        lay["base_ck"], num_nodes=v, v_pad=lay["v_pad"],
-                        vb=lay["vb"], max_iter=max_iter,
-                    )
-                    route = "vm-blocked"
-                else:
+                    # writes are [vb, B], not [V, B] (see ops.relax
+                    # notes). Degrade-don't-crash (size-gated default CI
+                    # cannot run on the real platform): the layout
+                    # build, the kernel, AND the output materialization
+                    # (dispatch is async — a device-time failure only
+                    # surfaces at the int()) all sit inside the try.
+                    try:
+                        lay = dgraph.vm_blocked_layout(VM_BLOCK, lay_chunk)
+                        if lay is not None:
+                            dist, iters, improving = (
+                                _fanout_vm_blocked_kernel(
+                                    sources, lay["src_ck"],
+                                    lay["dstl_ck"], lay["w_ck"],
+                                    lay["base_ck"], num_nodes=v,
+                                    v_pad=lay["v_pad"], vb=lay["vb"],
+                                    max_iter=max_iter,
+                                )
+                            )
+                            iters = int(iters)
+                            route = "vm-blocked"
+                    except Exception:
+                        self._auto_route_failed(
+                            "_vmb_disabled",
+                            "dst-blocked vm fan-out failed on this "
+                            "platform; falling back to the plain vm "
+                            "sweep for this backend instance",
+                            forced=False,
+                        )
+                if route is None:
                     src_bd, dst_bd, w_bd = dgraph.by_dst()
                     dist, iters, improving = _fanout_vm_kernel(
                         sources, src_bd, dst_bd, w_bd,
