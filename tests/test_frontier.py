@@ -79,3 +79,12 @@ def test_solver_end_to_end_with_frontier():
         SolverConfig(backend="jax", frontier=True)
     ).sssp(g, 0)
     np.testing.assert_allclose(res.dist[0], oracle_sssp(g, 0), atol=1e-4)
+
+
+def test_examined_split_counter_decode():
+    """The frontier kernel's split hi/lo counter decodes exactly."""
+    from paralleljohnson_tpu.ops.relax import examined_exact
+
+    assert examined_exact(0, 0) == 0
+    assert examined_exact(3, 5) == 3 * (1 << 20) + 5
+    assert examined_exact(2**30, (1 << 20) - 1) == (2**30 << 20) + (1 << 20) - 1
