@@ -34,6 +34,7 @@ def main():
     print(
         f"rmat20x128 default-config fan-out OK: {dt:.2f}s wall, "
         f"iters={res.stats.iterations_by_phase['fanout']}, "
+        f"routes={dict(res.stats.routes_by_phase)}, "
         f"edges_relaxed={res.stats.edges_relaxed:,}, "
         f"first-rows finite_frac={finite:.2f} — no OOM",
         flush=True,
