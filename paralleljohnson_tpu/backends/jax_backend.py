@@ -1190,6 +1190,7 @@ class JaxBackend(Backend):
             negative_cycle=bool(jnp.any(neg)),
             iterations=int(jnp.max(iters)),
             edges_relaxed=total_iters * e * v,
+            route="batch-vmapped",
         )
 
 
