@@ -8,7 +8,7 @@ import time
 sys.path.insert(0, ".")
 import numpy as np
 
-from paralleljohnson_tpu.backends import get_backend
+from paralleljohnson_tpu.backends import get_backend, jax_backend as jb
 from paralleljohnson_tpu.config import SolverConfig
 from paralleljohnson_tpu.graphs import grid2d
 
@@ -23,18 +23,26 @@ def timed_sssp(backend, dg):
 def main():
     g = grid2d(515, 515, negative_fraction=0.2, seed=7)
     print(f"grid 515x515: V={g.num_nodes} E={g.num_real_edges}", flush=True)
+    # (tag, config, inner_cap) — inner_cap bounds how much extra
+    # per-block propagation a visit does; CPU evidence says cap=64
+    # inflates candidate counts ~5x over the useful work, so the cap is
+    # a first-class knob of the on-chip decision.
     configs = [
         ("gs vb=4096", SolverConfig(gauss_seidel=True, frontier=False,
-                                    gs_block_size=4096)),
+                                    gs_block_size=4096), 64),
         ("gs vb=16384", SolverConfig(gauss_seidel=True, frontier=False,
-                                     gs_block_size=16384)),
+                                     gs_block_size=16384), 64),
+        ("gs vb=16384 cap=8", SolverConfig(
+            gauss_seidel=True, frontier=False, gs_block_size=16384), 8),
         ("gs vb=32768", SolverConfig(gauss_seidel=True, frontier=False,
-                                     gs_block_size=32768)),
-        ("frontier", SolverConfig(frontier=True, gauss_seidel=False)),
-        ("full sweeps", SolverConfig(frontier=False, gauss_seidel=False)),
+                                     gs_block_size=32768), 64),
+        ("frontier", SolverConfig(frontier=True, gauss_seidel=False), 64),
+        ("full sweeps", SolverConfig(frontier=False, gauss_seidel=False), 64),
     ]
     ref = None
-    for tag, cfg in configs:
+    cap0 = jb.GS_INNER_CAP
+    for tag, cfg, cap in configs:
+        jb.GS_INNER_CAP = cap
         backend = get_backend("jax", cfg)
         dg = backend.upload(g)
         dt, r = timed_sssp(backend, dg)
@@ -48,6 +56,7 @@ def main():
             flush=True,
         )
         del dg, backend
+    jb.GS_INNER_CAP = cap0
 
     # Full-Johnson phase-2 shape: the B=64 fan-out on the (now
     # weight-independent-layout) GS route vs the sweep routes — the
