@@ -24,6 +24,14 @@ run() {  # run <seconds> <label> <cmd...>
 # 0) probe
 run 120 probe python -c "import jax,numpy as np; print('probe', int(jax.jit(lambda x:x+1)(np.int32(1))))" || exit 1
 
+# 0b) driver metric FIRST: bench.py is the artifact the round is scored
+# on (round-3 verdict missing #2 — three rounds, zero driver-captured
+# on-chip numbers because the tunnel wedged before stage 5 could run).
+# Its ramp rungs are also the gentlest wedge-safe compile ladder. Run
+# it again at the end (stage 5) so the freshest kernels get the final
+# recorded number.
+run 1200 bench.py-early python bench.py
+
 # 1) blocked-fanout vs plain at rmat20 (the VERDICT #3 decision number)
 run 1800 blocked-vs-plain python scripts/tpu_blocked_micro.py
 
