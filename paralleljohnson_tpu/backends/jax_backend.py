@@ -20,8 +20,9 @@ from paralleljohnson_tpu.backends.base import Backend, KernelResult, register_ba
 from paralleljohnson_tpu.graphs import CSRGraph
 from paralleljohnson_tpu.ops import relax
 
-# Inner-fixpoint cap of the blocked Gauss-Seidel kernels: bounds extra
-# per-block propagation per visit (never correctness — see ops/gauss_seidel).
+# Default inner-fixpoint cap of the blocked Gauss-Seidel kernels
+# (SolverConfig.gs_inner_cap overrides): bounds extra per-block
+# propagation per visit (never correctness — see ops/gauss_seidel).
 GS_INNER_CAP = 64
 
 # Edge count above which the dst-blocked layout is built on DEVICE
@@ -757,7 +758,7 @@ class JaxBackend(Backend):
                     dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
                     bundle["w_blk"], bundle["rank"],
                     vb=bundle["vb"], halo=bundle["halo"],
-                    max_outer=max_iter, inner_cap=GS_INNER_CAP,
+                    max_outer=max_iter, inner_cap=self.config.gs_inner_cap,
                 )
                 iters = int(rounds)
                 improving = bool(improving)
@@ -962,7 +963,7 @@ class JaxBackend(Backend):
                         bundle["dstl_blk"], bundle["w_blk"],
                         bundle["rank"], v_pad=bundle["v_pad"],
                         vb=bundle["vb"], halo=bundle["halo"],
-                        max_outer=max_iter, inner_cap=GS_INNER_CAP,
+                        max_outer=max_iter, inner_cap=self.config.gs_inner_cap,
                         real_edges_host=bundle["real_edges_host"],
                     )
                     gs_route = "gs-sharded"
@@ -972,7 +973,7 @@ class JaxBackend(Backend):
                         bundle["w_blk"], bundle["rank"],
                         v_pad=bundle["v_pad"], vb=bundle["vb"],
                         halo=bundle["halo"], max_outer=max_iter,
-                        inner_cap=GS_INNER_CAP,
+                        inner_cap=self.config.gs_inner_cap,
                     )
                     examined = _gs_examined_exact(
                         iters_blk, bundle["real_edges_host"],

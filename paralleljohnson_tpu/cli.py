@@ -60,6 +60,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "direction changes, not diameter)")
     p.add_argument("--gs-block-size", type=int, default=4096,
                    help="vertices per Gauss-Seidel block")
+    p.add_argument("--gs-inner-cap", type=int, default=64,
+                   help="max Gauss-Seidel inner iterations per block "
+                        "visit (bounds extra propagation, not correctness)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--predecessors", action="store_true",
                    help="also compute shortest-path trees (saved to --output)")
@@ -94,6 +97,7 @@ def _config(args) -> "SolverConfig":
         edge_shard=tristate[args.edge_shard],
         gauss_seidel=tristate[args.gauss_seidel],
         gs_block_size=args.gs_block_size,
+        gs_inner_cap=args.gs_inner_cap,
         checkpoint_dir=args.checkpoint_dir,
         validate=args.validate,
     )

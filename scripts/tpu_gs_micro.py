@@ -8,7 +8,7 @@ import time
 sys.path.insert(0, ".")
 import numpy as np
 
-from paralleljohnson_tpu.backends import get_backend, jax_backend as jb
+from paralleljohnson_tpu.backends import get_backend
 from paralleljohnson_tpu.config import SolverConfig
 from paralleljohnson_tpu.graphs import grid2d
 
@@ -33,16 +33,15 @@ def main():
         ("gs vb=16384", SolverConfig(gauss_seidel=True, frontier=False,
                                      gs_block_size=16384), 64),
         ("gs vb=16384 cap=8", SolverConfig(
-            gauss_seidel=True, frontier=False, gs_block_size=16384), 8),
+            gauss_seidel=True, frontier=False, gs_block_size=16384,
+            gs_inner_cap=8), 8),
         ("gs vb=32768", SolverConfig(gauss_seidel=True, frontier=False,
                                      gs_block_size=32768), 64),
         ("frontier", SolverConfig(frontier=True, gauss_seidel=False), 64),
         ("full sweeps", SolverConfig(frontier=False, gauss_seidel=False), 64),
     ]
     ref = None
-    cap0 = jb.GS_INNER_CAP
-    for tag, cfg, cap in configs:
-        jb.GS_INNER_CAP = cap
+    for tag, cfg, _cap in configs:
         backend = get_backend("jax", cfg)
         dg = backend.upload(g)
         dt, r = timed_sssp(backend, dg)
@@ -56,7 +55,6 @@ def main():
             flush=True,
         )
         del dg, backend
-    jb.GS_INNER_CAP = cap0
 
     # Full-Johnson phase-2 shape: the B=64 fan-out on the (now
     # weight-independent-layout) GS route vs the sweep routes — the
