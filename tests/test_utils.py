@@ -76,3 +76,27 @@ def test_solver_stats_accumulate_and_rate():
     assert stats.edges_relaxed_per_second() >= 0
     d = stats.as_dict()
     assert d["edges_relaxed"] == 150
+
+
+def test_solver_stats_route_change_accumulates():
+    """A phase whose route degrades mid-solve must record every distinct
+    route in order ("vm-blocked+vm"), not just the last write — last-
+    write-wins misattributed the measured kernel (ADVICE round 4)."""
+    from paralleljohnson_tpu.backends.base import KernelResult
+
+    stats = SolverStats()
+    for route in ("vm-blocked", "vm-blocked", "vm", "vm"):
+        stats.accumulate(
+            KernelResult(
+                dist=np.zeros(1), iterations=1, edges_relaxed=1, route=route
+            ),
+            phase="fanout",
+        )
+    assert stats.routes_by_phase["fanout"] == "vm-blocked+vm"
+    # A single-route phase stays a plain tag.
+    stats.accumulate(
+        KernelResult(dist=np.zeros(1), iterations=1, edges_relaxed=1,
+                     route="gs"),
+        phase="bellman_ford",
+    )
+    assert stats.routes_by_phase["bellman_ford"] == "gs"
