@@ -79,7 +79,12 @@ class SolverConfig:
         False disables.
       gs_block_size: vertices per Gauss-Seidel block (the inner-fixpoint
         unit; bigger blocks = fewer, larger device ops but more inner
-        iterations per block).
+        iterations per block). Default 8192: at full dimacs scale it
+        halves the sequential device steps of vb=4096 (11,224 vs
+        20,830) for +7% candidate work — dominant on both terms of the
+        on-chip cost model (bench_artifacts/gs_offchip_validation.md);
+        the staged on-chip vb sweep (scripts/tpu_gs_micro.py) settles
+        the final value.
       gs_inner_cap: max inner iterations per block visit. Bounds EXTRA
         per-visit propagation, never correctness; lower caps cut
         candidate work (CPU evidence: cap=64 examines ~2.3x Jacobi's
@@ -109,7 +114,7 @@ class SolverConfig:
     frontier: bool | str = "auto"
     frontier_capacity: int | None = None
     gauss_seidel: bool | str = "auto"
-    gs_block_size: int = 4096
+    gs_block_size: int = 8192
     gs_inner_cap: int = 64
     edge_shard: bool | str = "auto"
     checkpoint_dir: str | None = None

@@ -30,8 +30,16 @@ def main():
     configs = [
         ("gs vb=4096", SolverConfig(gauss_seidel=True, frontier=False,
                                     gs_block_size=4096), 64),
+        # vb=8192 halves sequential steps vs 4096 for +7% candidates;
+        # larger vb keeps trading (bench_artifacts/
+        # gs_offchip_validation.md has the full CPU-measured table):
+        # price the per-step fixed cost here and pick the default.
+        ("gs vb=8192", SolverConfig(gauss_seidel=True, frontier=False,
+                                    gs_block_size=8192), 64),
         ("gs vb=16384", SolverConfig(gauss_seidel=True, frontier=False,
                                      gs_block_size=16384), 64),
+        ("gs vb=65536", SolverConfig(gauss_seidel=True, frontier=False,
+                                     gs_block_size=65536), 64),
         ("gs vb=16384 cap=8", SolverConfig(
             gauss_seidel=True, frontier=False, gs_block_size=16384,
             gs_inner_cap=8), 8),
