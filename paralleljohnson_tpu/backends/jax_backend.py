@@ -99,6 +99,17 @@ class JaxDeviceGraph:
             self._by_dst_cache["max_deg"] = cached
         return cached
 
+    def _gather_weights_with_holes(self, edge_ids) -> jax.Array:
+        """CURRENT device weights at ``edge_ids`` (any shape), with
+        negative ids (layout holes / padding) as +inf no-ops — the one
+        idiom every weight-independent layout uses to re-derive its
+        weights after reweighting."""
+        return jnp.where(
+            edge_ids >= 0,
+            self.weights[jnp.maximum(edge_ids, 0)],
+            jnp.inf,
+        ).astype(self.weights.dtype)
+
     def vm_blocked_layout(self, vb: int, ec: int) -> dict | None:
         """Device-resident dst-blocked fan-out layout
         (``ops.relax.build_vm_blocked_layout``): weight-independent chunk
@@ -162,12 +173,9 @@ class JaxDeviceGraph:
                     struct["src_ck"].size, struct["src_ck"].shape,
                 )
             else:
-                order = struct["edge_order"]
-                w_ck = jnp.where(
-                    order >= 0,
-                    self.weights[jnp.maximum(order, 0)],
-                    jnp.inf,
-                ).astype(self.weights.dtype)
+                w_ck = self._gather_weights_with_holes(
+                    struct["edge_order"]
+                )
             self._by_dst_cache[key] = w_ck
         return {**struct, "w_ck": w_ck}
 
@@ -230,14 +238,45 @@ class JaxDeviceGraph:
             self._struct_cache[key] = struct
         w_ck = self._by_dst_cache.get(key)
         if w_ck is None:
-            order = struct["edge_order"]
-            w_ck = jnp.where(
-                order >= 0,
-                self.weights[jnp.maximum(order, 0)],
-                jnp.inf,
-            ).astype(self.weights.dtype)
+            w_ck = self._gather_weights_with_holes(struct["edge_order"])
             self._by_dst_cache[key] = w_ck
         return {**struct, "w_ck": w_ck}
+
+    def dia_layout(self, max_offsets: int) -> dict | None:
+        """Device-resident DIA (diagonal) layout for the gather-free B=1
+        relaxation sweep (``ops.dia``): weight-independent structure
+        (offsets + per-slot edge ids) cached across reweight in
+        ``_struct_cache``; the [K, V] diagonal weights are gathered from
+        the CURRENT device weights (same pattern as ``gs_layout``).
+        None when no host CSR is available or the given labeling is not
+        diagonal (``build_dia_layout`` contract)."""
+        if self.host_graph is None:
+            return None
+        key = ("dia", max_offsets)
+        struct = self._struct_cache.get(key)
+        if struct == "none":
+            return None
+        if struct is None:
+            from paralleljohnson_tpu.ops.dia import build_dia_layout
+
+            g = self.host_graph
+            host = build_dia_layout(
+                g.indptr, g.indices, g.num_nodes, max_offsets=max_offsets
+            )
+            if host is None:
+                self._struct_cache[key] = "none"
+                return None
+            struct = {
+                "offsets": host["offsets"],
+                "diag_edge": jnp.asarray(host["diag_edge"], jnp.int32),
+                "num_entries": host["num_entries"],
+            }
+            self._struct_cache[key] = struct
+        w_diag = self._by_dst_cache.get(key)
+        if w_diag is None:
+            w_diag = self._gather_weights_with_holes(struct["diag_edge"])
+            self._by_dst_cache[key] = w_diag
+        return {**struct, "w_diag": w_diag}
 
     def gs_layout(self, vb: int) -> dict | None:
         """Device-resident blocked Gauss-Seidel layout (RCM relabeling +
@@ -275,12 +314,7 @@ class JaxDeviceGraph:
             self._struct_cache[key] = struct
         w_blk = self._by_dst_cache.get(key)
         if w_blk is None:
-            order = struct["edge_order"]
-            w_blk = jnp.where(
-                order >= 0,
-                self.weights[jnp.maximum(order, 0)],
-                jnp.inf,
-            ).astype(self.weights.dtype)
+            w_blk = self._gather_weights_with_holes(struct["edge_order"])
             self._by_dst_cache[key] = w_blk
         return {**struct, "w_blk": w_blk}
 
@@ -724,6 +758,34 @@ class JaxBackend(Backend):
             and self._low_degree_family(dgraph)
         )
 
+    def _use_dia(self, dgraph: JaxDeviceGraph) -> bool:
+        """Gather-free DIA stencil route for B=1 solves (ops.dia): on
+        TPU it sidesteps the XLA row-gather floor that lower-bounds
+        every gather-based sweep (the round-5 off-chip analysis,
+        bench_artifacts/gs_offchip_validation.md), so "auto" prefers it
+        whenever the graph's labeling is diagonal. An explicitly forced
+        frontier/gauss_seidel route wins over "auto" (the "True forces"
+        contract); on CPU the frontier's compacted work stays the
+        measured winner, so auto is TPU-only."""
+        flag = self.config.dia
+        if (
+            flag is False
+            or dgraph.host_graph is None
+            or getattr(self, "_dia_disabled", False)
+        ):
+            return False
+        if flag is True:
+            return self.dia_bundle(dgraph) is not None
+        if self.config.frontier is True or self.config.gauss_seidel is True:
+            return False
+        return (
+            jax.default_backend() == "tpu"
+            and self.dia_bundle(dgraph) is not None
+        )
+
+    def dia_bundle(self, dgraph: JaxDeviceGraph) -> dict | None:
+        return dgraph.dia_layout(self.config.dia_max_offsets)
+
     def _auto_route_failed(
         self, flag_attr: str, message: str, *, forced: bool
     ) -> None:
@@ -765,7 +827,11 @@ class JaxBackend(Backend):
             return False
         if flag is True:
             return True
-        return not (self._use_frontier(dgraph) or self._use_gs(dgraph))
+        return not (
+            self._use_frontier(dgraph)
+            or self._use_gs(dgraph)
+            or self._use_dia(dgraph)
+        )
 
     def bellman_ford(self, dgraph: JaxDeviceGraph, source: int | None) -> KernelResult:
         v = dgraph.num_nodes
@@ -797,6 +863,34 @@ class JaxBackend(Backend):
                 edges_relaxed=iters * dgraph.num_real_edges,
                 route="edge-sharded",
             )
+        if self._use_dia(dgraph):
+            try:
+                lay = self.dia_bundle(dgraph)
+                from paralleljohnson_tpu.ops.dia import dia_fixpoint
+
+                dist, iters, improving = dia_fixpoint(
+                    dist0, lay["w_diag"],
+                    offsets=lay["offsets"], max_iter=max_iter,
+                )
+                iters = int(iters)
+                improving = bool(improving)
+                return KernelResult(
+                    dist=dist,
+                    negative_cycle=improving and max_iter >= v,
+                    converged=not improving,
+                    iterations=iters,
+                    # Each chained sweep examines every stored diagonal
+                    # entry once (= E: the layout stores all real edges).
+                    edges_relaxed=iters * lay["num_entries"],
+                    route="dia",
+                )
+            except Exception:
+                self._auto_route_failed(
+                    "_dia_disabled",
+                    "dia stencil route failed on this platform; falling "
+                    "back to the gather routes for this backend instance",
+                    forced=self.config.dia is True,
+                )
         if self._use_gs(dgraph):
             try:
                 bundle = dgraph.gs_layout(self.config.gs_block_size)

@@ -58,7 +58,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="blocked Gauss-Seidel for high-diameter graphs "
                         "(auto: low-degree graphs on TPU; rounds ~ path "
                         "direction changes, not diameter)")
-    p.add_argument("--gs-block-size", type=int, default=4096,
+    p.add_argument("--dia", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="gather-free DIA stencil route for B=1 solves on "
+                        "diagonally-labeled graphs (lattices/banded "
+                        "meshes; auto: on TPU when the labeling qualifies)")
+    p.add_argument("--dia-max-offsets", type=int, default=16,
+                   help="max distinct edge diagonals the DIA route accepts")
+    p.add_argument("--gs-block-size", type=int, default=8192,
                    help="vertices per Gauss-Seidel block")
     p.add_argument("--gs-inner-cap", type=int, default=64,
                    help="max Gauss-Seidel inner iterations per block "
@@ -96,6 +103,8 @@ def _config(args) -> "SolverConfig":
         frontier=tristate[args.frontier],
         edge_shard=tristate[args.edge_shard],
         gauss_seidel=tristate[args.gauss_seidel],
+        dia=tristate[args.dia],
+        dia_max_offsets=args.dia_max_offsets,
         gs_block_size=args.gs_block_size,
         gs_inner_cap=args.gs_inner_cap,
         checkpoint_dir=args.checkpoint_dir,

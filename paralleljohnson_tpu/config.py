@@ -77,6 +77,15 @@ class SolverConfig:
         device) but NOT with an "edges" mesh axis (raises when forced).
         An explicit ``frontier=True`` beats gauss_seidel="auto".
         False disables.
+      dia: gather-free DIA (diagonal/stencil) route for B=1 solves on
+        graphs whose GIVEN labeling puts every edge on few index
+        diagonals (lattices, banded meshes — ``ops.dia``). ``"auto"``
+        prefers it on TPU whenever the labeling qualifies: it sidesteps
+        the XLA row-gather floor that lower-bounds every gather-based
+        sweep (bench_artifacts/gs_offchip_validation.md). An explicit
+        ``frontier=True`` or ``gauss_seidel=True`` beats dia="auto".
+      dia_max_offsets: max distinct (dst - src) diagonals the DIA
+        layout accepts before disqualifying the graph.
       gs_block_size: vertices per Gauss-Seidel block (the inner-fixpoint
         unit; bigger blocks = fewer, larger device ops but more inner
         iterations per block). Default 8192: at full dimacs scale it
@@ -113,6 +122,8 @@ class SolverConfig:
     fanout_layout: str = "auto"
     frontier: bool | str = "auto"
     frontier_capacity: int | None = None
+    dia: bool | str = "auto"
+    dia_max_offsets: int = 16
     gauss_seidel: bool | str = "auto"
     gs_block_size: int = 8192
     gs_inner_cap: int = 64
@@ -144,6 +155,14 @@ class SolverConfig:
             raise ValueError(
                 "gauss_seidel must be True/False/'auto', "
                 f"got {self.gauss_seidel!r}"
+            )
+        if self.dia not in (True, False, "auto"):
+            raise ValueError(
+                f"dia must be True/False/'auto', got {self.dia!r}"
+            )
+        if self.dia_max_offsets < 1:
+            raise ValueError(
+                f"dia_max_offsets must be >= 1, got {self.dia_max_offsets}"
             )
         if self.gs_block_size < 1:
             raise ValueError(

@@ -1,0 +1,118 @@
+"""DIA-format (diagonal) Bellman-Ford relaxation — the gather-free B=1
+SSSP route.
+
+Why (bench_artifacts/gs_offchip_validation.md, round-5): every
+gather-based sweep route pays the XLA row-gather floor per candidate
+(~12.5 ns/row measured on-chip), which lower-bounds the full-dimacs B=1
+solve at 4.3-7 s no matter the schedule. But a lattice-labeled road
+grid — the ``dimacs_ny_bf`` stand-in exactly — has every edge on one of
+a handful of index diagonals (offset d = dst - src in {+1, -1, +cols,
+-cols}), so a relaxation sweep is a STENCIL: for each stored diagonal,
+``min(d, roll(d, off) + w_diag)`` over the whole [V] vector. No gather,
+no scatter, no nonzero — pure VPU element-wise work on contiguous
+vectors, ~K x V x 4 bytes of traffic per sweep (microseconds at HBM
+bandwidth). 1125 diameter-bound sweeps at that cost beat every
+gather-bound alternative by orders of magnitude.
+
+This is the classic DIA/stencil sparse format, not a benchmark special
+case — but its applicability domain is exactly as narrow as DIA's:
+the GIVEN vertex labeling must place all edges on at most
+``max_offsets`` distinct diagonals (lattices and banded meshes in
+natural order qualify; scrambled labelings and power-law graphs do
+not). No relabeling pass is attempted: bandwidth reduction (RCM) packs
+edges NEAR the diagonal but onto ~bandwidth DISTINCT offsets, which
+buys DIA nothing. ``build_dia_layout`` returns None for unqualified
+graphs and dispatch falls through to the gather routes
+(backends/jax_backend.py ``_use_dia``).
+
+Correctness: the sweep is chained (later diagonals read earlier
+diagonals' updates within one sweep) — relaxation is monotone, so any
+schedule converges to the same fixpoint, and a chained sweep subsumes
+one Jacobi round; "still improving after max_iter >= V sweeps" remains
+a reachable-negative-cycle certificate (same contract as
+``relax.bellman_ford_sweeps``). ``jnp.roll`` is circular: a wrapped
+position (t, t - off out of range) carries no real edge, so its
+``w_diag`` slot is +inf by construction and the wrap contributes
+nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def build_dia_layout(
+    indptr: np.ndarray, indices: np.ndarray, num_nodes: int, *,
+    max_offsets: int = 16,
+):
+    """Host preprocessing (weight-INDEPENDENT, reusable across
+    reweights). Returns None unless every edge of the graph, in its
+    given labeling, lies on one of at most ``max_offsets`` distinct
+    diagonals and no two edges share a (diagonal, dst) slot (i.e. no
+    parallel edges).
+
+    Returns dict:
+      offsets    tuple[int, ...]   the K distinct (dst - src) values
+      diag_edge  int32 [K, V]      original edge id per slot (-1 = hole)
+      num_entries int              real edges stored (== E)
+    """
+    v = num_nodes
+    e = int(indptr[-1])
+    if e == 0:
+        return None
+    src = np.repeat(np.arange(v, dtype=np.int64), np.diff(indptr))
+    dst = indices[:e].astype(np.int64)
+    offs = dst - src
+    uniq = np.unique(offs)
+    if len(uniq) > max_offsets:
+        return None
+    k = len(uniq)
+    kidx = np.searchsorted(uniq, offs)
+    slot = kidx * v + dst
+    # One edge per (diagonal, dst) slot — parallel edges disqualify the
+    # layout (min-merging them would make the structure depend on the
+    # current weights, breaking reuse across Johnson reweighting).
+    if len(np.unique(slot)) != e:
+        return None
+    diag_edge = np.full(k * v, -1, np.int32)
+    diag_edge[slot] = np.arange(e, dtype=np.int32)
+    return {
+        "offsets": tuple(int(o) for o in uniq),
+        "diag_edge": diag_edge.reshape(k, v),
+        "num_entries": e,
+    }
+
+
+def dia_sweep(d, w_diag, *, offsets: tuple):
+    """One chained relaxation sweep over the stored diagonals."""
+    nd = d
+    for ki, off in enumerate(offsets):
+        # Edge (t - off) -> t relaxes nd[t] against nd[t - off] + w:
+        # roll by +off aligns source values under their destinations.
+        nd = jnp.minimum(nd, jnp.roll(nd, off) + w_diag[ki])
+    return nd
+
+
+@functools.partial(jax.jit, static_argnames=("offsets", "max_iter"))
+def dia_fixpoint(dist0, w_diag, *, offsets: tuple, max_iter: int):
+    """Fixpoint of :func:`dia_sweep`; same contract as
+    ``relax.bellman_ford_sweeps``: (dist, iterations, still_improving).
+    """
+
+    def cond(state):
+        _, i, improving = state
+        return improving & (i < max_iter)
+
+    def body(state):
+        d, i, _ = state
+        nd = dia_sweep(d, w_diag, offsets=offsets)
+        return nd, i + 1, jnp.any(nd < d)
+
+    return lax.while_loop(
+        cond, body, (dist0, jnp.int32(0), jnp.any(jnp.isfinite(dist0)))
+    )

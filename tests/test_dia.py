@@ -1,0 +1,143 @@
+"""DIA (diagonal/stencil) Bellman-Ford route tests (ops/dia.py — the
+round-5 gather-free B=1 path). Correctness bar: identical results to
+the sweep routes and the scipy oracle on qualifying (diagonally
+labeled) graphs, clean disqualification on everything else, and the
+same negative-cycle / reweight contracts as the gather routes."""
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.backends import get_backend
+from paralleljohnson_tpu.graphs import CSRGraph, grid2d, rmat
+from paralleljohnson_tpu.ops.dia import build_dia_layout, dia_fixpoint
+
+from conftest import oracle_sssp
+
+
+def _bf(g, source, **cfg):
+    be = get_backend("jax", SolverConfig(**cfg))
+    return be.bellman_ford(be.upload(g), source)
+
+
+def test_layout_grid_has_four_offsets():
+    g = grid2d(9, 7, seed=1)
+    lay = build_dia_layout(g.indptr, g.indices, g.num_nodes)
+    assert lay is not None
+    assert lay["offsets"] == (-7, -1, 1, 7)
+    assert lay["num_entries"] == g.num_real_edges
+    # Every real edge lands in exactly one slot.
+    assert int((lay["diag_edge"] >= 0).sum()) == g.num_real_edges
+
+
+def test_layout_rejects_powerlaw_and_parallel_edges():
+    g = rmat(8, 8, seed=3)
+    assert build_dia_layout(g.indptr, g.indices, g.num_nodes) is None
+    # Parallel edges share a (diagonal, dst) slot -> disqualified.
+    gp = CSRGraph(
+        indptr=np.array([0, 2, 2], np.int32),
+        indices=np.array([1, 1], np.int32),
+        weights=np.array([1.0, 2.0], np.float32),
+    )
+    assert build_dia_layout(gp.indptr, gp.indices, gp.num_nodes) is None
+
+
+@pytest.mark.parametrize("neg", [0.0, 0.25])
+def test_dia_matches_oracle_on_grid(neg):
+    g = grid2d(13, 13, negative_fraction=neg, seed=2)
+    res = _bf(g, 0, dia=True)
+    assert res.route == "dia"
+    np.testing.assert_allclose(res.dist, oracle_sssp(g, 0), atol=1e-4)
+    assert res.converged and not res.negative_cycle
+    # Exact per-sweep accounting: every stored diagonal entry, once.
+    assert res.edges_relaxed == res.iterations * g.num_real_edges
+
+
+def test_dia_equals_full_sweeps():
+    g = grid2d(17, 17, negative_fraction=0.2, seed=5)
+    a = _bf(g, 3, dia=True)
+    b = _bf(g, 3, dia=False, frontier=False, gauss_seidel=False,
+            edge_shard=False)
+    assert a.route == "dia" and b.route == "sweep"
+    np.testing.assert_allclose(a.dist, b.dist, atol=1e-4)
+
+
+def test_dia_negative_cycle_certified():
+    # 0 <-> 1 with total weight < 0: offsets {+1, -1}, a 2-cycle.
+    g = CSRGraph(
+        indptr=np.array([0, 1, 2, 2], np.int32),
+        indices=np.array([1, 0], np.int32),
+        weights=np.array([1.0, -2.0], np.float32),
+    )
+    res = _bf(g, 0, dia=True)
+    assert res.route == "dia"
+    assert res.negative_cycle
+
+
+def test_dia_forced_on_unqualified_graph_falls_through():
+    # dia=True on a non-diagonal graph: the layout is None, so dispatch
+    # must fall through to the gather routes (no crash, correct result).
+    g = rmat(7, 8, seed=4)
+    res = _bf(g, 0, dia=True, frontier=False, gauss_seidel=False,
+              edge_shard=False)
+    assert res.route == "sweep"
+    np.testing.assert_allclose(res.dist, oracle_sssp(g, 0), atol=1e-4)
+
+
+def test_dia_survives_reweight():
+    """Johnson phase 2 precondition: the DIA structure is
+    weight-independent and the diagonal weights are re-gathered from
+    the CURRENT device weights after reweighting."""
+    g = grid2d(11, 11, negative_fraction=0.3, seed=7)
+    be = get_backend("jax", SolverConfig(dia=True))
+    dg = be.upload(g)
+    r1 = be.bellman_ford(dg, None)  # virtual source: potentials
+    assert r1.route == "dia" and not r1.negative_cycle
+    h = np.asarray(r1.dist)
+    dg2 = be.reweight(dg, h)
+    r2 = be.bellman_ford(dg2, 0)
+    assert r2.route == "dia"
+    # Reweighted distances un-reweight to the original SSSP distances.
+    want = oracle_sssp(g, 0)
+    got = np.asarray(r2.dist) - h[0] + h
+    np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+def test_dia_full_johnson_solve_routes_phase1():
+    g = grid2d(12, 12, negative_fraction=0.25, seed=9)
+    solver = ParallelJohnsonSolver(SolverConfig(dia=True, validate=True))
+    res = solver.solve(g, sources=np.arange(8))
+    assert res.stats.routes_by_phase.get("bellman_ford") == "dia"
+
+
+def test_dia_auto_is_tpu_only_on_cpu_mesh():
+    # On the CPU test mesh, auto must NOT pick dia (frontier/sweeps
+    # measure faster on CPU); an explicit dia=True must.
+    g = grid2d(9, 9, seed=0)
+    assert _bf(g, 0, dia="auto").route != "dia"
+    assert _bf(g, 0, dia=True).route == "dia"
+
+
+def test_dia_fixpoint_kernel_direct():
+    # Chained sweep converges to the oracle fixpoint on a 1-D chain
+    # with a backward shortcut (offsets +1 and -3).
+    g = CSRGraph(
+        indptr=np.array([0, 1, 2, 3, 5, 5], np.int32),
+        indices=np.array([1, 2, 3, 4, 0], np.int32),
+        weights=np.array([1.0, 1.0, 1.0, 1.0, -2.5], np.float32),
+    )
+    lay = build_dia_layout(g.indptr, g.indices, g.num_nodes)
+    assert lay is not None and set(lay["offsets"]) == {1, -3}
+    import jax.numpy as jnp
+
+    w_diag = jnp.where(
+        lay["diag_edge"] >= 0,
+        jnp.asarray(g.weights)[np.maximum(lay["diag_edge"], 0)],
+        jnp.inf,
+    )
+    dist0 = jnp.full(g.num_nodes, jnp.inf).at[0].set(0.0)
+    dist, iters, improving = dia_fixpoint(
+        dist0, w_diag, offsets=lay["offsets"], max_iter=g.num_nodes
+    )
+    np.testing.assert_allclose(np.asarray(dist), oracle_sssp(g, 0), atol=1e-5)
+    assert not bool(improving)
