@@ -163,6 +163,15 @@ def _emit(measured: dict, tag: str) -> None:
                   "oracle_ok", "route", "repeats", "config")
         if k in measured and measured[k] is not None
     }
+    if measured.get("platform") != "tpu":
+        # Round-4 verdict weak #3: the cpu-fallback series (634 -> 742
+        # -> 809 M edges/s across rounds 2-4 at the same config) is
+        # container-CPU noise on an unchanged kernel, not progress —
+        # say so IN the artifact so a rising number can't be misread.
+        detail["fallback_note"] = (
+            "cpu-fallback: not a TPU measurement; round-over-round "
+            "variation at this config is host noise, not kernel change"
+        )
     if detail:
         out["detail"] = detail
     print(json.dumps(out))
