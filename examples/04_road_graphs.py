@@ -1,0 +1,59 @@
+"""High-diameter road graphs: how the B=1 kernel routes trade off.
+
+Road networks are the hard case for sweep-based SSSP (diameter-bound
+round counts — SURVEY.md §7 "Hard parts" #1). This example runs the same
+negative-weight road-grid SSSP through each route and prints the route
+tag, round count, and exact candidate work, so you can see what `auto`
+is choosing between:
+
+  dia       gather-free stencil sweeps — lattice/banded labelings only,
+            the TPU auto-pick when the labeling qualifies
+  gs        blocked Gauss-Seidel — rounds ~ path direction changes,
+            the TPU auto-pick for other low-degree graphs
+  frontier  compacted active-vertex relaxation — the CPU auto-pick
+  sweep     full Jacobi relaxation — the baseline everything beats
+
+Run: python examples/04_road_graphs.py
+(PJ_EXAMPLE_ROWS scales the grid; CI runs it tiny.)
+"""
+
+import os
+import time
+
+import numpy as np
+
+import paralleljohnson_tpu as pj
+from paralleljohnson_tpu.backends import get_backend
+
+rows = int(os.environ.get("PJ_EXAMPLE_ROWS", "60"))
+g = pj.load_graph(f"grid:rows={rows},cols={rows},neg=0.2,seed=7")
+print(f"road grid: {g.num_nodes} nodes, {g.num_real_edges} edges, "
+      f"diameter ~{2 * rows}")
+
+ref = None
+for tag, cfg in [
+    ("dia", dict(dia=True)),
+    ("gs", dict(dia=False, gauss_seidel=True, frontier=False)),
+    ("frontier", dict(dia=False, gauss_seidel=False, frontier=True)),
+    ("sweep", dict(dia=False, gauss_seidel=False, frontier=False,
+                   edge_shard=False)),
+]:
+    be = get_backend("jax", pj.SolverConfig(**cfg))
+    dg = be.upload(g)
+    res = be.bellman_ford(dg, source=0)  # compile + warm
+    t0 = time.perf_counter()
+    res = be.bellman_ford(dg, source=0)
+    dt = time.perf_counter() - t0
+    d = np.asarray(res.dist)
+    ref = d if ref is None else ref
+    agree = bool(np.allclose(d, ref, rtol=1e-4, atol=1e-3))
+    print(f"  {tag:9s} route={res.route:9s} rounds={res.iterations:5d} "
+          f"candidates={res.edges_relaxed:>13,} {dt * 1e3:8.1f} ms "
+          f"agree={agree}")
+
+# The same routes serve Johnson's phase 1 (virtual-source potentials) —
+# `auto` picks per platform: dia/gs on TPU, frontier on CPU.
+res = pj.ParallelJohnsonSolver(pj.SolverConfig()).solve(
+    g, sources=np.arange(4)
+)
+print(f"full Johnson: phase routes {res.stats.routes_by_phase}")
