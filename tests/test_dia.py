@@ -141,3 +141,33 @@ def test_dia_fixpoint_kernel_direct():
     )
     np.testing.assert_allclose(np.asarray(dist), oracle_sssp(g, 0), atol=1e-5)
     assert not bool(improving)
+
+
+def test_dia_f64():
+    import subprocess
+    import sys
+    import os
+
+    script = """
+import jax
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from paralleljohnson_tpu.backends import get_backend
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import grid2d
+g = grid2d(9, 9, negative_fraction=0.2, seed=4, dtype=np.float64)
+be = get_backend("jax", SolverConfig(dia=True, precision="f64"))
+res = be.bellman_ford(be.upload(g), 0)
+assert res.route == "dia", res.route
+assert np.asarray(res.dist).dtype == np.float64
+print("ok")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip().endswith("ok")

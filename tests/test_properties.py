@@ -85,11 +85,12 @@ def test_reweighted_nonnegative():
 
 
 @settings(max_examples=25, deadline=None)
-@given(graphs(negative=True), st.integers(0, 5))
+@given(graphs(negative=True), st.integers(0, 6))
 def test_layouts_and_frontier_agree(g, knob):
     """Every kernel-routing knob computes the same distances: fan-out
     layouts, forced frontier, forced Gauss-Seidel (SSSP phase), the
-    dst-blocked fan-out, forced dense — all against the numpy oracle
+    dst-blocked fan-out, forced dense, forced DIA (qualifies or falls
+    through, result must not change) — all against the numpy oracle
     backend on the same random negative-weight DAG."""
     from paralleljohnson_tpu.backends import jax_backend
 
@@ -104,6 +105,7 @@ def test_layouts_and_frontier_agree(g, knob):
         # route (checked first in multi_source); VM_BLOCK shrunk below.
         SolverConfig(backend="jax", fanout_layout="vertex_major",
                      mesh_shape=(1,), dense_threshold=0),
+        SolverConfig(backend="jax", dia=True),
     ]
     if knob == 5:
         # Route the dst-blocked fan-out at toy scale.
