@@ -1091,6 +1091,46 @@ class JaxBackend(Backend):
                 "1-D mesh_shape=(n,) (or leave gauss_seidel='auto' to "
                 "use the 2-D sharded sweep path on this mesh)"
             )
+        if mesh.devices.size == 1 and self._use_dia(dgraph):
+            # DIA stencil fan-out, tried ahead of every gather route:
+            # on a lattice labeling each sweep is K contiguous [B, V]
+            # roll+add+min passes — pure bandwidth, no per-row gather —
+            # so it wins wherever the B=1 dia route does, at any batch
+            # width. Single-device only (rows are independent; a
+            # sharded composition can come later), degrade-don't-crash
+            # like every auto route.
+            try:
+                lay = self.dia_bundle(dgraph)
+                from paralleljohnson_tpu.ops.dia import dia_fixpoint
+
+                dist0_bv = jnp.full((sources.shape[0], v), jnp.inf,
+                                    self._dtype)
+                dist0_bv = dist0_bv.at[
+                    jnp.arange(sources.shape[0]), sources
+                ].set(0.0)
+                dist, iters, improving = dia_fixpoint(
+                    dist0_bv, lay["w_diag"],
+                    offsets=lay["offsets"], max_iter=max_iter,
+                )
+                iters = int(iters)
+                return KernelResult(
+                    dist=dist,
+                    converged=not bool(improving),
+                    iterations=iters,
+                    edges_relaxed=(
+                        iters * lay["num_entries"]
+                        * int(sources.shape[0])
+                    ),
+                    route="dia",
+                )
+            except Exception:
+                self._auto_route_failed(
+                    "_dia_disabled",
+                    "dia stencil fan-out failed on this platform; "
+                    "falling back to the gather routes for this "
+                    "backend instance",
+                    forced=self.config.dia is True,
+                )
         if "edges" not in mesh.axis_names and self._use_gs(dgraph):
             # Both GS fan-out routes, tried ahead of the sweep chain:
             # single-device blocked GS, or GS composed with source

@@ -89,20 +89,28 @@ def build_dia_layout(
 
 
 def dia_sweep(d, w_diag, *, offsets: tuple):
-    """One chained relaxation sweep over the stored diagonals."""
+    """One chained relaxation sweep over the stored diagonals.
+
+    ``d`` is [V] (SSSP) or [B, V] (fan-out) — the roll is along the
+    trailing (vertex) axis and ``w_diag[ki]`` ([V]) broadcasts over the
+    batch. Batched, the per-candidate cost is pure bandwidth
+    (contiguous [B, V] tiles, no per-row gather), which is why this
+    also wins the lattice fan-out on TPU where even the [B]-amortized
+    gather routes stay row-bound."""
     nd = d
     for ki, off in enumerate(offsets):
-        # Edge (t - off) -> t relaxes nd[t] against nd[t - off] + w:
-        # roll by +off aligns source values under their destinations.
-        nd = jnp.minimum(nd, jnp.roll(nd, off) + w_diag[ki])
+        # Edge (t - off) -> t relaxes nd[..., t] against
+        # nd[..., t - off] + w: roll by +off aligns source values under
+        # their destinations.
+        nd = jnp.minimum(nd, jnp.roll(nd, off, axis=-1) + w_diag[ki])
     return nd
 
 
 @functools.partial(jax.jit, static_argnames=("offsets", "max_iter"))
 def dia_fixpoint(dist0, w_diag, *, offsets: tuple, max_iter: int):
-    """Fixpoint of :func:`dia_sweep`; same contract as
-    ``relax.bellman_ford_sweeps``: (dist, iterations, still_improving).
-    """
+    """Fixpoint of :func:`dia_sweep` for [V] or [B, V] distances; same
+    contract as ``relax.bellman_ford_sweeps`` / the vm fan-out
+    fixpoints: (dist, iterations, still_improving)."""
 
     def cond(state):
         _, i, improving = state

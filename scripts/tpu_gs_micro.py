@@ -90,13 +90,23 @@ def main():
             mesh_shape=(1,))),
         ("vm sweeps", SolverConfig(
             gauss_seidel=False, frontier=False, mesh_shape=(1,))),
+        # Round-5, last + fail-soft (never on-chip yet): the DIA stencil
+        # fan-out — contiguous [B, V] roll tiles, no per-row gather; CPU
+        # parity with gs-fanout at B=32 (61.6 s vs 60.3 s), bandwidth
+        # model projects ~0.5-1 s on-chip vs gather-bound alternatives.
+        ("dia-fanout", SolverConfig(dia=True, gauss_seidel=False,
+                                    frontier=False, mesh_shape=(1,))),
     ]:
-        backend = get_backend("jax", cfg)
-        dg = backend.upload(g2)
-        r = backend.multi_source(dg, sources)  # warm
-        t0 = time.perf_counter()
-        r = backend.multi_source(dg, sources)
-        dt = time.perf_counter() - t0
+        try:
+            backend = get_backend("jax", cfg)
+            dg = backend.upload(g2)
+            r = backend.multi_source(dg, sources)  # warm
+            t0 = time.perf_counter()
+            r = backend.multi_source(dg, sources)
+            dt = time.perf_counter() - t0
+        except Exception as exc:
+            print(f"{tag}: FAILED ({type(exc).__name__}: {exc})", flush=True)
+            continue
         d = np.asarray(r.dist)
         if ref is None:
             ref = d

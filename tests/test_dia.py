@@ -171,3 +171,38 @@ print("ok")
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert out.stdout.strip().endswith("ok")
+
+
+def test_dia_fanout_matches_oracle():
+    g = grid2d(14, 14, negative_fraction=0.0, seed=6)
+    be = get_backend("jax", SolverConfig(dia=True, mesh_shape=(1,)))
+    dg = be.upload(g)
+    sources = np.array([0, 5, 77, 140, 195], np.int64)
+    res = be.multi_source(dg, sources)
+    assert res.route == "dia"
+    want = np.stack([oracle_sssp(g, int(s)) for s in sources])
+    np.testing.assert_allclose(np.asarray(res.dist), want, atol=1e-4)
+    assert res.edges_relaxed == (
+        res.iterations * g.num_real_edges * len(sources)
+    )
+
+
+def test_dia_fanout_full_johnson_negative_weights():
+    """Both Johnson phases on the DIA route: phase-1 potentials AND the
+    reweighted phase-2 fan-out (validated against the scipy oracle)."""
+    g = grid2d(12, 12, negative_fraction=0.3, seed=13)
+    solver = ParallelJohnsonSolver(
+        SolverConfig(dia=True, mesh_shape=(1,), validate=True)
+    )
+    res = solver.solve(g, sources=np.arange(6))
+    assert res.stats.routes_by_phase["bellman_ford"] == "dia"
+    assert res.stats.routes_by_phase["fanout"] == "dia"
+
+
+def test_dia_fanout_multi_device_mesh_falls_through():
+    # The DIA fan-out is single-device; on the 8-device CPU mesh it must
+    # leave dispatch to the sharded routes even when dia=True.
+    g = grid2d(10, 10, seed=2)
+    be = get_backend("jax", SolverConfig(dia=True))
+    res = be.multi_source(be.upload(g), np.arange(4, dtype=np.int64))
+    assert res.route != "dia"
