@@ -1091,37 +1091,63 @@ class JaxBackend(Backend):
                 "1-D mesh_shape=(n,) (or leave gauss_seidel='auto' to "
                 "use the 2-D sharded sweep path on this mesh)"
             )
-        if mesh.devices.size == 1 and self._use_dia(dgraph):
+        if "edges" in mesh.axis_names and self.config.dia is True:
+            # Same contract as gauss_seidel=True: the stencil needs
+            # every diagonal per device, so an edges axis cannot carry
+            # it — "True forces" must fail loud, not silently route a
+            # gather kernel.
+            raise NotImplementedError(
+                "dia=True fan-out shards sources only; use a 1-D "
+                "mesh_shape=(n,) (or leave dia='auto' to use the 2-D "
+                "sharded sweep path on this mesh)"
+            )
+        if "edges" not in mesh.axis_names and self._use_dia(dgraph):
             # DIA stencil fan-out, tried ahead of every gather route:
             # on a lattice labeling each sweep is K contiguous [B, V]
             # roll+add+min passes — pure bandwidth, no per-row gather —
             # so it wins wherever the B=1 dia route does, at any batch
-            # width. Single-device only (rows are independent; a
-            # sharded composition can come later), degrade-don't-crash
-            # like every auto route.
+            # width. Rows are independent, so a >1-device sources mesh
+            # composes with the replicated [K, V] diagonal weights and
+            # zero per-round collectives (parallel.sharded_dia_fanout);
+            # an "edges" axis does not (the stencil needs every
+            # diagonal per device). Degrade-don't-crash like every
+            # auto route.
             try:
                 lay = self.dia_bundle(dgraph)
-                from paralleljohnson_tpu.ops.dia import dia_fixpoint
+                if mesh.devices.size > 1:
+                    from paralleljohnson_tpu.parallel import (
+                        sharded_dia_fanout,
+                    )
 
-                dist0_bv = jnp.full((sources.shape[0], v), jnp.inf,
-                                    self._dtype)
-                dist0_bv = dist0_bv.at[
-                    jnp.arange(sources.shape[0]), sources
-                ].set(0.0)
-                dist, iters, improving = dia_fixpoint(
-                    dist0_bv, lay["w_diag"],
-                    offsets=lay["offsets"], max_iter=max_iter,
-                )
-                iters = int(iters)
+                    dist, iters, improving, examined = sharded_dia_fanout(
+                        mesh, sources, lay["w_diag"], num_nodes=v,
+                        offsets=lay["offsets"], max_iter=max_iter,
+                        num_entries=lay["num_entries"],
+                    )
+                    dia_route = "dia-sharded"
+                else:
+                    from paralleljohnson_tpu.ops.dia import dia_fixpoint
+
+                    dist0_bv = jnp.full((sources.shape[0], v), jnp.inf,
+                                        self._dtype)
+                    dist0_bv = dist0_bv.at[
+                        jnp.arange(sources.shape[0]), sources
+                    ].set(0.0)
+                    dist, iters, improving = dia_fixpoint(
+                        dist0_bv, lay["w_diag"],
+                        offsets=lay["offsets"], max_iter=max_iter,
+                    )
+                    examined = (
+                        int(iters) * lay["num_entries"]
+                        * int(sources.shape[0])
+                    )
+                    dia_route = "dia"
                 return KernelResult(
                     dist=dist,
                     converged=not bool(improving),
-                    iterations=iters,
-                    edges_relaxed=(
-                        iters * lay["num_entries"]
-                        * int(sources.shape[0])
-                    ),
-                    route="dia",
+                    iterations=int(iters),
+                    edges_relaxed=examined,
+                    route=dia_route,
                 )
             except Exception:
                 self._auto_route_failed(

@@ -8,6 +8,7 @@ from paralleljohnson_tpu.parallel.mesh import (
     make_mesh_2d,
     sharded_fanout,
     sharded_fanout_2d,
+    sharded_dia_fanout,
     sharded_gs_fanout,
 )
 
@@ -19,5 +20,6 @@ __all__ = [
     "multihost",
     "sharded_fanout",
     "sharded_fanout_2d",
+    "sharded_dia_fanout",
     "sharded_gs_fanout",
 ]

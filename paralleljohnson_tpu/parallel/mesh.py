@@ -326,6 +326,72 @@ def sharded_gs_fanout(
     return dist[:b], rounds, improving.astype(bool), examined
 
 
+@functools.lru_cache(maxsize=32)
+def _sharded_dia_fanout_fn(mesh: Mesh, num_nodes: int, offsets: tuple,
+                           max_iter: int):
+    """DIA stencil fan-out sharded over the "sources" axis: the chained
+    roll sweeps (ops.dia) run PER DEVICE on that device's [b/n, V] row
+    slice with the [K, V] diagonal weights replicated — rows are
+    independent, so like the GS composition there are NO per-round
+    collectives, only the output assembly."""
+
+    def shard_body(srcs, w_diag):
+        from paralleljohnson_tpu.ops.dia import dia_fixpoint
+
+        b_loc = srcs.shape[0]
+        dist0 = jnp.full((b_loc, num_nodes), jnp.inf, w_diag.dtype)
+        dist0 = dist0.at[jnp.arange(b_loc), srcs].set(0.0)
+        dist, iters, improving = dia_fixpoint(
+            dist0, w_diag, offsets=offsets, max_iter=max_iter
+        )
+        iters_vec = iters[None]                     # [1] per shard
+        iters = jax.lax.pmax(iters, "sources")
+        improving = jax.lax.pmax(improving.astype(jnp.int32), "sources")
+        return dist, iters, improving, iters_vec
+
+    mapped = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P("sources"), P(None)),
+        out_specs=(P("sources"), P(), P(), P("sources")),
+        check_vma=False,  # pmax results are replicated
+    )
+    return jax.jit(mapped)
+
+
+def sharded_dia_fanout(
+    mesh: Mesh,
+    sources,
+    w_diag,
+    *,
+    num_nodes: int,
+    offsets: tuple,
+    max_iter: int,
+    num_entries: int,
+):
+    """N-source DIA fan-out with sources sharded over ``mesh`` (1-D
+    "sources" axis). Pads the batch to a mesh multiple (duplicating
+    ``sources[0]``; rows dropped from output AND work accounting).
+
+    Returns (dist[B, V], iterations, still_improving, examined) —
+    ``examined`` the exact Python-int candidate count: per shard,
+    sweeps x stored diagonal entries x that shard's REAL row count."""
+    n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    sources = jnp.asarray(sources, jnp.int32)
+    b = sources.shape[0]
+    sources, pad = _pad_sources(sources, n)
+    fn = _sharded_dia_fanout_fn(
+        mesh, int(num_nodes), tuple(offsets), int(max_iter)
+    )
+    dist, iters, improving, iters_vec = fn(sources, w_diag)
+    per = (b + pad) // n
+    iters_arr = np.asarray(_fetch_shard_vec(iters_vec), np.int64).ravel()
+    examined = int(num_entries) * _row_sweeps_exact(
+        iters_arr, stride=1, n_groups=n, per_group=per, b_real=b
+    )
+    return dist[:b], iters, improving.astype(bool), examined
+
+
 def make_mesh_2d(mesh_shape: tuple[int, int]) -> Mesh:
     """2-D ``("sources", "edges")`` mesh: sources axis for fan-out
     throughput, edges axis for edge lists beyond one chip's HBM — the two

@@ -199,10 +199,23 @@ def test_dia_fanout_full_johnson_negative_weights():
     assert res.stats.routes_by_phase["fanout"] == "dia"
 
 
-def test_dia_fanout_multi_device_mesh_falls_through():
-    # The DIA fan-out is single-device; on the 8-device CPU mesh it must
-    # leave dispatch to the sharded routes even when dia=True.
+def test_dia_fanout_sharded_on_multi_device_mesh():
+    """On the 8-device CPU mesh, the dia fan-out composes with source
+    sharding (replicated diagonals, batch split, zero per-round
+    collectives) and matches the oracle — incl. a ragged batch that
+    pads to a mesh multiple."""
     g = grid2d(10, 10, seed=2)
     be = get_backend("jax", SolverConfig(dia=True))
-    res = be.multi_source(be.upload(g), np.arange(4, dtype=np.int64))
-    assert res.route != "dia"
+    sources = np.array([0, 9, 42, 77, 99, 13, 57], np.int64)  # 7 of 8
+    res = be.multi_source(be.upload(g), sources)
+    assert res.route == "dia-sharded"
+    want = np.stack([oracle_sssp(g, int(s)) for s in sources])
+    np.testing.assert_allclose(np.asarray(res.dist), want, atol=1e-4)
+    assert res.converged
+
+
+def test_dia_forced_on_edges_mesh_raises():
+    g = grid2d(8, 8, seed=1)
+    be = get_backend("jax", SolverConfig(dia=True, mesh_shape=(4, 2)))
+    with pytest.raises(NotImplementedError, match="dia=True"):
+        be.multi_source(be.upload(g), np.arange(4, dtype=np.int64))
