@@ -65,6 +65,20 @@ def build_dia_layout(
     e = int(indptr[-1])
     if e == 0:
         return None
+    # Each diagonal holds at most V entries, so K diagonals cannot carry
+    # more than K x V edges — and a cheap evenly-spaced sample that
+    # already shows > max_offsets distinct offsets PROVES the full edge
+    # list does too (sampling can only undercount distinct values).
+    # Both early-outs skip the O(E log E) pass for the big power-law
+    # graphs that auto-dispatch probes on TPU.
+    if e > max_offsets * v:
+        return None
+    if e > 8192:
+        pick = np.linspace(0, e - 1, 4096).astype(np.int64)
+        row = np.searchsorted(indptr, pick, side="right") - 1
+        s_offs = indices[pick].astype(np.int64) - row
+        if len(np.unique(s_offs)) > max_offsets:
+            return None
     src = np.repeat(np.arange(v, dtype=np.int64), np.diff(indptr))
     dst = indices[:e].astype(np.int64)
     offs = dst - src

@@ -219,3 +219,16 @@ def test_dia_forced_on_edges_mesh_raises():
     be = get_backend("jax", SolverConfig(dia=True, mesh_shape=(4, 2)))
     with pytest.raises(NotImplementedError, match="dia=True"):
         be.multi_source(be.upload(g), np.arange(4, dtype=np.int64))
+
+
+def test_layout_sampling_early_out_large_graphs():
+    """Large power-law graphs must disqualify via the cheap sampled
+    pre-pass (sound: a sample can only undercount distinct offsets),
+    and large lattices must still pass through it to a full layout."""
+    g = rmat(13, 8, seed=5)  # E = 64k > sample threshold
+    assert g.num_real_edges > 8192
+    assert build_dia_layout(g.indptr, g.indices, g.num_nodes) is None
+    gl = grid2d(60, 60, seed=5)  # E = 14k > sample threshold
+    assert gl.num_real_edges > 8192
+    lay = build_dia_layout(gl.indptr, gl.indices, gl.num_nodes)
+    assert lay is not None and lay["offsets"] == (-60, -1, 1, 60)
