@@ -18,6 +18,10 @@ run() {  # run <seconds> <label> <cmd...>
   rc=${PIPESTATUS[0]}
   echo "--- rc=$rc ---" | tee -a "$LOG"
   [ "$rc" -ne 0 ] && FAILED_STAGES="$FAILED_STAGES $label"
+  # Evidence survives a session cut mid-pass: stage log + BASELINE.md
+  # rows land in the repo after EVERY stage, not only at the end.
+  mkdir -p bench_artifacts
+  cp "$LOG" "bench_artifacts/tpu_round5_pass.log" 2>/dev/null || true
   return "$rc"
 }
 
