@@ -52,6 +52,11 @@ run 2400 jax-rmat20-full python -m paralleljohnson_tpu.cli bench rmat_apsp --bac
   run 3000 jax-rmat22 python -m paralleljohnson_tpu.cli bench rmat_apsp --backend jax --preset full --update-baseline BASELINE.md
 ) || FAILED_STAGES="$FAILED_STAGES jax-rmat22"
 
+# 4a) route tags of every jax row just written (round-4 verdict weak #1:
+#     a row whose tag shows a degraded route is a FAILED measurement of
+#     the intended kernel — check tags, not just wall-clocks)
+run 60 route-tags grep -E '\| jax \|' BASELINE.md
+
 # 4b) pallas VMEM-resident sweep vs XLA (Mosaic compile + perf decision)
 run 1500 pallas-sweep python scripts/tpu_pallas_sweep_micro.py
 
