@@ -187,7 +187,14 @@ def main(argv: list[str] | None = None) -> int:
     p_bench.add_argument("--update-baseline", default=None, metavar="MD",
                          help="rewrite the measured table in this BASELINE.md")
 
-    p_info = sub.add_parser("info", help="environment / plugin summary")
+    p_info = sub.add_parser(
+        "info",
+        help="environment / plugin summary; with a graph spec, also the "
+             "per-graph kernel-route diagnosis (which route each phase "
+             "would take and why)",
+    )
+    p_info.add_argument("graph", nargs="?", default=None,
+                        help="optional loader spec / path to diagnose")
     p_info.add_argument("--json", action="store_true", dest="as_json")
 
     args = parser.parse_args(argv)
@@ -225,6 +232,36 @@ def main(argv: list[str] | None = None) -> int:
             "devices": [str(d) for d in jax.devices()],
             "default_backend_platform": jax.default_backend(),
         }
+        if args.graph is not None:
+            # Per-graph route diagnosis: the SAME predicates dispatch
+            # consults, so "why did my solve pick route X" is answerable
+            # without running a solve (and, on-chip, without burning
+            # tunnel time on a mis-routed measurement).
+            from paralleljohnson_tpu.backends import get_backend
+            from paralleljohnson_tpu.config import SolverConfig
+
+            g = load_graph(args.graph)
+            be = get_backend("jax", SolverConfig())
+            dg = be.upload(g)
+            dia_lay = be.dia_bundle(dg)
+            info["graph"] = {
+                "nodes": g.num_nodes,
+                "edges": g.num_real_edges,
+                "max_degree": dg.max_degree,
+                "negative_weights": bool(g.has_negative_weights),
+                "routes": {
+                    "dense": bool(be._use_dense(dg)),
+                    "dia": bool(be._use_dia(dg)),
+                    "gauss_seidel": bool(be._use_gs(dg)),
+                    "frontier": bool(be._use_frontier(dg)),
+                    "edge_shard": bool(be._use_edge_shard(dg)),
+                },
+                "dia_qualifies": dia_lay is not None,
+                "dia_offsets": (
+                    list(dia_lay["offsets"]) if dia_lay is not None else None
+                ),
+                "low_degree_family": bool(be._low_degree_family(dg)),
+            }
         print(json.dumps(info, indent=None if args.as_json else 2))
         return 0
 

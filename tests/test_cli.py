@@ -15,6 +15,17 @@ def test_info(capsys):
     assert "dimacs" in out["loaders"]
 
 
+def test_info_graph_route_diagnosis(capsys):
+    assert main(["info", "grid:rows=9,cols=9,seed=1", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    gi = payload["graph"]
+    assert gi["nodes"] == 81 and gi["dia_qualifies"]
+    assert gi["dia_offsets"] == [-9, -1, 1, 9]
+    assert set(gi["routes"]) == {
+        "dense", "dia", "gauss_seidel", "frontier", "edge_shard"
+    }
+
+
 def test_solve_json(capsys):
     assert main(["solve", "er:n=40,p=0.1,seed=1", "--backend", "numpy",
                  "--json"]) == 0
