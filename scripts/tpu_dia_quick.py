@@ -1,0 +1,46 @@
+"""Five-minute on-chip quick win: the DIA stencil route vs the committed
+17.4 s dimacs_ny_bf row (round-5; the largest projected single-kernel
+gain — bench_artifacts/gs_offchip_validation.md projects 0.05-0.3 s).
+
+Runs the exact full-preset workload through the cli bench path so the
+row lands in BASELINE.md with its route tag. Kept minimal so a late
+tunnel recovery can still capture it: one graph, one warm, one measure.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+import numpy as np
+
+from paralleljohnson_tpu.backends import get_backend
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import grid2d
+
+
+def main():
+    g = grid2d(515, 515, negative_fraction=0.2, seed=7)
+    print(f"grid 515x515: V={g.num_nodes} E={g.num_real_edges}", flush=True)
+    be = get_backend("jax", SolverConfig())  # auto: dia expected on TPU
+    dg = be.upload(g)
+    r = be.bellman_ford(dg, source=0)  # compile + warm
+    # Scalar download is the only reliable device sync through the
+    # tunnel (memory: axon gotchas).
+    float(np.asarray(r.dist[0]))
+    t0 = time.perf_counter()
+    r = be.bellman_ford(dg, source=0)
+    float(np.asarray(r.dist[0]))
+    dt = time.perf_counter() - t0
+    print(
+        f"dimacs-full SSSP auto: {dt:.3f}s route={r.route} "
+        f"sweeps={r.iterations} examined={r.edges_relaxed:,} "
+        f"(committed row: 17.4 s frontier; cpp 0.40 s)",
+        flush=True,
+    )
+    if r.route != "dia":
+        print("WARNING: auto did not route dia — check _dia_disabled / "
+              "platform", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,11 @@ run 120 probe python -c "import jax,numpy as np; print('probe', int(jax.jit(lamb
 # recorded number.
 run 1200 bench.py-early python bench.py
 
+# 0c) round-5 quick win: DIA vs the committed 17.4 s dimacs row —
+#     minutes, and the largest projected single-kernel gain; early so a
+#     late recovery still captures it.
+run 420 dia-quick python scripts/tpu_dia_quick.py
+
 # 1) blocked-fanout vs plain at rmat20 (the VERDICT #3 decision number)
 run 1800 blocked-vs-plain python scripts/tpu_blocked_micro.py
 
