@@ -88,14 +88,17 @@ def main():
         ("gs-fanout vb=16384", SolverConfig(
             gauss_seidel=True, frontier=False, gs_block_size=16384,
             mesh_shape=(1,))),
-        ("vm sweeps", SolverConfig(
-            gauss_seidel=False, frontier=False, mesh_shape=(1,))),
-        # Round-5, last + fail-soft (never on-chip yet): the DIA stencil
+        # Round-5, fail-soft (never on-chip yet): the DIA stencil
         # fan-out — contiguous [B, V] roll tiles, no per-row gather; CPU
         # parity with gs-fanout at B=32 (61.6 s vs 60.3 s), bandwidth
         # model projects ~0.5-1 s on-chip vs gather-bound alternatives.
+        # BEFORE the vm sweeps row: that one can run into the stage
+        # timeout (1125 diameter-bound sweeps at B=64), and a timeout
+        # kills the process, not just the row.
         ("dia-fanout", SolverConfig(dia=True, gauss_seidel=False,
                                     frontier=False, mesh_shape=(1,))),
+        ("vm sweeps", SolverConfig(
+            gauss_seidel=False, frontier=False, mesh_shape=(1,))),
     ]:
         try:
             backend = get_backend("jax", cfg)

@@ -45,7 +45,7 @@ run 420 dia-quick python scripts/tpu_dia_quick.py
 run 1800 blocked-vs-plain python scripts/tpu_blocked_micro.py
 
 # 2) GS vs frontier on the dimacs stand-in, on-chip (VERDICT #4 number)
-run 1200 gs-dimacs python scripts/tpu_gs_micro.py
+run 1800 gs-dimacs python scripts/tpu_gs_micro.py
 
 # 3) re-run the affected full-preset rows with the new kernels
 run 1800 jax-dimacs-full python -m paralleljohnson_tpu.cli bench dimacs_ny_bf --backend jax --preset full --update-baseline BASELINE.md
