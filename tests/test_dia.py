@@ -232,3 +232,11 @@ def test_layout_sampling_early_out_large_graphs():
     assert gl.num_real_edges > 8192
     lay = build_dia_layout(gl.indptr, gl.indices, gl.num_nodes)
     assert lay is not None and lay["offsets"] == (-60, -1, 1, 60)
+
+
+@pytest.mark.parametrize("rows,cols", [(1, 40), (40, 1), (2, 2), (3, 17)])
+def test_dia_degenerate_lattices(rows, cols):
+    g = grid2d(rows, cols, negative_fraction=0.2, seed=8)
+    res = _bf(g, 0, dia=True)
+    assert res.route == "dia"
+    np.testing.assert_allclose(res.dist, oracle_sssp(g, 0), atol=1e-4)
