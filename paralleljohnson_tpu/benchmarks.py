@@ -1,5 +1,7 @@
 """Benchmark harness for the five attested configs (SURVEY.md §2 #14,
-BASELINE.json:6-12).
+BASELINE.json:6-12) plus the ``dimacs_ny_scrambled`` companion row (the
+road-graph config under a scrambled vertex labeling — the honest proxy
+for the real DIMACS file, whose labeling is not a lattice order).
 
 Each config is a callable returning a result record; the harness times the
 solve, folds in the attested edges-relaxed counters (BASELINE.json:2
@@ -56,6 +58,7 @@ _SIZES = {
     #                 smoke            mini              full (attested)
     "er1k_apsp":     dict(n=64,        mini_n=256,       full_n=1000),
     "dimacs_ny_bf":  dict(rows=24,     mini_rows=96,     full_rows=515),
+    "dimacs_ny_scrambled": dict(rows=24, mini_rows=96,   full_rows=515),
     "ego_fb_nsource": dict(scale=8,    mini_scale=10,    full_scale=12,
                           sources=16,  mini_sources=64,  full_sources=512),
     "rmat_apsp":     dict(scale=8,     mini_scale=12,    full_scale=20,
@@ -139,6 +142,35 @@ def bench_dimacs_ny_bf(backend: str, preset: str) -> BenchRecord:
     wall = time.perf_counter() - t0
     return BenchRecord(
         "dimacs_ny_bf", backend, preset, wall,
+        res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
+        {"nodes": g.num_nodes, "edges": g.num_real_edges,
+         "sweeps": res.stats.iterations_by_phase.get("bellman_ford", 0),
+         "reached_frac": _finite_frac(res.dist), **_routes(res)},
+    )
+
+
+def bench_dimacs_ny_scrambled(backend: str, preset: str) -> BenchRecord:
+    """Config 2b (round-5 verdict next #3): the SAME road-graph SSSP as
+    ``dimacs_ny_bf`` but with the vertex labels uniformly permuted —
+    the honest proxy for the real DIMACS file, whose labeling is not a
+    lattice order. The natural row-major grid labeling qualifies the
+    DIA stencil route; a real file's does not, so THIS row is what the
+    attested config would actually measure: auto must decline DIA here
+    and serve the solve through the irregular-labeling routes (bucket
+    on TPU, frontier on CPU)."""
+    from paralleljohnson_tpu.graphs import grid2d, permute_labels
+
+    rows = _sz("dimacs_ny_scrambled", "rows", preset)
+    g = permute_labels(
+        grid2d(rows, rows, negative_fraction=0.2, seed=7), seed=11
+    )
+    solver = _solver(backend)
+    solver.sssp(g, 0)  # warm
+    t0 = time.perf_counter()
+    res = solver.sssp(g, 0)
+    wall = time.perf_counter() - t0
+    return BenchRecord(
+        "dimacs_ny_scrambled", backend, preset, wall,
         res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
         {"nodes": g.num_nodes, "edges": g.num_real_edges,
          "sweeps": res.stats.iterations_by_phase.get("bellman_ford", 0),
@@ -261,6 +293,7 @@ def bench_batch_small(backend: str, preset: str) -> BenchRecord:
 CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "er1k_apsp": bench_er1k_apsp,
     "dimacs_ny_bf": bench_dimacs_ny_bf,
+    "dimacs_ny_scrambled": bench_dimacs_ny_scrambled,
     "ego_fb_nsource": bench_ego_fb_nsource,
     "rmat_apsp": bench_rmat_apsp,
     "batch_small": bench_batch_small,

@@ -65,6 +65,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "meshes; auto: on TPU when the labeling qualifies)")
     p.add_argument("--dia-max-offsets", type=int, default=16,
                    help="max distinct edge diagonals the DIA route accepts")
+    p.add_argument("--bucket", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="bucketed delta-stepping route for B=1 solves on "
+                        "irregular high-diameter graphs (auto: on TPU for "
+                        "the low-degree family when DIA disqualifies)")
+    p.add_argument("--delta", type=float, default=None,
+                   help="bucket width of the bucket route (default: "
+                        "auto-tune from mean edge weight x degree)")
     p.add_argument("--gs-block-size", type=int, default=8192,
                    help="vertices per Gauss-Seidel block")
     p.add_argument("--gs-inner-cap", type=int, default=64,
@@ -105,6 +113,8 @@ def _config(args) -> "SolverConfig":
         gauss_seidel=tristate[args.gauss_seidel],
         dia=tristate[args.dia],
         dia_max_offsets=args.dia_max_offsets,
+        bucket=tristate[args.bucket],
+        delta=args.delta,
         gs_block_size=args.gs_block_size,
         gs_inner_cap=args.gs_inner_cap,
         checkpoint_dir=args.checkpoint_dir,
@@ -180,7 +190,7 @@ def main(argv: list[str] | None = None) -> int:
 
     p_bench = sub.add_parser("bench", help="attested benchmark configs")
     p_bench.add_argument("configs", nargs="*",
-                         help="subset of configs (default: all five)")
+                         help="subset of configs (default: all)")
     p_bench.add_argument("--backend", default="jax")
     p_bench.add_argument("--preset", default="mini",
                          choices=["smoke", "mini", "full"])
@@ -252,6 +262,7 @@ def main(argv: list[str] | None = None) -> int:
                 "routes": {
                     "dense": bool(be._use_dense(dg)),
                     "dia": bool(be._use_dia(dg)),
+                    "bucket": bool(be._use_bucket(dg)),
                     "gauss_seidel": bool(be._use_gs(dg)),
                     "frontier": bool(be._use_frontier(dg)),
                     "edge_shard": bool(be._use_edge_shard(dg)),

@@ -84,6 +84,24 @@ class SolverConfig:
         the XLA row-gather floor that lower-bounds every gather-based
         sweep (bench_artifacts/gs_offchip_validation.md). An explicit
         ``frontier=True`` or ``gauss_seidel=True`` beats dia="auto".
+      bucket: bucketed (delta-stepping-style) relaxation for B=1 solves
+        on irregular high-diameter graphs — the road-family route when
+        the labeling is NOT diagonal (``ops.bucket``): tentative
+        distances are binned into width-``delta`` buckets, the lowest
+        nonempty bucket is settled with light-edge inner steps before
+        its heavy edges relax once, so each vertex settles ~once and
+        the examined-candidate count collapses vs the GS re-relaxation
+        (bench_artifacts/bucket_offchip_validation.md prices the full
+        dimacs-scale solve under 1 s vs GS's 4.5-8 s). ``"auto"``
+        prefers it on TPU for explicit-source solves on the low-degree
+        family whenever DIA disqualifies; an explicitly forced
+        frontier/gauss_seidel/dia route beats bucket="auto". True
+        forces (including the virtual-source pass, which degrades to
+        full sweeps via the overflow fallback); False disables.
+      delta: bucket width of the ``bucket`` route; ``None`` auto-tunes
+        from mean |edge weight| x an average-degree heuristic
+        (``ops.bucket.auto_delta``). Any value > 0 is correct — the
+        width only trades inner re-relaxation against bucket count.
       dia_max_offsets: max distinct (dst - src) diagonals the DIA
         layout accepts before disqualifying the graph.
       gs_block_size: vertices per Gauss-Seidel block (the inner-fixpoint
@@ -124,6 +142,8 @@ class SolverConfig:
     frontier_capacity: int | None = None
     dia: bool | str = "auto"
     dia_max_offsets: int = 16
+    bucket: bool | str = "auto"
+    delta: float | None = None
     gauss_seidel: bool | str = "auto"
     gs_block_size: int = 8192
     gs_inner_cap: int = 64
@@ -159,6 +179,28 @@ class SolverConfig:
         if self.dia not in (True, False, "auto"):
             raise ValueError(
                 f"dia must be True/False/'auto', got {self.dia!r}"
+            )
+        if self.bucket not in (True, False, "auto"):
+            raise ValueError(
+                f"bucket must be True/False/'auto', got {self.bucket!r}"
+            )
+        if self.delta is not None and not self.delta > 0:
+            raise ValueError(
+                f"delta must be > 0 (or None = auto), got {self.delta!r}"
+            )
+        # The B=1 relaxation routes are mutually exclusive; forcing two
+        # at once used to resolve silently by dispatch order (ADVICE
+        # round 5) — reject it here so "True forces" can never lie.
+        forced = [
+            name
+            for name in ("frontier", "gauss_seidel", "dia", "bucket")
+            if getattr(self, name) is True
+        ]
+        if len(forced) > 1:
+            raise ValueError(
+                "mutually-exclusive route flags forced together: "
+                + " and ".join(f"{n}=True" for n in forced)
+                + "; force at most one (the others dispatch by 'auto')"
             )
         if self.dia_max_offsets < 1:
             raise ValueError(

@@ -4,6 +4,7 @@ from paralleljohnson_tpu.graphs.csr import CSRGraph, PAD_WEIGHT, stack_graphs
 from paralleljohnson_tpu.graphs.generators import (
     erdos_renyi,
     grid2d,
+    permute_labels,
     random_dag,
     random_graph_batch,
     rmat,
@@ -24,6 +25,7 @@ __all__ = [
     "load_dimacs",
     "load_graph",
     "load_snap",
+    "permute_labels",
     "random_dag",
     "random_graph_batch",
     "register_loader",

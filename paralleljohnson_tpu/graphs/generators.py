@@ -109,6 +109,25 @@ def rmat(
     return CSRGraph.from_edges(src, dst, w, num_nodes, dedupe=dedupe, dtype=dtype)
 
 
+def permute_labels(graph: CSRGraph, *, seed: int = 0) -> CSRGraph:
+    """The same graph under a uniformly random vertex relabeling
+    (weights carried per edge, structure otherwise identical).
+
+    Why this exists (round-5 verdict next #3): the benchmark stand-ins'
+    NATURAL labelings carry structure the real datasets do not — a
+    ``grid2d`` in row-major order puts every edge on 4 index diagonals,
+    which is exactly what qualifies the DIA route, while a real DIMACS
+    file's labeling is effectively arbitrary. Scrambling the labels
+    produces the honest proxy: same distances (up to the relabeling),
+    same degree profile and diameter, no labeling gift."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(graph.num_nodes).astype(np.int64)
+    return CSRGraph.from_edges(
+        perm[graph.src], perm[graph.indices], graph.weights,
+        graph.num_nodes, dtype=graph.weights.dtype,
+    )
+
+
 def random_graph_batch(
     batch: int,
     num_nodes: int,

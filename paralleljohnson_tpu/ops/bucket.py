@@ -1,0 +1,278 @@
+"""Bucketed (delta-stepping-style) Bellman-Ford — the B=1 route for
+irregular high-diameter graphs whose labeling is NOT diagonal.
+
+Why (round-5 gather-floor analysis, bench_artifacts/
+gs_offchip_validation.md): the DIA stencil route wins the road-graph B=1
+solve only when the GIVEN vertex labeling is diagonal (a lattice order).
+A real DIMACS file's labeling is not, so the solve falls to blocked GS,
+whose validated step model prices it at 4.5-8 s — dominated by the
+~340M candidate relaxations GS re-examines (examined x the ~12.5 ns XLA
+row-gather floor alone is 4.3-7 s). The classic cure for exactly this
+(SURVEY.md §7 "Hard parts" #1) is delta-stepping [Meyer & Sanders]:
+process vertices in near-priority order by binning tentative distances
+into buckets of width delta, settling the lowest nonempty bucket before
+touching later ones. Each vertex then settles ~once instead of being
+re-improved along every arriving path, so EXAMINED collapses to a small
+multiple of E (~2-6M here vs GS's 340M) — the gather-floor term drops
+from seconds to tens of milliseconds, and total steps stay ~the hop
+diameter (each light step is one hop of wavefront).
+
+Formulation (fixed shapes, jit/TPU-safe — no priority queue exists on
+TPU):
+
+  - dist [V] plus two boolean masks: ``active`` (improved since last
+    processed) and ``pending`` (processed this bucket, heavy out-edges
+    still owed). Bucket ids are ``floor(dist / delta)`` — an O(V)
+    contiguous elementwise pass, re-derived per step (no bucket data
+    structure to maintain).
+  - LIGHT step: compact the ids of active vertices in the minimum
+    bucket (``jnp.nonzero`` with static ``size=capacity``), gather
+    their out-edge tile via CSR indptr padded to ``max_degree`` (the
+    frontier kernel's tile idiom), relax only LIGHT edges (w <= delta)
+    with an in-place scatter-min on the while_loop carry, deactivate
+    the processed ids into ``pending``, and (re)activate every strictly
+    improved destination — including back into the current or an
+    EARLIER bucket (negative light edges move the wavefront backward;
+    the min-bucket scan simply follows).
+  - HEAVY step: once no active vertex remains at or below the pending
+    bucket, relax the HEAVY out-edges (w > delta) of every pending
+    vertex once, from its settled distance — the classic deferral that
+    stops premature long jumps from re-activating far vertices over and
+    over.
+  - Overflow is TRUNCATION, not catastrophe: a bucket larger than
+    ``capacity`` is processed in capacity-sized bites — unprocessed
+    vertices simply keep their mask bits, and the min-bucket scan
+    returns to them next step. Only processed ids are ever deactivated,
+    so correctness never depends on the buffer size; ``capacity`` can
+    therefore stay SMALL (the per-step tile is what the on-chip step
+    cost scales with). The one degenerate case — more than a quarter of
+    the graph active in one bucket, e.g. the all-zeros virtual-source
+    start — falls back to one full chunked sweep (O(E)), which relaxes
+    every edge and resets both masks exactly (``active`` = improved,
+    ``pending`` = empty).
+
+Correctness: relaxation is monotone, so any schedule converges to the
+same fixpoint. The mask invariant — every improvable edge has its
+source active or pending — holds at every step (processing relaxes
+light now and owes heavy via ``pending``; every improvement
+re-activates its vertex), so empty masks certify the global fixpoint.
+The bucket schedule does NOT subsume Jacobi rounds, so "still busy
+after N steps" is NOT a negative-cycle certificate; callers that
+exhaust ``max_steps`` must continue on the full-sweep kernel FROM the
+returned distances (a valid upper bound under monotone relaxation) —
+still improving after >= V further sweeps then certifies a reachable
+negative cycle exactly as in ``relax.bellman_ford_sweeps``
+(``backends.jax_backend`` does this).
+
+Work accounting: the exact split int32 examined counter of the frontier
+kernel (``relax.examined_exact`` decodes; every per-step addend —
+``capacity x max_degree`` or E — stays below 2^31 - 2^20 by the same
+clamp/raise contract). Light and heavy steps count every VALID tile
+entry examined (the lightness test evaluates each); full sweeps add E.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paralleljohnson_tpu.ops import relax
+from paralleljohnson_tpu.ops.relax import FRONTIER_ADDEND_MAX, INF
+
+# Bucket id of inactive / unreached vertices (int32 max — larger than
+# any clipped real bucket id, so min-reductions skip them).
+NO_BUCKET = np.int32(np.iinfo(np.int32).max)
+# |floor(dist / delta)| is clipped here before the int32 cast: distances
+# can be huge-but-finite (long paths, tiny delta) and an overflowing
+# cast is UB. 2^30 keeps every clipped id strictly below NO_BUCKET.
+_BUCKET_CLIP = 2.0 ** 30
+
+
+def auto_delta(mean_weight: float, num_nodes: int, num_edges: int) -> float:
+    """Bucket width heuristic: mean |edge weight| x twice the average
+    out-degree, the factor clamped to [1, 8]. Measured on the scrambled
+    515^2 road grid (bench_artifacts/bucket_offchip_validation.md):
+    widths near mean x 8 minimize sequential steps (2,114 vs 2,968 at
+    mean x 4) while truncation keeps examined at ~3.2 x E; much larger
+    widths keep trading a few steps for re-relaxation, much smaller
+    ones approach one-bucket-per-hop and inflate steps. A pure perf
+    knob — any delta > 0 is correct (SolverConfig.delta overrides)."""
+    avg_deg = num_edges / max(num_nodes, 1)
+    return float(max(mean_weight, 1e-6) * min(8.0, max(1.0, 2.0 * avg_deg)))
+
+
+def auto_capacity(num_nodes: int, max_degree: int) -> int:
+    """Static frontier-id buffer size for the bucket route. SMALL is
+    the point: overflow is truncation (correctness never depends on the
+    buffer), and the per-step tile ``capacity x max_degree`` is exactly
+    what the on-chip step cost scales with — measured at full dimacs
+    scale, capacity 1024 costs only ~8% more steps than 4096 (2,309 vs
+    2,142) while the tile shrinks 4x (the frontier kernel's measured
+    ~15 ms rounds ran 132k-entry tiles; this is 4k). Floor 1024, grows
+    gently with V, capped at 8192; clamped so ``capacity x max_degree``
+    respects the split examined counter's addend bound (same contract
+    as ``bellman_ford_frontier``)."""
+    cap = int(min(num_nodes, min(8192, max(1024, num_nodes // 256))))
+    if max_degree > 0:
+        cap = max(1, min(cap, (FRONTIER_ADDEND_MAX - 1) // max_degree))
+    return cap
+
+
+def step_model_seconds(
+    steps: int, examined: int, *, c_step: float, c_gather: float = 12.5e-9
+) -> float:
+    """Priced on-chip time of a bucketed solve: t = steps x C_step +
+    examined x C_gather — the same two-term model (per-sequential-step
+    fixed cost + the measured ~12.5 ns XLA row-gather floor per
+    candidate) the round-5 GS validation used, so bucket-vs-GS rows are
+    directly comparable (bench_artifacts/gs_offchip_validation.md)."""
+    return steps * c_step + examined * c_gather
+
+
+def bellman_ford_bucketed(
+    dist0, src, dst, w, indptr, delta, *, max_steps: int, capacity: int,
+    max_degree: int, num_real_edges: int, edge_chunk: int = 1 << 20,
+):
+    """Fixpoint bucketed relaxation (B=1). See the module docstring.
+
+    ``src``/``dst``/``w`` must be in CSR (src-sorted) order with
+    ``indptr`` int32[V+1] describing the real edges (padded tail edges
+    are (0, 0, +inf) no-ops only the full-sweep fallback touches).
+    ``delta`` is a traced scalar (one compile serves every width);
+    ``capacity``/``max_degree``/``num_real_edges``/``max_steps`` are
+    static host ints.
+
+    Returns (dist, steps, still_busy, examined_hi, examined_lo):
+    ``still_busy`` means the step budget ran out with the masks
+    nonempty — the distances are then a valid upper bound the caller
+    must hand to the full-sweep kernel to finish and certify (this is
+    NOT a negative-cycle flag); the counter pair decodes via
+    :func:`relax.examined_exact`.
+    """
+    v = dist0.shape[0]
+    indptr = jnp.asarray(indptr, jnp.int32)
+    indptr_ext = jnp.concatenate([indptr, indptr[-1:]])
+    if num_real_edges >= FRONTIER_ADDEND_MAX:
+        raise ValueError(
+            "bellman_ford_bucketed: E="
+            f"{num_real_edges} >= 2^31 - 2^20 breaks the split int32 "
+            "examined counter's full-sweep addend; use the sweep routes "
+            "or shard the edges (parallel.mesh)"
+        )
+    capacity = int(min(capacity, v))
+    if max_degree > 0:
+        capacity = max(1, min(capacity, (FRONTIER_ADDEND_MAX - 1) // max_degree))
+    n_edges = jnp.int32(num_real_edges)
+    delta = jnp.asarray(delta, w.dtype)
+
+    def bucket_ids(d):
+        b = jnp.clip(jnp.floor(d / delta), -_BUCKET_CLIP, _BUCKET_CLIP)
+        return jnp.where(jnp.isfinite(d), b.astype(jnp.int32), NO_BUCKET)
+
+    def out_tile(d, ids):
+        """Out-edge tile of the compacted ids (fill id = v -> empty row):
+        (t [K, D], wt [K, D], dv [K], valid [K, D])."""
+        starts = indptr_ext[ids]
+        ends = indptr_ext[ids + 1]
+        eidx = starts[:, None] + jnp.arange(max_degree, dtype=jnp.int32)[None, :]
+        valid = eidx < ends[:, None]
+        eidx = jnp.minimum(eidx, dst.shape[0] - 1)  # clip; masked below
+        t = jnp.where(valid, dst[eidx], v)          # sentinel v: dropped
+        wt = jnp.where(valid, w[eidx], INF)
+        dv = jnp.where(ids < v, d[jnp.minimum(ids, v - 1)], INF)
+        return t, wt, dv, valid
+
+    def relax_tile(d, active, t, cand, valid):
+        """Scatter-min ``cand`` and (re)activate every strictly improved
+        destination. In-place on the while_loop carry — O(K x D)
+        writes, no [V] copy."""
+        t = t.ravel()
+        cand = cand.ravel()
+        old = d[t]                         # t == v clips; cand is +inf there
+        nd = d.at[t].min(cand, mode="drop")
+        new = nd[t]
+        winner = (cand < old) & (cand == new)
+        active = active.at[t].max(winner, mode="drop")
+        return nd, active, jnp.sum(valid).astype(jnp.int32)
+
+    def light_branch(d, active, pending, bk, cur):
+        mask = active & (bk == cur)
+        (ids,) = jnp.nonzero(mask, size=capacity, fill_value=v)
+        t, wt, dv, valid = out_tile(d, ids)
+        cand = jnp.where(wt <= delta, dv[:, None] + wt, INF)
+        # Deactivate BEFORE the winner scatter: a processed vertex that
+        # another in-tile edge improves this very step must end active.
+        # A bucket larger than ``capacity`` is simply truncated — the
+        # unprocessed vertices keep their active bit, the min-bucket
+        # scan returns here next step, and the invariant never notices
+        # (only PROCESSED ids are ever deactivated).
+        active = active.at[ids].set(False, mode="drop")
+        nd, active, ex = relax_tile(d, active, t, cand, valid)
+        # Processed vertices owe one heavy pass from their settled value.
+        pending = pending.at[ids].set(True, mode="drop")
+        return nd, active, pending, ex
+
+    def heavy_branch(d, active, pending, bk, cur):
+        (ids,) = jnp.nonzero(pending, size=capacity, fill_value=v)
+        t, wt, dv, valid = out_tile(d, ids)
+        cand = jnp.where(wt > delta, dv[:, None] + wt, INF)
+        # ONLY the processed ids' heavy obligation is discharged (an
+        # overflowing pending set truncates exactly like a light step);
+        # a pending vertex that improved since its light pass is still
+        # in ``active`` and must stay there (its LIGHT out-edges are
+        # owed a relaxation at the improved value — clearing it lost
+        # exactly that obligation and broke the fixpoint certificate).
+        nd, active, ex = relax_tile(d, active, t, cand, valid)
+        pending = pending.at[ids].set(False, mode="drop")
+        return nd, active, pending, ex
+
+    def full_branch(d, active, pending, bk, cur):
+        # Degenerate frontier (a quarter of the graph active in one
+        # bucket — e.g. the all-zeros virtual-source start): one full
+        # chunked sweep relaxes EVERY edge at O(E), cheaper than
+        # chewing through the bucket in capacity-sized bites, and both
+        # masks reset exactly (active = improved; no heavy relaxation
+        # is owed by anyone).
+        nd = relax.relax_sweep(d, src, dst, w, edge_chunk=edge_chunk)
+        return nd, nd < d, jnp.zeros_like(pending), n_edges
+
+    def cond(state):
+        _, active, pending, i, _, _ = state
+        return (jnp.any(active) | jnp.any(pending)) & (i < max_steps)
+
+    def body(state):
+        d, active, pending, i, ex_hi, ex_lo = state
+        bk = bucket_ids(d)
+        min_a = jnp.min(jnp.where(active, bk, NO_BUCKET))
+        min_p = jnp.min(jnp.where(pending, bk, NO_BUCKET))
+        # Settle the lowest active bucket first (light steps); flush the
+        # owed heavy edges once nothing active remains at or below it.
+        do_light = min_a <= min_p
+        count = jnp.where(
+            do_light, jnp.sum(active & (bk == min_a)), jnp.sum(pending)
+        )
+        branch = jnp.where(
+            count > max(capacity, v // 4), 2, jnp.where(do_light, 0, 1)
+        )
+        d, active, pending, ex = lax.switch(
+            branch, (light_branch, heavy_branch, full_branch),
+            d, active, pending, bk, min_a,
+        )
+        # Split accumulator (relax.bellman_ford_frontier contract): lo
+        # stays < 2^20 after every normalize, every addend is < 2^31 -
+        # 2^20 (E and capacity x max_degree both are), so lo + ex never
+        # wraps and hi counts exact 2^20-units.
+        ex_lo = ex_lo + ex
+        ex_hi = ex_hi + (ex_lo >> 20)
+        ex_lo = ex_lo & ((1 << 20) - 1)
+        return d, active, pending, i + 1, ex_hi, ex_lo
+
+    active0 = jnp.isfinite(dist0)
+    pending0 = jnp.zeros(v, bool)
+    dist, active, pending, steps, ex_hi, ex_lo = lax.while_loop(
+        cond, body,
+        (dist0, active0, pending0, jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+    )
+    return dist, steps, jnp.any(active) | jnp.any(pending), ex_hi, ex_lo

@@ -123,7 +123,12 @@ def minplus_pallas(
         ],
         out_specs=pl.BlockSpec((bi, bj), lambda gi, gj, gk: (gi, gj)),
         out_shape=jax.ShapeDtypeStruct((ip, jp), d.dtype),
-        compiler_params=pltpu.CompilerParams(
+        # CompilerParams was TPUCompilerParams before jax 0.6; resolve by
+        # name so the kernel serves both generations (the CI image and
+        # the TPU fleet run different jax versions).
+        compiler_params=getattr(
+            pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+        )(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -48,9 +48,13 @@ from jax import lax
 def pallas_traffic_model(
     indptr: np.ndarray, indices: np.ndarray, num_nodes: int, *,
     vb: int, ec: int,
-) -> tuple[float, int]:
-    """(ratio, nc): modelled HBM traffic of one Pallas sweep over the
-    plain XLA sweep's, per batch column (B cancels).
+) -> tuple[float, int, np.ndarray]:
+    """(ratio, nc, counts): modelled HBM traffic of one Pallas sweep over
+    the plain XLA sweep's, per batch column (B cancels), plus the
+    [nb, nb] per-(db, sb)-bucket edge counts the model binned — pass
+    them to :func:`build_pallas_sweep_layout` so a gate-then-build
+    sequence runs the O(E) host bincount once, not twice (ADVICE
+    round 5).
 
     Pallas moves ~2 x nc x vb block elements per sweep (src-block load +
     output-block writeback per chunk — worst case; src loads on sb change
@@ -68,19 +72,24 @@ def pallas_traffic_model(
     nb = max(1, -(-v // vb))
     srcb = np.repeat(np.arange(v, dtype=np.int64), np.diff(indptr)) // vb
     dstb = indices[:e].astype(np.int64) // vb
-    counts = np.bincount(dstb * nb + srcb, minlength=nb * nb)
+    counts = np.bincount(dstb * nb + srcb, minlength=nb * nb).reshape(nb, nb)
     nc = int(np.sum(-(-counts // ec)))
-    nc += int(np.sum(counts.reshape(nb, nb).sum(axis=1) == 0))  # placeholders
+    nc += int(np.sum(counts.sum(axis=1) == 0))  # placeholders
     block_elems = 2 * nc * vb
     gather_elems = 8 * max(e, 1)
-    return block_elems / gather_elems, nc
+    return block_elems / gather_elems, nc, counts
 
 
 def build_pallas_sweep_layout(
     indptr: np.ndarray, indices: np.ndarray, num_nodes: int, *,
-    vb: int, ec: int,
+    vb: int, ec: int, counts: np.ndarray | None = None,
 ):
     """Host preprocessing (structure only, reusable across reweights).
+
+    ``counts``: the [nb, nb] per-(db, sb)-bucket edge counts, when the
+    caller already ran :func:`pallas_traffic_model` at the same
+    (vb, ec) — skips re-binning the edge list (one O(E) pass saved on
+    every first layout build past the traffic gate, ADVICE round 5).
 
     Returns dict of numpy arrays:
       srcl_ck  int32 [NC, ec]  source id LOCAL to the chunk's src block
@@ -107,8 +116,14 @@ def build_pallas_sweep_layout(
     # Bucket = (db, sb); each bucket padded to a multiple of ec. Every dst
     # block must appear at least once (the kernel initializes the output
     # block on its first chunk), even if it has no incoming edges.
-    bucket = db_s.astype(np.int64) * nb + sb_s
-    counts = np.bincount(bucket, minlength=nb * nb).reshape(nb, nb)
+    if counts is None:
+        bucket = db_s.astype(np.int64) * nb + sb_s
+        counts = np.bincount(bucket, minlength=nb * nb).reshape(nb, nb)
+    elif counts.shape != (nb, nb):
+        raise ValueError(
+            f"counts shape {counts.shape} != bucket grid ({nb}, {nb}) — "
+            "pass counts from pallas_traffic_model at the SAME (vb, ec)"
+        )
     chunks_per_bucket = -(-counts // ec)          # [nb(db), nb(sb)]
     empty_db = chunks_per_bucket.sum(axis=1) == 0
     chunks_per_bucket[empty_db, 0] = 1            # placeholder chunk

@@ -2,9 +2,15 @@
 17.4 s dimacs_ny_bf row (round-5; the largest projected single-kernel
 gain — bench_artifacts/gs_offchip_validation.md projects 0.05-0.3 s).
 
-Runs the exact full-preset workload through the cli bench path so the
-row lands in BASELINE.md with its route tag. Kept minimal so a late
-tunnel recovery can still capture it: one graph, one warm, one measure.
+This is a DIRECT-backend measurement of the grid2d STAND-IN at the
+full-preset shape (515x515, the dimacs_ny_bf sizing) — it does NOT go
+through the cli bench path, touches no real DIMACS file, and writes
+nothing to BASELINE.md (ADVICE round 5: the old docstring claimed all
+three and could misattribute the log later). For a BASELINE.md row with
+a route tag, run ``pjtpu bench dimacs_ny_bf --preset full
+--update-baseline BASELINE.md`` after this smoke confirms the route.
+Kept minimal so a late tunnel recovery can still capture it: one graph,
+one warm, one measure.
 """
 
 import sys
@@ -32,7 +38,8 @@ def main():
     float(np.asarray(r.dist[0]))
     dt = time.perf_counter() - t0
     print(
-        f"dimacs-full SSSP auto: {dt:.3f}s route={r.route} "
+        f"grid2d-515 stand-in SSSP (direct backend, dimacs_ny_bf full "
+        f"shape) auto: {dt:.3f}s route={r.route} "
         f"sweeps={r.iterations} examined={r.edges_relaxed:,} "
         f"(committed row: 17.4 s frontier; cpp 0.40 s)",
         flush=True,

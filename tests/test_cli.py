@@ -22,7 +22,7 @@ def test_info_graph_route_diagnosis(capsys):
     assert gi["nodes"] == 81 and gi["dia_qualifies"]
     assert gi["dia_offsets"] == [-9, -1, 1, 9]
     assert set(gi["routes"]) == {
-        "dense", "dia", "gauss_seidel", "frontier", "edge_shard"
+        "dense", "dia", "bucket", "gauss_seidel", "frontier", "edge_shard"
     }
 
 
@@ -182,3 +182,20 @@ def test_solve_reduce_rejects_output_and_validate(capsys):
     assert rc == 1
     err = capsys.readouterr().err
     assert "--output" in err and "--validate" in err
+
+
+def test_cli_bucket_and_delta_flags(capsys):
+    import json as _json
+
+    from paralleljohnson_tpu.cli import main
+
+    rc = main(["sssp", "grid:rows=11,cols=11,neg=0.2,seed=3", "--source",
+               "0", "--bucket", "true", "--delta", "12.5", "--json",
+               "--log-stats"])
+    assert rc == 0
+    payload = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["routes_by_phase"]["bellman_ford"] == "bucket"
+    # Conflicting forced routes surface as the config ValueError -> rc 1.
+    rc = main(["sssp", "grid:rows=8,cols=8", "--source", "0",
+               "--bucket", "true", "--dia", "true"])
+    assert rc == 1

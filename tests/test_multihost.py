@@ -11,8 +11,22 @@ import subprocess
 import sys
 from pathlib import Path
 
+import jax
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 CHILD = Path(__file__).with_name("multihost_child.py")
+
+# jax < 0.5 cannot execute multi-process computations on the CPU
+# backend at all ("Multiprocess computations aren't implemented on the
+# CPU backend") — an environment limitation, not a repo regression, so
+# degrade to a skip exactly like the hypothesis importorskip. The same
+# code path runs for real on newer-jax images and on actual pods.
+_JAX_MAJOR_MINOR = tuple(int(x) for x in jax.__version__.split(".")[:2])
+pytestmark = pytest.mark.skipif(
+    _JAX_MAJOR_MINOR < (0, 5),
+    reason="multi-process CPU collectives unimplemented in jax < 0.5",
+)
 
 
 def _free_port() -> int:

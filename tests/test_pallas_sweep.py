@@ -185,8 +185,10 @@ def test_traffic_gate_trips_at_large_sparse_v():
     indptr = np.cumsum(indptr)
 
     vb = 8192
-    ratio, nc = pallas_traffic_model(indptr, dst, v, vb=vb, ec=2048)
+    ratio, nc, counts = pallas_traffic_model(indptr, dst, v, vb=vb, ec=2048)
     assert ratio > 1.0, (ratio, nc)
+    nb = -(-v // vb)
+    assert counts.shape == (nb, nb) and int(counts.sum()) == e
 
     from paralleljohnson_tpu.graphs import CSRGraph
 
@@ -209,7 +211,19 @@ def test_traffic_gate_passes_moderate_v():
     from paralleljohnson_tpu.ops.pallas_sweep import pallas_traffic_model
 
     g = rmat(13, 16, seed=2)  # V=8192, E=128k: nb small, buckets dense
-    ratio, _ = pallas_traffic_model(
+    ratio, _, counts = pallas_traffic_model(
         g.indptr, g.indices, g.num_nodes, vb=1024, ec=2048
     )
     assert ratio <= 1.0, ratio
+    # Threading the model's counts into the builder must reproduce the
+    # from-scratch layout exactly (ADVICE r5: one O(E) binning, not two).
+    from paralleljohnson_tpu.ops.pallas_sweep import build_pallas_sweep_layout
+
+    a = build_pallas_sweep_layout(
+        g.indptr, g.indices, g.num_nodes, vb=1024, ec=2048
+    )
+    b = build_pallas_sweep_layout(
+        g.indptr, g.indices, g.num_nodes, vb=1024, ec=2048, counts=counts
+    )
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])

@@ -3,6 +3,12 @@ triangle inequality, reweighted weights >= 0, d(v,v)=0, backend equivalence.
 """
 
 import numpy as np
+import pytest
+
+# Degrade to a module skip where hypothesis is absent (some CI images
+# ship without it); the deterministic routing tests in test_bucket.py /
+# test_dia.py / test_gauss_seidel.py keep the kernel matrix covered.
+hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
@@ -41,7 +47,10 @@ def graphs(draw, max_nodes=24, negative=False):
     return CSRGraph.from_edges(s, d, ws, n)
 
 
-@settings(max_examples=40, deadline=None)
+# max_examples capped on the slowest matrices (round-5 verdict next
+# #8): the strategy space is tiny graphs, so breadth saturates well
+# before the old counts while tier-1 wall-clock stays ~linear in them.
+@settings(max_examples=30, deadline=None)
 @given(graphs())
 def test_apsp_invariants_nonnegative(g):
     res = ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g)
@@ -84,14 +93,15 @@ def test_reweighted_nonnegative():
     assert np.all(rw.weights >= 0)
 
 
-@settings(max_examples=25, deadline=None)
-@given(graphs(negative=True), st.integers(0, 6))
+@settings(max_examples=16, deadline=None)
+@given(graphs(negative=True), st.integers(0, 7))
 def test_layouts_and_frontier_agree(g, knob):
     """Every kernel-routing knob computes the same distances: fan-out
     layouts, forced frontier, forced Gauss-Seidel (SSSP phase), the
     dst-blocked fan-out, forced dense, forced DIA (qualifies or falls
-    through, result must not change) — all against the numpy oracle
-    backend on the same random negative-weight DAG."""
+    through, result must not change), forced bucketed delta-stepping —
+    all against the numpy oracle backend on the same random
+    negative-weight DAG."""
     from paralleljohnson_tpu.backends import jax_backend
 
     cfgs = [
@@ -106,6 +116,7 @@ def test_layouts_and_frontier_agree(g, knob):
         SolverConfig(backend="jax", fanout_layout="vertex_major",
                      mesh_shape=(1,), dense_threshold=0),
         SolverConfig(backend="jax", dia=True),
+        SolverConfig(backend="jax", bucket=True),
     ]
     if knob == 5:
         # Route the dst-blocked fan-out at toy scale.
@@ -123,7 +134,7 @@ def test_layouts_and_frontier_agree(g, knob):
     )
 
 
-@settings(max_examples=20, deadline=None)
+@settings(max_examples=12, deadline=None)
 @given(graphs(negative=True), st.integers(1, 5))
 def test_solve_reduced_checksum_invariant(g, bs):
     """Streaming reduction is batch-size invariant and equals the full
