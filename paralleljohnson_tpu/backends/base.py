@@ -107,12 +107,23 @@ class Backend(abc.ABC):
         Optional."""
         raise NotImplementedError(f"{self.name} does not track predecessors")
 
-    def suggested_source_batch(self, dgraph: Any) -> int | None:
+    def suggested_source_batch(
+        self, dgraph: Any, with_pred: bool = False
+    ) -> int | None:
         """Largest source batch one fan-out kernel call should take when
         ``config.source_batch_size`` is None (the promised fits-memory
         heuristic); ``None`` = no cap, solve all sources in one call.
-        Host-memory backends have no hard cap."""
+        ``with_pred=True`` must also budget the extra int32 [B, V] pred
+        block (and any extraction intermediates) a ``--predecessors``
+        solve carries. Host-memory backends have no hard cap."""
         return None
+
+    def clear_caches(self, dgraph: Any) -> None:
+        """Drop rebuildable device-side caches attached to ``dgraph``
+        (layout structures, re-sorted edge copies) so a large host
+        download has the memory they held. No-op for host backends;
+        device backends override (HBM hygiene before multi-batch row
+        downloads — the RMAT-22 crash mitigation)."""
 
     # -- optional fast paths (defaults compose the kernels host-side) -------
 

@@ -547,6 +547,18 @@ def _fanout_pred_kernel(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("edge_chunk",))
+def _extract_pred_kernel(dist, sources, src, dst, w, *, edge_chunk: int):
+    """Post-fixpoint tight-edge predecessor extraction (ops.pred): one
+    vectorized O(E x B / chunk) pass over the COO edges after ANY route
+    converged, plus the pointer-doubling tree check. Returns
+    (pred[B, V] int32, ok bool) — ok=False routes the solve to the
+    legacy argmin-sweep fallback (zero-weight tight cycle)."""
+    from paralleljohnson_tpu.ops.pred import extract_pred
+
+    return extract_pred(dist, sources, src, dst, w, edge_chunk=edge_chunk)
+
+
 def _minplus_impl(use_pallas: bool, interpret: bool):
     """The min-plus product impl for dense kernels: the Pallas/Mosaic tile
     kernel (SURVEY.md §7 step 6) or None (the XLA blocked fallback)."""
@@ -658,6 +670,20 @@ class JaxBackend(Backend):
         g.__dict__["_src"] = np.asarray(dgraph.src)[:e]
         return g
 
+    def clear_caches(self, dgraph: JaxDeviceGraph) -> None:
+        """Drop every rebuildable layout cache held by ``dgraph`` —
+        the HBM-hygiene step for large row downloads (the s22 worker
+        crash happened under HBM pressure DURING a row download while
+        the fan-out layouts were still resident; VERDICT missing #3).
+        ``_struct_cache`` can hold device-built chunk structures
+        (``build_vm_blocked_layout_device``: ~16E bytes at rmat-22) and
+        ``_by_dst_cache`` the dst-sorted edge triple + per-layout chunk
+        weights; all of it is re-derivable, so the solver frees it
+        before multi-batch downloads and the next kernel call rebuilds
+        on demand."""
+        dgraph._struct_cache.clear()
+        dgraph._by_dst_cache.clear()
+
     def _memory_budget_bytes(self) -> int:
         """Usable accelerator memory for one fan-out call. Prefers the
         device's own bytes_limit (TPU HBM); CPU hosts get a conservative
@@ -671,19 +697,25 @@ class JaxBackend(Backend):
             pass
         return 4 << 30
 
-    def suggested_source_batch(self, dgraph: JaxDeviceGraph) -> int | None:
+    def suggested_source_batch(
+        self, dgraph: JaxDeviceGraph, with_pred: bool = False
+    ) -> int | None:
         """Cap the [B, V] distance block to the device budget
         (SolverConfig.source_batch_size=None contract). The edge-chunk
         intermediate is bounded separately by ``_edge_chunk_for``, so the
         [B, V] blocks dominate: ~6 of them live across the while_loop
-        carry, the update, and XLA temporaries."""
+        carry, the update, and XLA temporaries. ``with_pred`` adds ~3
+        more (the int32 pred block itself plus the extraction pass's
+        (best_du, best_u) scan carries — ops.pred), so a pred solve no
+        longer silently overshoots the budget the plain sizing promised."""
         v = max(dgraph.num_nodes, 1)
         itemsize = jnp.dtype(self._dtype).itemsize
+        blocks = 9 if with_pred else 6
         # Per-DEVICE budget: row blocks shard over the "sources" axis only
         # (on a 2-D mesh they replicate over "edges"), so the global B is
         # n_sources x what one device can hold.
         n = self._sources_axis_size()
-        b = (self._memory_budget_bytes() // (6 * v * itemsize)) * n
+        b = (self._memory_budget_bytes() // (blocks * v * itemsize)) * n
         b = int(max(1, min(b, 1 << 16)))
         if b > n:
             b -= b % n  # keep shards even on the mesh
@@ -1106,6 +1138,33 @@ class JaxBackend(Backend):
             route=route,
         )
 
+    def _use_pred_extraction(self) -> bool:
+        """Post-fixpoint tight-edge extraction (ops.pred) serves pred
+        solves unless explicitly disabled or a prior auto attempt failed
+        on this platform (degrade-don't-crash, like every auto route)."""
+        return self.config.pred_extraction is not False and not getattr(
+            self, "_pred_extract_disabled", False
+        )
+
+    def _pred_fallback(self, why: str):
+        """Route a pred solve to the legacy argmin sweep — unless the
+        user FORCED extraction, in which case fail loud (the "True
+        forces" contract: extraction genuinely cannot represent this
+        solve, silence would lie)."""
+        if self.config.pred_extraction is True:
+            raise RuntimeError(
+                f"pred_extraction=True but {why}; the legacy argmin "
+                "sweep (pred_extraction=False) handles this case"
+            )
+        import warnings
+
+        warnings.warn(
+            f"tight-edge predecessor extraction fell back to the legacy "
+            f"argmin sweep: {why}",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
     def bellman_ford_pred(self, dgraph: JaxDeviceGraph, source: int | None) -> KernelResult:
         if source is None:
             # Same contract as the numpy backend: the virtual-source pass
@@ -1113,6 +1172,52 @@ class JaxBackend(Backend):
             raise NotImplementedError(
                 "virtual-source Bellman-Ford has no predecessor tree"
             )
+        if self._use_pred_extraction():
+            # Fast path (the round-7 tentpole): let the AUTO route family
+            # (dia / bucket / gs / frontier / edge-sharded / sweep) run
+            # the distance fixpoint, then extract the tree in one
+            # tight-edge pass — instead of pinning the solve to the
+            # argmin-tracking sweep below.
+            res = self.bellman_ford(dgraph, source)
+            if res.negative_cycle or not res.converged:
+                return res  # no tree to extract (cpp backend contract)
+            ok = False
+            try:
+                chunk = _edge_chunk_for(1, dgraph.src.shape[0])
+                pred, ok = _extract_pred_kernel(
+                    res.dist, jnp.asarray([source], jnp.int32),
+                    dgraph.src, dgraph.dst, dgraph.weights,
+                    edge_chunk=chunk,
+                )
+                ok = bool(ok)
+            except Exception:
+                self._auto_route_failed(
+                    "_pred_extract_disabled",
+                    "tight-edge pred extraction failed on this platform; "
+                    "falling back to the argmin sweep for this backend "
+                    "instance",
+                    forced=self.config.pred_extraction is True,
+                )
+            if ok:
+                res.pred = pred
+                res.route = f"{res.route or 'sweep'}+pred"
+                # One extraction pass examines every edge once — the
+                # honest O(E) addend vs the sweep's iterations x E.
+                res.edges_relaxed += dgraph.num_real_edges
+                return res
+            self._pred_fallback(
+                "the tree check rejected the one-pass extraction "
+                "(zero-weight tight cycle on a shortest path)"
+            )
+        return self._bellman_ford_pred_sweep(dgraph, source)
+
+    def _bellman_ford_pred_sweep(
+        self, dgraph: JaxDeviceGraph, source: int
+    ) -> KernelResult:
+        """Legacy argmin-tracking sweep (pred carried through every
+        relaxation) — the explicit fallback route of the tight-edge
+        extraction (pred_extraction=False, or a zero-weight tight cycle
+        defeats the one-pass rule)."""
         v = dgraph.num_nodes
         dist0 = jnp.full(v, jnp.inf, self._dtype).at[source].set(0.0)
         max_iter = self.config.max_iterations or v
@@ -1130,12 +1235,81 @@ class JaxBackend(Backend):
             converged=not improving,
             iterations=iters,
             edges_relaxed=iters * dgraph.num_real_edges,
+            route="pred-sweep",
         )
 
     def multi_source_pred(self, dgraph: JaxDeviceGraph, sources: np.ndarray) -> KernelResult:
-        """Fan-out with predecessor tracking. Always the sparse sweep path
-        (the dense min-plus kernels do not carry argmins); sources are
-        sharded across the mesh exactly as in :meth:`multi_source`."""
+        """Fan-out with predecessor trees. Dispatches exactly like
+        :meth:`multi_source` (auto route: vm-blocked / gs / dia / bucket
+        / dense / sharded) and appends one post-fixpoint tight-edge
+        extraction pass (ops.pred); the legacy argmin sweep
+        (:meth:`_multi_source_pred_sweep`) remains as the explicit
+        fallback (pred_extraction=False, or a zero-weight tight cycle
+        rejected by the on-device tree check)."""
+        if self._use_pred_extraction():
+            res = self.multi_source(dgraph, sources)
+            if not res.converged:
+                return res  # the solver raises ConvergenceError; no tree
+            sources_d = jnp.asarray(sources, jnp.int32)
+            b = int(sources_d.shape[0])
+            ok = False
+            try:
+                mesh = self._mesh()
+                if mesh.devices.size > 1:
+                    # Sharded extraction over the sources axis: rows are
+                    # independent, edges replicated — the same layout as
+                    # the sharded fan-out, zero collectives. Valid on
+                    # 1-D and 2-D meshes alike (parallel.mesh).
+                    from paralleljohnson_tpu.parallel import (
+                        sharded_tight_pred,
+                    )
+
+                    ns = int(mesh.shape.get(
+                        "sources", mesh.devices.size
+                    ))
+                    chunk = _edge_chunk_for(
+                        -(-b // ns), dgraph.src.shape[0]
+                    )
+                    pred, ok = sharded_tight_pred(
+                        mesh, res.dist, sources_d,
+                        dgraph.src, dgraph.dst, dgraph.weights,
+                        num_nodes=dgraph.num_nodes, edge_chunk=chunk,
+                    )
+                else:
+                    chunk = _edge_chunk_for(b, dgraph.src.shape[0])
+                    pred, ok = _extract_pred_kernel(
+                        res.dist, sources_d,
+                        dgraph.src, dgraph.dst, dgraph.weights,
+                        edge_chunk=chunk,
+                    )
+                    ok = bool(ok)
+            except Exception:
+                self._auto_route_failed(
+                    "_pred_extract_disabled",
+                    "tight-edge pred extraction failed on this platform; "
+                    "falling back to the argmin sweep for this backend "
+                    "instance",
+                    forced=self.config.pred_extraction is True,
+                )
+            if ok:
+                res.pred = pred
+                res.route = f"{res.route or 'sweep'}+pred"
+                # One extraction pass: E candidate examinations per row.
+                res.edges_relaxed += b * dgraph.num_real_edges
+                return res
+            self._pred_fallback(
+                "the tree check rejected the one-pass extraction "
+                "(zero-weight tight cycle on a shortest path)"
+            )
+        return self._multi_source_pred_sweep(dgraph, sources)
+
+    def _multi_source_pred_sweep(
+        self, dgraph: JaxDeviceGraph, sources: np.ndarray
+    ) -> KernelResult:
+        """Legacy fan-out with argmin tracking through every sweep —
+        the explicit fallback of the tight-edge extraction route;
+        sources are sharded across the mesh exactly as in
+        :meth:`multi_source`."""
         v = dgraph.num_nodes
         sources = jnp.asarray(sources, jnp.int32)
         max_iter = self.config.max_iterations or v
@@ -1174,6 +1348,7 @@ class JaxBackend(Backend):
             converged=not bool(improving),
             iterations=iters,
             edges_relaxed=int(row_sweeps) * dgraph.num_real_edges,
+            route="pred-sweep",
         )
 
     def _pallas_mode(self) -> tuple[bool, bool]:

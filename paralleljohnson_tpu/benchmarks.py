@@ -59,6 +59,7 @@ _SIZES = {
     "er1k_apsp":     dict(n=64,        mini_n=256,       full_n=1000),
     "dimacs_ny_bf":  dict(rows=24,     mini_rows=96,     full_rows=515),
     "dimacs_ny_scrambled": dict(rows=24, mini_rows=96,   full_rows=515),
+    "dimacs_ny_scrambled_pred": dict(rows=24, mini_rows=96, full_rows=515),
     "ego_fb_nsource": dict(scale=8,    mini_scale=10,    full_scale=12,
                           sources=16,  mini_sources=64,  full_sources=512),
     "rmat_apsp":     dict(scale=8,     mini_scale=12,    full_scale=20,
@@ -178,6 +179,46 @@ def bench_dimacs_ny_scrambled(backend: str, preset: str) -> BenchRecord:
     )
 
 
+def bench_dimacs_ny_scrambled_pred(backend: str, preset: str) -> BenchRecord:
+    """Config 2c (round-7 tentpole): the scrambled road-graph SSSP with
+    ``--predecessors`` — the solve that used to abandon the whole fast
+    kernel family for the legacy argmin sweep. Times the tight-edge
+    extraction route AND (jax only) the legacy sweep on the same graph,
+    so BENCH/BASELINE record the pred-route speedup and the exact
+    edges-examined ratio (extraction adds one O(E) pass; the sweep pays
+    iterations x E)."""
+    from paralleljohnson_tpu.graphs import grid2d, permute_labels
+
+    rows = _sz("dimacs_ny_scrambled_pred", "rows", preset)
+    g = permute_labels(
+        grid2d(rows, rows, negative_fraction=0.2, seed=7), seed=11
+    )
+    solver = _solver(backend)
+    solver.sssp(g, 0, predecessors=True)  # warm
+    t0 = time.perf_counter()
+    res = solver.sssp(g, 0, predecessors=True)
+    wall = time.perf_counter() - t0
+    detail = {
+        "nodes": g.num_nodes, "edges": g.num_real_edges,
+        "reached_frac": _finite_frac(res.dist), **_routes(res),
+    }
+    if backend == "jax":
+        legacy = _solver(backend, pred_extraction=False)
+        legacy.sssp(g, 0, predecessors=True)  # warm
+        t0 = time.perf_counter()
+        lres = legacy.sssp(g, 0, predecessors=True)
+        detail["legacy_sweep_wall_s"] = round(time.perf_counter() - t0, 6)
+        detail["legacy_sweep_edges_relaxed"] = lres.stats.edges_relaxed
+        detail["pred_route_speedup"] = round(
+            detail["legacy_sweep_wall_s"] / max(wall, 1e-9), 3
+        )
+    return BenchRecord(
+        "dimacs_ny_scrambled_pred", backend, preset, wall,
+        res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
+        detail,
+    )
+
+
 def bench_ego_fb_nsource(backend: str, preset: str) -> BenchRecord:
     """Config 3 (BASELINE.json:9): batched N-source fan-out on a
     non-negative power-law graph (ego-Facebook profile). Stand-in: R-MAT
@@ -294,6 +335,7 @@ CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "er1k_apsp": bench_er1k_apsp,
     "dimacs_ny_bf": bench_dimacs_ny_bf,
     "dimacs_ny_scrambled": bench_dimacs_ny_scrambled,
+    "dimacs_ny_scrambled_pred": bench_dimacs_ny_scrambled_pred,
     "ego_fb_nsource": bench_ego_fb_nsource,
     "rmat_apsp": bench_rmat_apsp,
     "batch_small": bench_batch_small,

@@ -81,6 +81,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--predecessors", action="store_true",
                    help="also compute shortest-path trees (saved to --output)")
+    p.add_argument("--pred-extraction", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="post-fixpoint tight-edge predecessor extraction: "
+                        "--predecessors solves run the same fast auto "
+                        "route as plain solves plus one extraction pass "
+                        "(route tag '<route>+pred'); false = legacy "
+                        "argmin sweep (route tag 'pred-sweep')")
     p.add_argument("--validate", action="store_true",
                    help="cross-check against the scipy oracle (slow)")
     p.add_argument("--output", default=None, help="write result .npz here")
@@ -117,6 +124,7 @@ def _config(args) -> "SolverConfig":
         delta=args.delta,
         gs_block_size=args.gs_block_size,
         gs_inner_cap=args.gs_inner_cap,
+        pred_extraction=tristate[args.pred_extraction],
         checkpoint_dir=args.checkpoint_dir,
         validate=args.validate,
     )
@@ -266,6 +274,12 @@ def main(argv: list[str] | None = None) -> int:
                     "gauss_seidel": bool(be._use_gs(dg)),
                     "frontier": bool(be._use_frontier(dg)),
                     "edge_shard": bool(be._use_edge_shard(dg)),
+                    # A --predecessors solve takes the SAME route above
+                    # plus one tight-edge extraction pass ("<route>+pred")
+                    # — or the legacy argmin sweep when extraction is off.
+                    "pred": (
+                        "extract" if be._use_pred_extraction() else "sweep"
+                    ),
                 },
                 "dia_qualifies": dia_lay is not None,
                 "dia_offsets": (

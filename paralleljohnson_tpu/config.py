@@ -116,6 +116,18 @@ class SolverConfig:
         per-visit propagation, never correctness; lower caps cut
         candidate work (CPU evidence: cap=64 examines ~2.3x Jacobi's
         candidates at road scale) at the price of more outer rounds.
+      pred_extraction: post-fixpoint tight-edge predecessor extraction
+        (``ops.pred``): ``--predecessors`` solves run the SAME auto route
+        as plain solves (vm-blocked / gs / dia / bucket / dense /
+        sharded) and append one vectorized extraction pass over the
+        edges, instead of pinning the whole solve to the legacy
+        source-major argmin sweep (iterations x B x E work vs the
+        extraction's single O(E x B) pass). ``"auto"``: extraction, with
+        an automatic fallback to the legacy sweep when the on-device
+        tree check detects a zero-weight tight cycle the one-pass rule
+        cannot resolve (rare; warns). True forces extraction (the cycle
+        fallback becomes an error); False keeps the legacy argmin sweep
+        (route tag ``pred-sweep``).
       edge_shard: shard the EDGE LIST across the mesh for single-source
         Bellman-Ford (dist replicated, one pmin all-reduce per sweep) —
         the scale-out axis when the edge list exceeds one chip's HBM,
@@ -147,6 +159,7 @@ class SolverConfig:
     gauss_seidel: bool | str = "auto"
     gs_block_size: int = 8192
     gs_inner_cap: int = 64
+    pred_extraction: bool | str = "auto"
     edge_shard: bool | str = "auto"
     checkpoint_dir: str | None = None
     validate: bool = False
@@ -213,6 +226,11 @@ class SolverConfig:
         if self.gs_inner_cap < 1:
             raise ValueError(
                 f"gs_inner_cap must be >= 1, got {self.gs_inner_cap}"
+            )
+        if self.pred_extraction not in (True, False, "auto"):
+            raise ValueError(
+                "pred_extraction must be True/False/'auto', "
+                f"got {self.pred_extraction!r}"
             )
         if self.edge_shard not in (True, False, "auto"):
             raise ValueError(

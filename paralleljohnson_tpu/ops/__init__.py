@@ -3,3 +3,5 @@
 from paralleljohnson_tpu.ops import relax
 
 __all__ = ["relax"]
+# ops.pred / ops.dia / ops.bucket / ops.gauss_seidel / ops.pallas_* are
+# imported lazily at their dispatch sites (they may build device arrays).

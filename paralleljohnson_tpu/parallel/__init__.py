@@ -10,6 +10,7 @@ from paralleljohnson_tpu.parallel.mesh import (
     sharded_fanout_2d,
     sharded_dia_fanout,
     sharded_gs_fanout,
+    sharded_tight_pred,
 )
 
 __all__ = [
@@ -22,4 +23,5 @@ __all__ = [
     "sharded_fanout_2d",
     "sharded_dia_fanout",
     "sharded_gs_fanout",
+    "sharded_tight_pred",
 ]

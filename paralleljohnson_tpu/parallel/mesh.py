@@ -419,6 +419,69 @@ def sharded_dia_fanout(
     return dist[:b], iters, improving.astype(bool), examined
 
 
+@functools.lru_cache(maxsize=32)
+def _sharded_tight_pred_fn(mesh: Mesh, num_nodes: int, edge_chunk: int):
+    """Tight-edge predecessor extraction (``ops.pred``) sharded over the
+    "sources" axis: rows are independent, so each device extracts trees
+    for its own [B/n, V] distance block against the REPLICATED edge list
+    — zero collectives, exactly the sharded-fanout data layout (CSR
+    replicated per chip). Valid on the 1-D sources mesh AND the 2-D
+    ("sources", "edges") mesh: ``P("sources")`` leaves rows replicated
+    over the edges axis, and the body is deterministic in replicated
+    inputs, so each edges shard computes the identical tree."""
+
+    def shard_body(dist, srcs, s, t, wt):
+        from paralleljohnson_tpu.ops.pred import extract_pred
+
+        pred, ok = extract_pred(
+            dist, srcs, s, t, wt, edge_chunk=edge_chunk
+        )
+        return pred, ok[None].astype(jnp.int32)  # [1] per shard
+
+    mapped = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P("sources"), P("sources"), P(None), P(None), P(None)),
+        out_specs=(P("sources"), P("sources")),
+    )
+    return jax.jit(mapped)
+
+
+def sharded_tight_pred(
+    mesh: Mesh,
+    dist,
+    sources,
+    src,
+    dst,
+    w,
+    *,
+    num_nodes: int,
+    edge_chunk: int = 1 << 20,
+):
+    """Post-fixpoint predecessor extraction with the distance rows
+    sharded over ``mesh``'s "sources" axis (the mesh the fan-out ran
+    on). Pads ``dist``/``sources`` to a mesh multiple by duplicating row
+    0 (dropped from the output), mirroring :func:`sharded_fanout`.
+
+    Returns (pred[B, V] int32 sharded on "sources", ok bool) — ``ok``
+    is the host-reduced all-shards tree-validity certificate
+    (``ops.pred.extract_pred`` contract): False means a zero-weight
+    tight cycle defeated the one-pass rule and the caller must fall
+    back to the legacy argmin sweep."""
+    ns = int(mesh.shape.get("sources", mesh.devices.size))
+    sources = jnp.asarray(sources, jnp.int32)
+    b = sources.shape[0]
+    sources, pad = _pad_sources(sources, ns)
+    if pad:
+        dist = jnp.concatenate(
+            [dist, jnp.repeat(dist[:1], pad, axis=0)]
+        )
+    fn = _sharded_tight_pred_fn(mesh, int(num_nodes), int(edge_chunk))
+    pred, ok_vec = fn(dist, sources, src, dst, w)
+    ok = bool(np.all(_fetch_shard_vec(ok_vec)))
+    return pred[:b], ok
+
+
 def make_mesh_2d(mesh_shape: tuple[int, int]) -> Mesh:
     """2-D ``("sources", "edges")`` mesh: sources axis for fan-out
     throughput, edges axis for edge lists beyond one chip's HBM — the two

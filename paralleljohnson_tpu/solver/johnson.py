@@ -135,6 +135,14 @@ def _unreweight(rows, h, row_sources):
     return rows - hh[row_sources][:, None] + hh[None, :]
 
 
+# Row blocks at least this large trigger Backend.clear_caches before the
+# host download / reduction materializes them (the HBM-hygiene step toward
+# the RMAT-22 crash fix: layout caches + the download buffer must not
+# coexist at full scale). 1 GB: only genuinely large multi-batch solves
+# pay the cache rebuild; tests monkeypatch this to 0.
+_DOWNLOAD_CLEAR_MIN_BYTES = 1 << 30
+
+
 _ROW_REDUCERS = {
     "checksum": _reduce_checksum,
     "eccentricity": _reduce_eccentricity,
@@ -252,7 +260,8 @@ class ParallelJohnsonSolver:
         h, dgraph = self._potentials(graph, dgraph, stats)
         values = []
         with phase_timer(stats, "fanout"):
-            for batch in self._source_batches(sources, dgraph):
+            batches = self._source_batches(sources, dgraph)
+            for batch in batches:
                 res = self.backend.multi_source(dgraph, batch)
                 stats.accumulate(res, phase="fanout")
                 if not res.converged:
@@ -262,6 +271,16 @@ class ParallelJohnsonSolver:
                 rows = res.dist
                 if graph.has_negative_weights:
                     rows = _unreweight(rows, h, batch)
+                # Same HBM-hygiene gate as _fanout's downloads: a reducer
+                # may materialize the rows host-side, and at RMAT-22
+                # scale the layout caches must not still be resident
+                # when it does (the s22 crash mitigation).
+                if (
+                    len(batches) > 1
+                    and int(getattr(rows, "nbytes", 0) or 0)
+                    >= _DOWNLOAD_CLEAR_MIN_BYTES
+                ):
+                    self.backend.clear_caches(dgraph)
                 values.append(reduce_rows(rows, batch))
         return ReducedResult(
             values=values, sources=sources, potentials=h, stats=stats
@@ -378,16 +397,40 @@ class ParallelJohnsonSolver:
         return h, dgraph
 
     def _source_batches(
-        self, sources: np.ndarray, dgraph: Any = None
+        self, sources: np.ndarray, dgraph: Any = None, *,
+        with_pred: bool = False,
     ) -> list[np.ndarray]:
         bs = self.config.source_batch_size
         if bs is None and dgraph is not None:
             # The promised fits-memory heuristic (config.source_batch_size
             # docstring): the backend sizes the [B, V] block to its device
-            # budget so e.g. RMAT-20 full APSP cannot OOM by default.
-            bs = self.backend.suggested_source_batch(dgraph)
+            # budget so e.g. RMAT-20 full APSP cannot OOM by default. A
+            # pred solve passes with_pred so the extra int32 [B, V] pred
+            # block is budgeted too (plain calls keep the positional-only
+            # signature third-party backends already implement).
+            if with_pred:
+                bs = self.backend.suggested_source_batch(
+                    dgraph, with_pred=True
+                )
+            else:
+                bs = self.backend.suggested_source_batch(dgraph)
         bs = bs or len(sources) or 1
         return [sources[i : i + bs] for i in range(0, len(sources), bs)]
+
+    def _download_rows(self, dgraph: Any, rows, pred=None):
+        """Materialize one batch's device rows on the host, clearing the
+        backend's rebuildable device caches first when the block is large
+        (``_DOWNLOAD_CLEAR_MIN_BYTES``) — at RMAT-22 scale the layout
+        caches and the download buffer must not coexist in HBM."""
+        nbytes = int(getattr(rows, "nbytes", 0) or 0)
+        if pred is not None:
+            nbytes += int(getattr(pred, "nbytes", 0) or 0)
+        if nbytes >= _DOWNLOAD_CLEAR_MIN_BYTES:
+            self.backend.clear_caches(dgraph)
+        return (
+            np.asarray(rows),
+            None if pred is None else np.asarray(pred),
+        )
 
     def _fanout(
         self,
@@ -409,7 +452,7 @@ class ParallelJohnsonSolver:
             ckpt = BatchCheckpointer(
                 self.config.checkpoint_dir, graph_key=graph
             )
-        batches = self._source_batches(sources, dgraph)
+        batches = self._source_batches(sources, dgraph, with_pred=with_pred)
         rows: list[np.ndarray] = []
         preds: list[np.ndarray] = []
         for batch_idx, batch in enumerate(batches):
@@ -440,8 +483,7 @@ class ParallelJohnsonSolver:
             # (host .npz) forces the download either way.
             row, pred = res.dist, res.pred
             if ckpt is not None or len(batches) > 1:
-                row = np.asarray(row)
-                pred = None if pred is None else np.asarray(pred)
+                row, pred = self._download_rows(dgraph, row, pred)
                 if ckpt is not None:
                     ckpt.save(batch_idx, batch, row, pred=pred)
             rows.append(row)

@@ -1,0 +1,103 @@
+"""On-chip pred-route micro (round-7 tentpole): ``--predecessors`` at
+full fast-route speed vs the legacy argmin sweep.
+
+Two measurements, both DIRECT-backend (no BASELINE.md writes — run
+``pjtpu bench dimacs_ny_scrambled_pred --preset full --update-baseline
+BASELINE.md`` afterwards for the recorded row):
+
+  1. B=1 SSSP on the scrambled 515x515 road stand-in (the dimacs full
+     shape whose labeling disqualifies DIA): auto should route
+     ``bucket+pred`` on TPU — one tight-edge extraction pass appended to
+     the bucket fixpoint — vs the legacy ``pred-sweep`` whose argmin
+     tracking pays 3 segment reductions per chunk per Jacobi sweep.
+  2. B=128 fan-out on rmat-16: auto ``vm-blocked+pred`` (or ``vm+pred``)
+     vs the legacy source-major pred sweep.
+
+The exact edges-examined counters are printed with each wall-clock so
+the "one extra O(E x B) pass, not iterations x B x E" claim is checked
+by measurement, not asserted. Minimal (one warm, one measure per config)
+so a brief tunnel-health window can still capture it.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+import numpy as np
+
+from paralleljohnson_tpu.backends import get_backend
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import grid2d, permute_labels, rmat
+
+
+def _sync(arr):
+    # Scalar download is the only reliable device sync through the
+    # tunnel (memory: axon gotchas).
+    float(np.asarray(arr).ravel()[0])
+
+
+def _time_pred(label, be, dg, call):
+    r = call()  # compile + warm
+    _sync(r.dist)
+    t0 = time.perf_counter()
+    r = call()
+    _sync(r.dist)
+    _sync(r.pred)
+    dt = time.perf_counter() - t0
+    print(
+        f"{label}: {dt:.3f}s route={r.route} iters={r.iterations} "
+        f"examined={r.edges_relaxed:,}",
+        flush=True,
+    )
+    return dt, r
+
+
+def main():
+    # 1) scrambled road stand-in, B=1 (the attested dimacs shape).
+    g = permute_labels(
+        grid2d(515, 515, negative_fraction=0.2, seed=7), seed=11
+    )
+    print(f"scrambled grid 515x515: V={g.num_nodes} E={g.num_real_edges}",
+          flush=True)
+    be = get_backend("jax", SolverConfig())
+    dg = be.upload(g)
+    dt_fast, r = _time_pred(
+        "sssp-pred auto", be, dg, lambda: be.bellman_ford_pred(dg, 0)
+    )
+    if not (r.route or "").endswith("+pred"):
+        print("WARNING: auto pred solve did not take the extraction "
+              f"route (got {r.route}) — check _pred_extract_disabled",
+              flush=True)
+    be_legacy = get_backend("jax", SolverConfig(pred_extraction=False))
+    dg_l = be_legacy.upload(g)
+    dt_legacy, _ = _time_pred(
+        "sssp-pred legacy", be_legacy, dg_l,
+        lambda: be_legacy.bellman_ford_pred(dg_l, 0),
+    )
+    print(f"sssp pred-route speedup: {dt_legacy / max(dt_fast, 1e-9):.1f}x",
+          flush=True)
+
+    # 2) rmat-16 fan-out, B=128 (the vm-blocked family shape class).
+    g2 = rmat(16, 16, seed=3)
+    sources = np.arange(128)
+    print(f"rmat16: V={g2.num_nodes} E={g2.num_real_edges} B=128",
+          flush=True)
+    dg2 = be.upload(g2)
+    dt_fast, r = _time_pred(
+        "fanout-pred auto", be, dg2,
+        lambda: be.multi_source_pred(dg2, sources),
+    )
+    if not (r.route or "").endswith("+pred"):
+        print(f"WARNING: fan-out pred took {r.route}, not an extraction "
+              "route", flush=True)
+    dg2_l = be_legacy.upload(g2)
+    dt_legacy, _ = _time_pred(
+        "fanout-pred legacy", be_legacy, dg2_l,
+        lambda: be_legacy.multi_source_pred(dg2_l, sources),
+    )
+    print(f"fanout pred-route speedup: {dt_legacy / max(dt_fast, 1e-9):.1f}x",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -62,8 +62,20 @@ run 2400 jax-rmat20-full python -m paralleljohnson_tpu.cli bench rmat_apsp --bac
 #     the intended kernel — check tags, not just wall-clocks)
 run 60 route-tags grep -E '\| jax \|' BASELINE.md
 
-# 4b) pallas VMEM-resident sweep vs XLA (Mosaic compile + perf decision)
+# 4b) pallas VMEM-resident sweep vs XLA — the ONE outstanding compiled
+#     measurement (round-5 verdict next #6: promote or delete; either
+#     way this stage lands the deciding number in the first healthy
+#     tunnel window)
 run 1500 pallas-sweep python scripts/tpu_pallas_sweep_micro.py
+
+# 4c) pred-route micro (round-7 tentpole): --predecessors at fast-route
+#     speed — bucket+pred on the scrambled dimacs shape, vm-blocked+pred
+#     on rmat16, each vs the legacy argmin sweep
+run 900 pred-route python scripts/tpu_pred_micro.py
+
+# 4d) the recorded pred bench row (route tag + legacy-sweep speedup in
+#     the detail column)
+run 900 jax-dimacs-pred python -m paralleljohnson_tpu.cli bench dimacs_ny_scrambled_pred --backend jax --preset full --update-baseline BASELINE.md
 
 # 5) driver metric (should reflect the blocked kernel now)
 run 1200 bench.py python bench.py

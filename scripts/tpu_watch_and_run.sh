@@ -1,6 +1,10 @@
 #!/bin/bash
 # Probe the TPU tunnel periodically; the moment it is healthy, run the
-# round-3/4 measurement pass (scripts/tpu_round3_run.sh) to completion.
+# staged measurement pass (scripts/tpu_round3_run.sh) to completion.
+# The stage list includes the round-7 pred-route micro + bench row
+# (tight-edge extraction vs the legacy argmin sweep) and the one
+# outstanding compiled pallas_sweep measurement, so both land
+# automatically in the first healthy tunnel window.
 # Single-tenant discipline: only this watcher dials the device while it
 # runs; everything else in the session must force CPU
 # (paralleljohnson_tpu.utils.platform.honor_cpu_platform_request).

@@ -22,8 +22,11 @@ def test_info_graph_route_diagnosis(capsys):
     assert gi["nodes"] == 81 and gi["dia_qualifies"]
     assert gi["dia_offsets"] == [-9, -1, 1, 9]
     assert set(gi["routes"]) == {
-        "dense", "dia", "bucket", "gauss_seidel", "frontier", "edge_shard"
+        "dense", "dia", "bucket", "gauss_seidel", "frontier", "edge_shard",
+        "pred",
     }
+    # --predecessors rides the same route plus one extraction pass.
+    assert gi["routes"]["pred"] == "extract"
 
 
 def test_solve_json(capsys):

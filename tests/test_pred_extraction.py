@@ -1,0 +1,353 @@
+"""Tight-edge predecessor extraction (round-7 tentpole, ``ops.pred``):
+``--predecessors`` solves ride the SAME fast auto route as plain solves
+plus ONE post-fixpoint extraction pass — route tags ``<route>+pred``,
+exact-counter evidence of the single O(E x B) overhead, the legacy
+argmin sweep as the explicit fallback, and the shared
+``validate_pred_tree`` invariant checker used for cpp cross-checks."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.backends import available_backends, get_backend
+from paralleljohnson_tpu.graphs import (
+    CSRGraph,
+    erdos_renyi,
+    grid2d,
+    permute_labels,
+    random_dag,
+    rmat,
+)
+from paralleljohnson_tpu.utils.paths import validate_pred_tree
+
+
+def _zero_cycle_graph():
+    """0 -> 3 (w=1) -> 1 <-> 2 (both w=0): the tight zero-weight cycle
+    {1, 2} sits on shortest paths and every single-pass local tie-break
+    rule picks mutually-pointing predecessors for it (the hazard the
+    native BFS avoids by first-discovery)."""
+    edges = [(0, 3, 1.0), (3, 1, 0.0), (1, 2, 0.0), (2, 1, 0.0)]
+    s, d, w = zip(*edges)
+    return CSRGraph.from_edges(s, d, w, 4)
+
+
+# -- ops.pred unit level ------------------------------------------------------
+
+
+def test_tight_pred_pass_lexicographic_tiebreak():
+    """Among tight in-edges the winner is min (dist[u], u): the strictly
+    closer predecessor beats an equal-dist zero edge, and equal-dist
+    candidates break to the smallest id."""
+    import jax.numpy as jnp
+
+    from paralleljohnson_tpu.ops.pred import extract_pred
+
+    # 0 -> 1 (w=1), 0 -> 2 (w=1), 2 -> 1 (w=0) with dist = [0, 1, 1]:
+    # both in-edges of v=1 are tight; dist[0]=0 < dist[2]=1 so the
+    # strictly closer predecessor 0 must win over the zero edge.
+    src = jnp.asarray([0, 0, 2], jnp.int32)
+    dst = jnp.asarray([1, 2, 1], jnp.int32)
+    w = jnp.asarray([1.0, 1.0, 0.0], jnp.float32)
+    dist = jnp.asarray([[0.0, 1.0, 1.0]], jnp.float32)
+    pred, ok = extract_pred(
+        dist, jnp.asarray([0], jnp.int32), src, dst, w
+    )
+    assert bool(ok)
+    assert pred.tolist() == [[-1, 0, 0]]
+
+
+def test_pred_reaches_root_detects_cycle():
+    import jax.numpy as jnp
+
+    from paralleljohnson_tpu.ops.pred import pred_reaches_root
+
+    tree = jnp.asarray([[-1, 0, 1, 1]], jnp.int32)
+    assert bool(jnp.all(pred_reaches_root(tree)))
+    cycle = jnp.asarray([[-1, 2, 1, 1]], jnp.int32)  # 1 <-> 2
+    reaches = np.asarray(pred_reaches_root(cycle))
+    assert reaches[0, 0]
+    assert not reaches[0, 1] and not reaches[0, 2]
+    assert not reaches[0, 3]  # 3 drains INTO the cycle via pred=1
+
+
+# -- validate_pred_tree (the shared invariant checker) ------------------------
+
+
+def test_validate_pred_tree_accepts_and_rejects():
+    g = erdos_renyi(40, 0.12, seed=4)
+    res = ParallelJohnsonSolver(
+        SolverConfig(backend="numpy")
+    ).multi_source(g, np.arange(6), predecessors=True)
+    dist = np.asarray(res.dist)
+    pred = np.asarray(res.predecessors)
+    validate_pred_tree(g, dist, pred, res.sources)  # must pass
+
+    bad = pred.copy()
+    bad[0, res.sources[0]] = 0
+    with pytest.raises(ValueError, match="pred\\[source\\]"):
+        validate_pred_tree(g, dist, bad, res.sources)
+
+    finite = np.isfinite(dist[0])
+    finite[res.sources[0]] = False
+    if finite.any():
+        v = int(np.flatnonzero(finite)[0])
+        bad = pred.copy()
+        bad[0, v] = -1  # drop a reachable vertex's predecessor
+        with pytest.raises(ValueError, match="no predecessor"):
+            validate_pred_tree(g, dist, bad, res.sources)
+
+    # A mutual 2-cycle between two reachable vertices must be caught even
+    # when (by construction below) the edges price as "tight enough":
+    # fabricate it on the zero-cycle graph where 1<->2 are real 0-edges.
+    gz = _zero_cycle_graph()
+    dz = np.array([[0.0, 1.0, 1.0, 1.0]])
+    pz = np.array([[-1, 2, 1, 0]], np.int32)
+    with pytest.raises(ValueError, match="cycle"):
+        validate_pred_tree(gz, dz, pz, np.array([0]))
+
+    # Non-tight pred edge: pred[3]=0 with w(0,3)=1 is tight; claim pred
+    # via a non-edge instead.
+    pz2 = np.array([[-1, 3, 1, 1]], np.int32)  # (1, 3)? 1->3 not an edge
+    with pytest.raises(ValueError, match="not in the graph"):
+        validate_pred_tree(gz, dz, pz2, np.array([0]))
+
+
+# -- route + counter behavior -------------------------------------------------
+
+
+def test_fanout_pred_rides_fast_route_with_one_extra_pass():
+    """The exact-counter acceptance criterion: a pred fan-out reports the
+    SAME route as the plain fan-out plus ``+pred``, and its edges-relaxed
+    total exceeds the plain solve's by exactly B x E — one extraction
+    pass, not iterations x B x E."""
+    g = rmat(9, 8, seed=5)
+    cfg = SolverConfig(backend="jax", mesh_shape=(1,))
+    solver = ParallelJohnsonSolver(cfg)
+    sources = np.arange(32)
+    plain = solver.multi_source(g, sources)
+    pred = solver.multi_source(g, sources, predecessors=True)
+    plain_route = plain.stats.routes_by_phase["fanout"]
+    assert pred.stats.routes_by_phase["fanout"] == plain_route + "+pred"
+    assert (
+        pred.stats.edges_relaxed
+        == plain.stats.edges_relaxed + len(sources) * g.num_real_edges
+    )
+    np.testing.assert_allclose(
+        np.asarray(pred.dist), np.asarray(plain.dist), rtol=1e-6
+    )
+    validate_pred_tree(g, pred.dist, pred.predecessors, pred.sources)
+
+
+def test_sssp_pred_on_scrambled_standin_leaves_the_plain_sweep():
+    """Satellite routing test: ``--predecessors`` on the
+    ``dimacs_ny_scrambled`` stand-in (smoke shape) must NOT land on the
+    plain source-major sweep — on the CPU mesh the frontier route serves
+    it, tagged ``frontier+pred``."""
+    from paralleljohnson_tpu import benchmarks
+
+    rows = benchmarks._sz("dimacs_ny_scrambled", "rows", "smoke")
+    g = permute_labels(
+        grid2d(rows, rows, negative_fraction=0.2, seed=7), seed=11
+    )
+    res = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", mesh_shape=(1,))
+    ).sssp(g, 0, predecessors=True)
+    route = res.stats.routes_by_phase["bellman_ford"]
+    assert route not in ("sweep", "pred-sweep")
+    assert route.endswith("+pred")
+    assert route == "frontier+pred"  # the CPU-mesh winner for this family
+    validate_pred_tree(g, res.dist, res.predecessors, res.sources)
+
+
+def test_sssp_pred_routes_bucket_on_simulated_tpu(monkeypatch):
+    """The headline tag of the tentpole: on TPU the scrambled road
+    family routes bucket, and a pred solve reports ``bucket+pred``."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    g = permute_labels(
+        grid2d(24, 24, negative_fraction=0.2, seed=7), seed=11
+    )
+    be = get_backend("jax", SolverConfig(mesh_shape=(1,)))
+    dg = be.upload(g)
+    res = be.bellman_ford_pred(dg, 0)
+    assert res.route in ("bucket+pred", "bucket+sweep+pred")
+    validate_pred_tree(g, res.dist[None], res.pred[None], np.array([0]))
+
+
+def test_pred_extraction_false_keeps_legacy_sweep():
+    g = erdos_renyi(50, 0.1, seed=8)
+    res = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", mesh_shape=(1,), pred_extraction=False)
+    ).multi_source(g, np.arange(8), predecessors=True)
+    assert res.stats.routes_by_phase["fanout"] == "pred-sweep"
+    validate_pred_tree(g, res.dist, res.predecessors, res.sources)
+
+
+def test_sharded_pred_extraction_route_and_validity():
+    g = erdos_renyi(48, 0.1, seed=5)
+    res = ParallelJohnsonSolver(
+        SolverConfig(backend="jax")  # all 8 CPU-sim devices
+    ).multi_source(g, np.arange(13), predecessors=True)
+    assert res.stats.routes_by_phase["fanout"] == "sharded-1d+pred"
+    validate_pred_tree(g, res.dist, res.predecessors, res.sources)
+    res2d = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", mesh_shape=(2, 4))
+    ).multi_source(g, np.arange(13), predecessors=True)
+    assert res2d.stats.routes_by_phase["fanout"] == "sharded-2d+pred"
+    np.testing.assert_allclose(
+        np.asarray(res2d.dist), np.asarray(res.dist), rtol=1e-6
+    )
+    validate_pred_tree(g, res2d.dist, res2d.predecessors, res2d.sources)
+
+
+# -- the zero-weight tight-cycle fallback ------------------------------------
+
+
+def test_zero_weight_tight_cycle_falls_back_to_sweep():
+    g = _zero_cycle_graph()
+    cfg = SolverConfig(backend="jax", mesh_shape=(1,))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        res = ParallelJohnsonSolver(cfg).multi_source(
+            g, np.array([0]), predecessors=True
+        )
+    assert any("fell back" in str(r.message) for r in rec)
+    assert res.stats.routes_by_phase["fanout"] == "pred-sweep"
+    validate_pred_tree(g, res.dist, res.predecessors, res.sources)
+    # res.path raises ValueError if the tree cycles — walk every target.
+    for t in range(4):
+        res.path(0, t)
+
+
+def test_zero_weight_tight_cycle_forced_extraction_raises():
+    g = _zero_cycle_graph()
+    cfg = SolverConfig(
+        backend="jax", mesh_shape=(1,), pred_extraction=True
+    )
+    with pytest.raises(RuntimeError, match="pred_extraction=True"):
+        ParallelJohnsonSolver(cfg).multi_source(
+            g, np.array([0]), predecessors=True
+        )
+
+
+# -- memory model + cache hygiene --------------------------------------------
+
+
+def test_suggested_source_batch_accounts_for_pred_block(monkeypatch):
+    """with_pred batches must budget the extra int32 [B, V] pred block +
+    extraction carries: 9 [B, V]-equivalents instead of 6."""
+    g = erdos_renyi(64, 0.1, seed=12)
+    be = get_backend("jax", SolverConfig(mesh_shape=(1,)))
+    dg = be.upload(g)
+    monkeypatch.setattr(
+        type(be), "_memory_budget_bytes", lambda self: 90 * 64 * 4
+    )
+    assert be.suggested_source_batch(dg) == 15           # 90 // 6
+    assert be.suggested_source_batch(dg, with_pred=True) == 10  # 90 // 9
+
+
+def test_clear_caches_drops_layout_and_by_dst_entries():
+    g = erdos_renyi(64, 0.1, seed=1)
+    be = get_backend("jax", SolverConfig(mesh_shape=(1,)))
+    dg = be.upload(g)
+    dg.by_dst()
+    dg.gs_layout(16)
+    assert dg._by_dst_cache and dg._struct_cache
+    be.clear_caches(dg)
+    assert not dg._by_dst_cache and not dg._struct_cache
+
+
+def test_multibatch_download_invokes_clear_caches(monkeypatch):
+    """The HBM-hygiene step toward the s22 crash fix: large multi-batch
+    row downloads clear the device-side layout caches first (threshold
+    forced to 0 here), and the caches really are empty at download
+    time."""
+    from paralleljohnson_tpu.solver import johnson
+
+    monkeypatch.setattr(johnson, "_DOWNLOAD_CLEAR_MIN_BYTES", 0)
+    g = erdos_renyi(64, 0.1, seed=3)
+    cfg = SolverConfig(backend="jax", mesh_shape=(1,), source_batch_size=8)
+    solver = ParallelJohnsonSolver(cfg)
+    seen = []
+    real = type(solver.backend).clear_caches
+
+    def spy(self, dgraph):
+        real(self, dgraph)
+        seen.append(
+            (len(dgraph._struct_cache), len(dgraph._by_dst_cache))
+        )
+
+    monkeypatch.setattr(type(solver.backend), "clear_caches", spy)
+    res = solver.multi_source(g, np.arange(24))
+    assert len(seen) == 3  # one clear per downloaded batch
+    assert all(s == (0, 0) for s in seen)  # empty at download time
+    from tests.conftest import oracle_apsp
+
+    np.testing.assert_allclose(
+        np.asarray(res.dist), oracle_apsp(g)[:24], rtol=1e-4, atol=1e-4
+    )
+
+
+def test_solve_reduced_clears_caches_when_rows_large(monkeypatch):
+    from paralleljohnson_tpu.solver import johnson
+
+    monkeypatch.setattr(johnson, "_DOWNLOAD_CLEAR_MIN_BYTES", 0)
+    g = erdos_renyi(48, 0.1, seed=6)
+    cfg = SolverConfig(backend="jax", mesh_shape=(1,), source_batch_size=16)
+    solver = ParallelJohnsonSolver(cfg)
+    calls = []
+    monkeypatch.setattr(
+        type(solver.backend), "clear_caches",
+        lambda self, dg: calls.append(1),
+    )
+    solver.solve_reduced(g, reduce_rows="reach_count")
+    assert len(calls) == 3  # 48 sources / 16 per batch
+
+
+# -- cross-backend equivalence (incl. the cpp tight-edge BFS) ----------------
+
+
+@pytest.mark.skipif(
+    "cpp" not in available_backends(), reason="native library not buildable"
+)
+def test_pred_trees_valid_vs_cpp_on_negative_graphs():
+    """Trees need not be identical across backends — each must validate
+    against its OWN distances, and the distances must agree. Negative
+    edges exercise the reweighted (exactly-zero tree edges) regime the
+    extraction tolerance rule was designed for."""
+    for seed in (3, 9, 17):
+        g = random_dag(40, 0.12, negative_fraction=0.4, seed=seed)
+        sources = np.arange(10)
+        jx = ParallelJohnsonSolver(
+            SolverConfig(backend="jax", mesh_shape=(1,))
+        ).solve(g, sources=sources, predecessors=True)
+        cp = ParallelJohnsonSolver(
+            SolverConfig(backend="cpp")
+        ).solve(g, sources=sources, predecessors=True)
+        np.testing.assert_allclose(
+            np.asarray(jx.dist), cp.dist, rtol=1e-4, atol=1e-4
+        )
+        validate_pred_tree(g, jx.dist, jx.predecessors, sources)
+        validate_pred_tree(g, cp.dist, cp.predecessors, sources)
+
+
+def test_pred_trees_valid_on_hypothesis_graphs():
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings
+
+    from tests.test_properties import graphs
+
+    @settings(max_examples=20, deadline=None)
+    @given(graphs(max_nodes=18, negative=True))
+    def run(g):
+        res = ParallelJohnsonSolver(
+            SolverConfig(backend="jax", mesh_shape=(1,))
+        ).solve(g, sources=np.arange(min(6, g.num_nodes)),
+                predecessors=True)
+        validate_pred_tree(g, res.dist, res.predecessors, res.sources)
+
+    run()
+    assert hypothesis is not None
