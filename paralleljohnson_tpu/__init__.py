@@ -21,7 +21,13 @@ from paralleljohnson_tpu.solver import (
     ValidationError,
 )
 from paralleljohnson_tpu.backends import Backend, available_backends, get_backend
+from paralleljohnson_tpu.utils.faults import Fault, FaultPlan
 from paralleljohnson_tpu.utils.paths import path_weight, reconstruct_path
+from paralleljohnson_tpu.utils.resilience import (
+    RetryPolicy,
+    SolveCorruptionError,
+    StageAbandonedError,
+)
 
 __version__ = "0.1.0"
 
@@ -31,7 +37,12 @@ __all__ = [
     "Backend",
     "CSRGraph",
     "ConvergenceError",
+    "Fault",
+    "FaultPlan",
     "NegativeCycleError",
+    "RetryPolicy",
+    "SolveCorruptionError",
+    "StageAbandonedError",
     "ValidationError",
     "ParallelJohnsonSolver",
     "ReducedResult",

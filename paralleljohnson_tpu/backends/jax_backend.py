@@ -19,6 +19,7 @@ import numpy as np
 from paralleljohnson_tpu.backends.base import Backend, KernelResult, register_backend
 from paralleljohnson_tpu.graphs import CSRGraph
 from paralleljohnson_tpu.ops import relax
+from paralleljohnson_tpu.utils import resilience
 
 # Default inner-fixpoint cap of the blocked Gauss-Seidel kernels
 # (SolverConfig.gs_inner_cap overrides): bounds extra per-block
@@ -926,6 +927,50 @@ class JaxBackend(Backend):
             forced=self.config.gauss_seidel is True,
         )
 
+    def _shard_fault_hook(self):
+        """Fault-injection hook handed to the ``parallel.mesh`` sharded
+        entry points (``config.fault_plan`` stage ``"sharded_fanout"``):
+        fires inside the sharded path, so a simulated collective/tunnel
+        failure surfaces exactly where the real one would. None when no
+        plan is configured."""
+        plan = self.config.fault_plan
+        if plan is None:
+            return None
+
+        def hook():
+            active = plan.fire("sharded_fanout")
+            if active is not None:
+                active.wrap(lambda: None)()
+
+        return hook
+
+    def _sharded_fallback(
+        self, exc: BaseException, dgraph: JaxDeviceGraph, sources, *,
+        pred_sweep: bool = False,
+    ) -> KernelResult:
+        """A sharded fan-out raised (collective failure / tunnel drop):
+        degrade to single-device instead of dying — warn once, pin this
+        backend instance to a 1-device mesh, and re-dispatch the SAME
+        batch through the single-chip routes. OOM is NOT handled here:
+        the solver's OOMDegrader owns that recovery (shrink the batch,
+        keep the mesh), so RESOURCE_EXHAUSTED re-raises untouched."""
+        if resilience.is_oom_error(exc):
+            raise exc
+        self._auto_route_failed(
+            "_sharded_disabled",
+            "sharded fan-out failed (collective/tunnel failure); "
+            "falling back to single-device solves for this backend "
+            "instance",
+            forced=False,
+        )
+        self._mesh_cache = None  # _mesh() rebuilds as a 1-device mesh
+        if pred_sweep:
+            res = self._multi_source_pred_sweep(dgraph, sources)
+        else:
+            res = self.multi_source(dgraph, sources)
+        res.route = f"{res.route or 'sweep'}+1dev-fallback"
+        return res
+
     def _use_edge_shard(self, dgraph: JaxDeviceGraph) -> bool:
         """Edge sharding is the only way a multi-device mesh helps a B=1
         solve. Precedence: an explicit ``edge_shard=True`` wins (the
@@ -934,6 +979,8 @@ class JaxBackend(Backend):
         paths on low-degree graphs where they are work-optimal."""
         flag = self.config.edge_shard
         if flag is False or self._mesh().devices.size <= 1:
+            return False
+        if getattr(self, "_edge_shard_disabled", False):
             return False
         if flag is True:
             return True
@@ -955,25 +1002,41 @@ class JaxBackend(Backend):
         if self._use_edge_shard(dgraph):
             from paralleljohnson_tpu.parallel import edge_sharded_bellman_ford
 
-            emesh = self._edge_mesh()
-            dist, iters, improving = edge_sharded_bellman_ford(
-                emesh, dist0, dgraph.src, dgraph.dst, dgraph.weights,
-                max_iter=max_iter,
-                edge_chunk=_edge_chunk_for(
-                    1, -(-dgraph.src.shape[0] // emesh.devices.size)
-                ),
-            )
-            iters = int(iters)
-            improving = bool(improving)
-            return KernelResult(
-                dist=dist,
-                negative_cycle=improving and max_iter >= v,
-                converged=not improving,
-                iterations=iters,
-                # Each round relaxes the full edge list (across shards).
-                edges_relaxed=iters * dgraph.num_real_edges,
-                route="edge-sharded",
-            )
+            # Degrade-don't-crash like the fan-out's sharded branches: a
+            # collective failure disables edge sharding for this backend
+            # instance and the single-chip chain below serves the solve.
+            # OOM re-raises (the solver's retry path owns that recovery).
+            try:
+                emesh = self._edge_mesh()
+                dist, iters, improving = edge_sharded_bellman_ford(
+                    emesh, dist0, dgraph.src, dgraph.dst, dgraph.weights,
+                    max_iter=max_iter,
+                    edge_chunk=_edge_chunk_for(
+                        1, -(-dgraph.src.shape[0] // emesh.devices.size)
+                    ),
+                    fault_hook=self._shard_fault_hook(),
+                )
+                iters = int(iters)
+                improving = bool(improving)
+                return KernelResult(
+                    dist=dist,
+                    negative_cycle=improving and max_iter >= v,
+                    converged=not improving,
+                    iterations=iters,
+                    # Each round relaxes the full edge list (across shards).
+                    edges_relaxed=iters * dgraph.num_real_edges,
+                    route="edge-sharded",
+                )
+            except Exception as e:
+                if resilience.is_oom_error(e):
+                    raise
+                self._auto_route_failed(
+                    "_edge_shard_disabled",
+                    "edge-sharded Bellman-Ford failed (collective/tunnel "
+                    "failure); falling back to single-chip sweeps for "
+                    "this backend instance",
+                    forced=self.config.edge_shard is True,
+                )
         if self._use_dia(dgraph):
             try:
                 lay = self.dia_bundle(dgraph)
@@ -1329,11 +1392,17 @@ class JaxBackend(Backend):
                 -(-sources.shape[0] // mesh.devices.size),
                 dgraph.src.shape[0],
             )
-            dist, iters, improving, pred, row_sweeps = sharded_fanout(
-                mesh, sources, dgraph.src, dgraph.dst, dgraph.weights,
-                num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
-                with_pred=True, with_row_sweeps=True,
-            )
+            try:
+                dist, iters, improving, pred, row_sweeps = sharded_fanout(
+                    mesh, sources, dgraph.src, dgraph.dst, dgraph.weights,
+                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                    with_pred=True, with_row_sweeps=True,
+                    fault_hook=self._shard_fault_hook(),
+                )
+            except Exception as e:
+                return self._sharded_fallback(
+                    e, dgraph, sources, pred_sweep=True
+                )
         else:
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
             dist, pred, iters, improving = _fanout_pred_kernel(
@@ -1373,11 +1442,16 @@ class JaxBackend(Backend):
 
         cached = getattr(self, "_mesh_cache", None)
         if cached is None:
-            shape = self.config.mesh_shape
-            if shape is not None and len(shape) == 2:
-                cached = make_mesh_2d(shape)
+            if getattr(self, "_sharded_disabled", False):
+                # A sharded solve already failed on this instance
+                # (collective/tunnel failure) — stay on one device.
+                cached = make_mesh((1,))
             else:
-                cached = make_mesh(shape)
+                shape = self.config.mesh_shape
+                if shape is not None and len(shape) == 2:
+                    cached = make_mesh_2d(shape)
+                else:
+                    cached = make_mesh(shape)
             self._mesh_cache = cached
         return cached
 
@@ -1446,6 +1520,7 @@ class JaxBackend(Backend):
                         mesh, sources, lay["w_diag"], num_nodes=v,
                         offsets=lay["offsets"], max_iter=max_iter,
                         num_entries=lay["num_entries"],
+                        fault_hook=self._shard_fault_hook(),
                     )
                     dia_route = "dia-sharded"
                 else:
@@ -1503,6 +1578,7 @@ class JaxBackend(Backend):
                         vb=bundle["vb"], halo=bundle["halo"],
                         max_outer=max_iter, inner_cap=self.config.gs_inner_cap,
                         real_edges_host=bundle["real_edges_host"],
+                        fault_hook=self._shard_fault_hook(),
                     )
                     gs_route = "gs-sharded"
                 else:
@@ -1543,11 +1619,15 @@ class JaxBackend(Backend):
                 dgraph.by_dst() if layout == "vertex_major"
                 else (dgraph.src, dgraph.dst, dgraph.weights)
             )
-            dist, iters, improving, row_sweeps = sharded_fanout_2d(
-                mesh, sources, *edges,
-                num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
-                layout=layout, with_row_sweeps=True,
-            )
+            try:
+                dist, iters, improving, row_sweeps = sharded_fanout_2d(
+                    mesh, sources, *edges,
+                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                    layout=layout, with_row_sweeps=True,
+                    fault_hook=self._shard_fault_hook(),
+                )
+            except Exception as e:
+                return self._sharded_fallback(e, dgraph, sources)
             route = "sharded-2d"
         elif mesh.devices.size > 1:
             from paralleljohnson_tpu.parallel import sharded_fanout
@@ -1563,11 +1643,15 @@ class JaxBackend(Backend):
                 dgraph.by_dst() if layout == "vertex_major"
                 else (dgraph.src, dgraph.dst, dgraph.weights)
             )
-            dist, iters, improving, row_sweeps = sharded_fanout(
-                mesh, sources, *edges,
-                num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
-                layout=layout, with_row_sweeps=True,
-            )
+            try:
+                dist, iters, improving, row_sweeps = sharded_fanout(
+                    mesh, sources, *edges,
+                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                    layout=layout, with_row_sweeps=True,
+                    fault_hook=self._shard_fault_hook(),
+                )
+            except Exception as e:
+                return self._sharded_fallback(e, dgraph, sources)
             route = "sharded-1d"
         elif self._use_dense(dgraph):
             use_pallas, interpret = self._pallas_mode()

@@ -79,6 +79,18 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="max Gauss-Seidel inner iterations per block "
                         "visit (bounds extra propagation, not correctness)")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--retry-attempts", type=int, default=3,
+                   help="max attempts per solve stage before the failure "
+                        "propagates (1 disables retries)")
+    p.add_argument("--stage-deadline", type=float, default=None,
+                   metavar="SECONDS",
+                   help="per-attempt wall-clock cap enforced by a watchdog "
+                        "thread: a hung device call is logged-and-"
+                        "abandoned, then retried (default: no watchdog)")
+    p.add_argument("--min-source-batch", type=int, default=8,
+                   help="floor of the OOM degradation schedule (the "
+                        "fan-out batch is halved on RESOURCE_EXHAUSTED "
+                        "down to this size, then the OOM propagates)")
     p.add_argument("--predecessors", action="store_true",
                    help="also compute shortest-path trees (saved to --output)")
     p.add_argument("--pred-extraction", default="auto",
@@ -127,6 +139,9 @@ def _config(args) -> "SolverConfig":
         pred_extraction=tristate[args.pred_extraction],
         checkpoint_dir=args.checkpoint_dir,
         validate=args.validate,
+        retry_attempts=args.retry_attempts,
+        stage_deadline_s=args.stage_deadline,
+        min_source_batch=args.min_source_batch,
     )
 
 
@@ -160,6 +175,25 @@ def _report(res, args) -> None:
             print(f"  {phase:>14s}: {secs * 1e3:9.2f} ms")
         print(f"  edges relaxed: {res.stats.edges_relaxed:,} "
               f"({res.stats.edges_relaxed_per_second():,.0f}/s)")
+        # Resilience summary — only when a recovery path actually fired
+        # (a clean solve stays clean; a degraded one must say so).
+        s = res.stats
+        if s.retries or s.oom_degradations or s.abandoned_stages:
+            parts = []
+            if s.retries:
+                parts.append(f"{s.retries} retries")
+            if s.oom_degradations:
+                parts.append(
+                    f"{s.oom_degradations} OOM degradations "
+                    f"(final batch {s.final_batch})"
+                )
+            if s.abandoned_stages:
+                parts.append(
+                    f"abandoned: {', '.join(s.abandoned_stages)}"
+                )
+            print(f"  resilience: {'; '.join(parts)}")
+        if s.batches_resumed:
+            print(f"  batches resumed from checkpoint: {s.batches_resumed}")
         if args.output:
             print(f"  wrote {args.output}")
 
@@ -224,6 +258,8 @@ def main(argv: list[str] | None = None) -> int:
     from paralleljohnson_tpu import (
         NegativeCycleError,
         ParallelJohnsonSolver,
+        SolveCorruptionError,
+        StageAbandonedError,
         available_backends,
         load_graph,
     )
@@ -244,11 +280,29 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "info":
         import jax
 
+        from paralleljohnson_tpu.config import SolverConfig as _SC
+
+        _dc = _SC()
         info = {
             "backends": available_backends(),
             "loaders": available_loaders(),
             "devices": [str(d) for d in jax.devices()],
             "default_backend_platform": jax.default_backend(),
+            # The failure-handling defaults every solve runs under
+            # (README "Failure handling"; solve/sssp report the
+            # per-solve retries/oom_degradations/final_batch/
+            # abandoned_stages counters in their stats output).
+            "resilience": {
+                "retry_attempts": _dc.retry_attempts,
+                "retry_backoff_s": _dc.retry_backoff_s,
+                "stage_deadline_s": _dc.stage_deadline_s,
+                "min_source_batch": _dc.min_source_batch,
+                "oom_degradation": (
+                    "on RESOURCE_EXHAUSTED: clear_caches, halve the "
+                    "source batch (floor min_source_batch), resume from "
+                    "the failed batch"
+                ),
+            },
         }
         if args.graph is not None:
             # Per-graph route diagnosis: the SAME predicates dispatch
@@ -372,6 +426,12 @@ def main(argv: list[str] | None = None) -> int:
     except NegativeCycleError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    except (SolveCorruptionError, StageAbandonedError) as e:
+        # Resilience-layer terminal failures: corruption the sanity
+        # guard caught, or a stage the watchdog abandoned on every
+        # attempt — diagnosable message, distinct exit code.
+        print(f"error: {e}", file=sys.stderr)
+        return 3
     except (ValueError, FileNotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

@@ -138,6 +138,22 @@ class SolverConfig:
       checkpoint_dir: if set, per-source-batch distance rows are saved here
         and resumed after preemption (SURVEY.md §5 checkpoint/resume).
       validate: cross-check results against the scipy oracle (slow; tests).
+      retry_attempts: max attempts per solve stage before the failure
+        propagates (``utils.resilience.RetryPolicy``); 1 disables
+        retries. OOM batch degradations do NOT consume these — each
+        degraded size gets fresh attempts (the resource changed).
+      retry_backoff_s: base backoff before the 2nd attempt of a stage
+        (exponential x2 per further attempt, deterministic jitter).
+      stage_deadline_s: per-attempt wall-clock cap, enforced by a
+        watchdog thread that logs-and-abandons a hung device call (the
+        wedged-tunnel mitigation, ROADMAP item 1); None = no watchdog.
+      min_source_batch: floor of the OOM degradation schedule — the
+        fan-out batch is halved on RESOURCE_EXHAUSTED down to this size,
+        then the OOM propagates (``utils.resilience.OOMDegrader``).
+      fault_plan: a ``utils.faults.FaultPlan`` (or None) injecting
+        deterministic failures into solve stages — the harness tier-1
+        CPU tests use to exercise every retry/degrade/resume path
+        without a TPU. Production solves leave it None.
     """
 
     backend: str = "jax"
@@ -163,6 +179,11 @@ class SolverConfig:
     edge_shard: bool | str = "auto"
     checkpoint_dir: str | None = None
     validate: bool = False
+    retry_attempts: int = 3
+    retry_backoff_s: float = 0.05
+    stage_deadline_s: float | None = None
+    min_source_batch: int = 8
+    fault_plan: object | None = None
 
     @property
     def np_dtype(self):
@@ -236,3 +257,31 @@ class SolverConfig:
             raise ValueError(
                 f"edge_shard must be True/False/'auto', got {self.edge_shard!r}"
             )
+        if self.retry_attempts < 1:
+            raise ValueError(
+                f"retry_attempts must be >= 1, got {self.retry_attempts}"
+            )
+        if self.retry_backoff_s < 0:
+            raise ValueError(
+                f"retry_backoff_s must be >= 0, got {self.retry_backoff_s}"
+            )
+        if self.stage_deadline_s is not None and not self.stage_deadline_s > 0:
+            raise ValueError(
+                "stage_deadline_s must be > 0 (or None), "
+                f"got {self.stage_deadline_s}"
+            )
+        if self.min_source_batch < 1:
+            raise ValueError(
+                f"min_source_batch must be >= 1, got {self.min_source_batch}"
+            )
+
+    def retry_policy(self):
+        """The :class:`~paralleljohnson_tpu.utils.resilience.RetryPolicy`
+        these knobs describe (one construction point for solver/backend)."""
+        from paralleljohnson_tpu.utils.resilience import RetryPolicy
+
+        return RetryPolicy(
+            max_attempts=self.retry_attempts,
+            backoff_s=self.retry_backoff_s,
+            deadline_s=self.stage_deadline_s,
+        )

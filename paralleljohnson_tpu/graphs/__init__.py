@@ -9,7 +9,12 @@ from paralleljohnson_tpu.graphs.generators import (
     random_graph_batch,
     rmat,
 )
-from paralleljohnson_tpu.graphs.loaders import load_dimacs, load_snap, save_dimacs
+from paralleljohnson_tpu.graphs.loaders import (
+    GraphFormatError,
+    load_dimacs,
+    load_snap,
+    save_dimacs,
+)
 from paralleljohnson_tpu.graphs.registry import (
     available_loaders,
     load_graph,
@@ -18,6 +23,7 @@ from paralleljohnson_tpu.graphs.registry import (
 
 __all__ = [
     "CSRGraph",
+    "GraphFormatError",
     "PAD_WEIGHT",
     "available_loaders",
     "erdos_renyi",

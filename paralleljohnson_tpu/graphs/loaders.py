@@ -16,6 +16,20 @@ import numpy as np
 from paralleljohnson_tpu.graphs.csr import CSRGraph
 
 
+class GraphFormatError(ValueError):
+    """Malformed graph-file input (truncated record, out-of-range vertex
+    id, non-numeric weight, missing problem line). Always names the file
+    and 1-based line number, so a bad byte in a multi-GB road-graph file
+    is diagnosable from the message — instead of an index crash deep in
+    the CSR build minutes later."""
+
+
+def _format_error(path, lineno, what, line=None) -> GraphFormatError:
+    loc = f"{path}:{lineno}" if lineno else f"{path}"
+    detail = f" in {line!r}" if line is not None else ""
+    return GraphFormatError(f"{loc}: {what}{detail}")
+
+
 def _open_text(path: str | Path):
     path = Path(path)
     if path.suffix == ".gz":
@@ -32,6 +46,10 @@ def load_dimacs(path: str | Path, *, dtype=np.float32) -> CSRGraph:
       - ``a <u> <v> <w>``      — directed arc u->v, 1-indexed, w may be
                                  negative (the DIMACS-NY negative-weight
                                  config is attested, BASELINE.json:8)
+
+    Malformed input (truncated arc line, out-of-range vertex id,
+    non-numeric weight, arcs before/without the problem line) raises
+    :class:`GraphFormatError` naming file + line number.
     """
     num_nodes = None
     srcs: list[int] = []
@@ -45,18 +63,53 @@ def load_dimacs(path: str | Path, *, dtype=np.float32) -> CSRGraph:
             parts = line.split()
             if parts[0] == "p":
                 if len(parts) != 4 or parts[1] != "sp":
-                    raise ValueError(f"{path}:{lineno}: bad problem line {line!r}")
-                num_nodes = int(parts[2])
+                    raise _format_error(path, lineno, "bad problem line", line)
+                try:
+                    num_nodes = int(parts[2])
+                except ValueError:
+                    raise _format_error(
+                        path, lineno, "non-numeric node count", line
+                    ) from None
+                if num_nodes < 0:
+                    raise _format_error(
+                        path, lineno, "negative node count", line
+                    )
             elif parts[0] == "a":
                 if len(parts) != 4:
-                    raise ValueError(f"{path}:{lineno}: bad arc line {line!r}")
-                srcs.append(int(parts[1]) - 1)
-                dsts.append(int(parts[2]) - 1)
-                wts.append(float(parts[3]))
+                    raise _format_error(
+                        path, lineno, "truncated arc line", line
+                    )
+                if num_nodes is None:
+                    raise _format_error(
+                        path, lineno, "arc before 'p sp' problem line", line
+                    )
+                try:
+                    u = int(parts[1]) - 1
+                    v = int(parts[2]) - 1
+                except ValueError:
+                    raise _format_error(
+                        path, lineno, "non-numeric vertex id", line
+                    ) from None
+                try:
+                    w = float(parts[3])
+                except ValueError:
+                    raise _format_error(
+                        path, lineno, "non-numeric weight", line
+                    ) from None
+                if not (0 <= u < num_nodes and 0 <= v < num_nodes):
+                    raise _format_error(
+                        path, lineno,
+                        f"vertex id out of range 1..{num_nodes}", line,
+                    )
+                srcs.append(u)
+                dsts.append(v)
+                wts.append(w)
             else:
-                raise ValueError(f"{path}:{lineno}: unknown record {parts[0]!r}")
+                raise _format_error(
+                    path, lineno, f"unknown record {parts[0]!r}", line
+                )
     if num_nodes is None:
-        raise ValueError(f"{path}: missing 'p sp' problem line")
+        raise _format_error(path, None, "missing 'p sp' problem line")
     return CSRGraph.from_edges(srcs, dsts, wts, num_nodes, dtype=dtype)
 
 
@@ -85,10 +138,27 @@ def load_snap(
                 continue
             parts = line.split()
             if len(parts) < 2:
-                raise ValueError(f"{path}:{lineno}: bad edge line {line!r}")
-            srcs.append(int(parts[0]))
-            dsts.append(int(parts[1]))
-            wts.append(float(parts[2]) if len(parts) > 2 else default_weight)
+                raise _format_error(path, lineno, "truncated edge line", line)
+            try:
+                u = int(parts[0])
+                v = int(parts[1])
+            except ValueError:
+                raise _format_error(
+                    path, lineno, "non-numeric vertex id", line
+                ) from None
+            if u < 0 or v < 0:
+                raise _format_error(
+                    path, lineno, "negative vertex id", line
+                )
+            try:
+                w = float(parts[2]) if len(parts) > 2 else default_weight
+            except ValueError:
+                raise _format_error(
+                    path, lineno, "non-numeric weight", line
+                ) from None
+            srcs.append(u)
+            dsts.append(v)
+            wts.append(w)
     src = np.asarray(srcs, np.int64)
     dst = np.asarray(dsts, np.int64)
     w = np.asarray(wts, dtype)

@@ -76,6 +76,17 @@ def make_mesh(
     return Mesh(devices, axis_names=(axis_name,))
 
 
+def _fire_fault_hook(fault_hook) -> None:
+    """Run the caller's fault-injection hook (``utils.faults`` via
+    ``JaxBackend._shard_fault_hook``) at the top of a sharded entry
+    point — inside the sharded path, so an injected collective/tunnel
+    failure propagates through the same except blocks a real one would
+    (the sharded→single-device fallback). No-op when None (production
+    solves carry no plan)."""
+    if fault_hook is not None:
+        fault_hook()
+
+
 def _pad_sources(sources, n: int):
     """Pad a source batch to a multiple of ``n`` mesh shards, duplicating
     ``sources[0]``: padding rows participate in the pmax'd still-improving
@@ -246,12 +257,14 @@ def edge_sharded_bellman_ford(
     *,
     max_iter: int,
     edge_chunk: int = 1 << 20,
+    fault_hook=None,
 ):
     """Bellman-Ford with the EDGE LIST sharded across ``mesh`` (axis name
     "edges" — pass a mesh from :func:`make_edge_mesh`). ``dist0`` is
     replicated ([V] or [B, V]); edges are padded to a mesh multiple with
     (0, 0, +inf) no-ops. Returns (dist, iterations, still_improving).
     """
+    _fire_fault_hook(fault_hook)
     n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     e = src.shape[0]
     pad = (-e) % n
@@ -318,6 +331,7 @@ def sharded_gs_fanout(
     max_outer: int,
     inner_cap: int,
     real_edges_host: np.ndarray,
+    fault_hook=None,
 ):
     """N-source blocked-GS fan-out with sources sharded over ``mesh``
     (1-D "sources" axis). Pads the batch to a mesh multiple (duplicating
@@ -326,6 +340,7 @@ def sharded_gs_fanout(
     Returns (dist[B, V], rounds, still_improving, examined) —
     ``examined`` the exact Python-int candidate count: per shard,
     sum(iters_blk x real edges) x that shard's REAL row count."""
+    _fire_fault_hook(fault_hook)
     n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     sources = jnp.asarray(sources, jnp.int32)
     b = sources.shape[0]
@@ -395,6 +410,7 @@ def sharded_dia_fanout(
     offsets: tuple,
     max_iter: int,
     num_entries: int,
+    fault_hook=None,
 ):
     """N-source DIA fan-out with sources sharded over ``mesh`` (1-D
     "sources" axis). Pads the batch to a mesh multiple (duplicating
@@ -403,6 +419,7 @@ def sharded_dia_fanout(
     Returns (dist[B, V], iterations, still_improving, examined) —
     ``examined`` the exact Python-int candidate count: per shard,
     sweeps x stored diagonal entries x that shard's REAL row count."""
+    _fire_fault_hook(fault_hook)
     n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     sources = jnp.asarray(sources, jnp.int32)
     b = sources.shape[0]
@@ -567,6 +584,7 @@ def sharded_fanout_2d(
     edge_chunk: int = 1 << 20,
     layout: str = "source_major",
     with_row_sweeps: bool = False,
+    fault_hook=None,
 ):
     """N-source fan-out with sources AND edges sharded over a 2-D mesh
     (from :func:`make_mesh_2d`). Pads sources to a multiple of the
@@ -581,6 +599,7 @@ def sharded_fanout_2d(
     undefined behavior, so the pad must preserve monotone dst.
 
     Returns (dist[B, V], iterations, still_improving[, row_sweeps])."""
+    _fire_fault_hook(fault_hook)
     ns = mesh.shape["sources"]
     ne = mesh.shape["edges"]
     sources = jnp.asarray(sources, jnp.int32)
@@ -624,6 +643,7 @@ def sharded_fanout(
     layout: str = "source_major",
     with_row_sweeps: bool = False,
     n_real_rows: int | None = None,
+    fault_hook=None,
 ):
     """N-source fan-out with sources sharded over ``mesh``.
 
@@ -648,6 +668,7 @@ def sharded_fanout(
     """
     if with_pred and layout == "vertex_major":
         raise ValueError("with_pred requires the source_major layout")
+    _fire_fault_hook(fault_hook)
     n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     sources = jnp.asarray(sources, jnp.int32)
     b = sources.shape[0]

@@ -25,8 +25,17 @@ import numpy as np
 from paralleljohnson_tpu.backends import Backend, get_backend
 from paralleljohnson_tpu.config import SolverConfig
 from paralleljohnson_tpu.graphs import CSRGraph, stack_graphs
+from paralleljohnson_tpu.utils import resilience
 from paralleljohnson_tpu.utils.metrics import SolverStats, phase_timer
 from paralleljohnson_tpu.utils.reductions import finite_checksum, xp as _xp
+
+
+def _transient_error(e: BaseException) -> bool:
+    """Worth a plain (same-resource) retry: injected/real device runtime
+    failures. Deterministic solver errors (NegativeCycleError,
+    ConvergenceError, ValueError, SolveCorruptionError) are excluded —
+    re-running them reproduces them."""
+    return type(e).__name__ in ("XlaRuntimeError", "InjectedFaultError")
 
 
 class NegativeCycleError(ValueError):
@@ -260,14 +269,12 @@ class ParallelJohnsonSolver:
         h, dgraph = self._potentials(graph, dgraph, stats)
         values = []
         with phase_timer(stats, "fanout"):
-            batches = self._source_batches(sources, dgraph)
-            for batch in batches:
-                res = self.backend.multi_source(dgraph, batch)
-                stats.accumulate(res, phase="fanout")
-                if not res.converged:
-                    raise ConvergenceError(
-                        "fan-out hit max_iterations while still improving"
-                    )
+            # Same resilience driver as solve(): retry/watchdog per batch,
+            # OOM -> halve-and-resume (streaming mode has no checkpoint —
+            # reduced values accumulate host-side as batches complete).
+            for _, batch, res, _ in self._resilient_batches(
+                dgraph, sources, stats
+            ):
                 rows = res.dist
                 if graph.has_negative_weights:
                     rows = _unreweight(rows, h, batch)
@@ -276,7 +283,7 @@ class ParallelJohnsonSolver:
                 # scale the layout caches must not still be resident
                 # when it does (the s22 crash mitigation).
                 if (
-                    len(batches) > 1
+                    len(batch) < len(sources)
                     and int(getattr(rows, "nbytes", 0) or 0)
                     >= _DOWNLOAD_CLEAR_MIN_BYTES
                 ):
@@ -295,11 +302,9 @@ class ParallelJohnsonSolver:
         with phase_timer(stats, "upload"):
             dgraph = self.backend.upload(graph)
         with phase_timer(stats, "bellman_ford"):
-            if predecessors:
-                bf = self.backend.bellman_ford_pred(dgraph, source=int(source))
-            else:
-                bf = self.backend.bellman_ford(dgraph, source=int(source))
-        stats.accumulate(bf, phase="bellman_ford")
+            bf = self._run_bf(
+                dgraph, stats, source=int(source), pred=predecessors
+            )
         if bf.negative_cycle:
             raise NegativeCycleError("negative-weight cycle reachable from source")
         if not bf.converged:
@@ -350,7 +355,14 @@ class ParallelJohnsonSolver:
         try:
             with phase_timer(stats, "batch_apsp"):
                 batch = stack_graphs(graphs)
-                res = self.backend.batch_apsp(batch)
+                res = resilience.run_stage(
+                    lambda: self.backend.batch_apsp(batch),
+                    stage="batch_apsp",
+                    policy=self.config.retry_policy(),
+                    stats=stats,
+                    faults=self.config.fault_plan,
+                    retryable=_transient_error,
+                )
         except NotImplementedError:
             return [self.solve(g) for g in graphs]
         stats.accumulate(res, phase="batch_apsp")
@@ -372,6 +384,49 @@ class ParallelJohnsonSolver:
 
     # -- internals ----------------------------------------------------------
 
+    def _run_bf(
+        self, dgraph: Any, stats: SolverStats, *,
+        source: int | None, pred: bool = False,
+    ):
+        """One Bellman-Ford stage through the resilience layer: bounded
+        retries with watchdog deadline; a B=1 sweep has no batch to
+        shrink, so an OOM frees the rebuildable device caches and retries
+        with the memory they held. Converged non-cycle distances pass the
+        sanity guard before anyone consumes them as potentials/results."""
+
+        def kernel():
+            if pred:
+                return self.backend.bellman_ford_pred(dgraph, source=source)
+            return self.backend.bellman_ford(dgraph, source=source)
+
+        def retryable(e):
+            if resilience.is_oom_error(e):
+                try:
+                    self.backend.clear_caches(dgraph)
+                except Exception:  # noqa: BLE001 — hygiene only
+                    pass
+                return True
+            return _transient_error(e)
+
+        faults = self.config.fault_plan
+        bf = resilience.run_stage(
+            kernel,
+            stage="bellman_ford",
+            policy=self.config.retry_policy(),
+            stats=stats,
+            faults=faults,
+            retryable=retryable,
+        )
+        stats.accumulate(bf, phase="bellman_ford")
+        if faults is not None:
+            bf.dist = faults.poison_rows("bellman_ford", bf.dist)
+        if bf.converged and not bf.negative_cycle:
+            resilience.check_rows_sane(
+                bf.dist, None, route=bf.route,
+                iteration=bf.iterations, stage="bellman_ford",
+            )
+        return bf
+
     def _potentials(self, graph: CSRGraph, dgraph: Any, stats: SolverStats):
         """Phase 1 + reweight: returns (h, reweighted dgraph). h stays on
         the backend's device (a [V] row is 16 MB at RMAT-22); phase-3
@@ -380,8 +435,7 @@ class ParallelJohnsonSolver:
         if not graph.has_negative_weights:
             return np.zeros(graph.num_nodes, graph.dtype), dgraph
         with phase_timer(stats, "bellman_ford"):
-            bf = self.backend.bellman_ford(dgraph, source=None)
-        stats.accumulate(bf, phase="bellman_ford")
+            bf = self._run_bf(dgraph, stats, source=None)
         if bf.negative_cycle:
             raise NegativeCycleError(
                 "negative-weight cycle detected during reweighting"
@@ -396,26 +450,123 @@ class ParallelJohnsonSolver:
             dgraph = self.backend.reweight(dgraph, h)
         return h, dgraph
 
-    def _source_batches(
+    def _initial_batch_size(
         self, sources: np.ndarray, dgraph: Any = None, *,
         with_pred: bool = False,
-    ) -> list[np.ndarray]:
+    ) -> int:
+        """Starting fan-out batch size: the explicit config value, else
+        the backend's fits-memory heuristic (config.source_batch_size
+        docstring): the backend sizes the [B, V] block to its device
+        budget so e.g. RMAT-20 full APSP cannot OOM by default. A pred
+        solve passes with_pred so the extra int32 [B, V] pred block is
+        budgeted too (plain calls keep the positional-only signature
+        third-party backends already implement). The OOM degrader may
+        shrink it mid-solve (``_resilient_batches``)."""
         bs = self.config.source_batch_size
         if bs is None and dgraph is not None:
-            # The promised fits-memory heuristic (config.source_batch_size
-            # docstring): the backend sizes the [B, V] block to its device
-            # budget so e.g. RMAT-20 full APSP cannot OOM by default. A
-            # pred solve passes with_pred so the extra int32 [B, V] pred
-            # block is budgeted too (plain calls keep the positional-only
-            # signature third-party backends already implement).
             if with_pred:
                 bs = self.backend.suggested_source_batch(
                     dgraph, with_pred=True
                 )
             else:
                 bs = self.backend.suggested_source_batch(dgraph)
-        bs = bs or len(sources) or 1
+        return int(bs or len(sources) or 1)
+
+    def _source_batches(
+        self, sources: np.ndarray, dgraph: Any = None, *,
+        with_pred: bool = False,
+    ) -> list[np.ndarray]:
+        bs = self._initial_batch_size(sources, dgraph, with_pred=with_pred)
         return [sources[i : i + bs] for i in range(0, len(sources), bs)]
+
+    def _resilient_batches(
+        self,
+        dgraph: Any,
+        sources: np.ndarray,
+        stats: SolverStats,
+        *,
+        with_pred: bool = False,
+        try_resume=None,
+    ):
+        """Drive the fan-out batch loop through the resilience layer.
+
+        Yields ``(batch_idx, batch, payload, resumed)`` per completed
+        batch — ``payload`` is the checkpointer's cached ``(rows, pred)``
+        when ``resumed``, else the backend's KernelResult. Per batch:
+
+        - retry + per-attempt watchdog per ``config.retry_policy()``
+          (a hung device call is logged-and-abandoned, then retried);
+        - on device OOM: checkpoint state is already safe (completed
+          batches were saved as they finished), the degrader clears the
+          backend caches and HALVES the batch (floor
+          ``config.min_source_batch``, re-consulting
+          ``suggested_source_batch``), and the failed source range is
+          re-split and resumed — the batch is the unit of recovery;
+        - converged rows pass the distance-sanity guard BEFORE anyone
+          can checkpoint or consume them;
+        - deterministic faults (``config.fault_plan``) are injected per
+          attempt, so tier-1 CPU tests exercise all of the above.
+        """
+        policy = self.config.retry_policy()
+        faults = self.config.fault_plan
+        degrader = resilience.OOMDegrader(
+            self.backend,
+            dgraph,
+            self._initial_batch_size(sources, dgraph, with_pred=with_pred),
+            min_batch=self.config.min_source_batch,
+            with_pred=with_pred,
+        )
+        n = len(sources)
+        pos = 0
+        batch_idx = 0
+        while pos < n:
+            batch = sources[pos : pos + degrader.batch_size]
+            if try_resume is not None:
+                cached = try_resume(batch_idx, batch)
+                if cached is not None:
+                    stats.batches_resumed += 1
+                    yield batch_idx, batch, cached, True
+                    pos += len(batch)
+                    batch_idx += 1
+                    continue
+
+            def kernel(b=batch):
+                if with_pred:
+                    return self.backend.multi_source_pred(dgraph, b)
+                return self.backend.multi_source(dgraph, b)
+
+            try:
+                res = resilience.run_stage(
+                    kernel,
+                    stage="fanout",
+                    policy=policy,
+                    stats=stats,
+                    faults=faults,
+                    batch=batch_idx,
+                    retryable=_transient_error,
+                )
+            except Exception as e:
+                if resilience.is_oom_error(e):
+                    degrader.degrade(e)  # re-raises at the floor
+                    stats.oom_degradations += 1
+                    continue  # re-split THIS range smaller; pos unchanged
+                raise
+            stats.accumulate(res, phase="fanout")
+            if not res.converged:
+                raise ConvergenceError(
+                    "fan-out hit max_iterations while still improving"
+                )
+            if faults is not None:
+                res.dist = faults.poison_rows(
+                    "fanout", res.dist, batch=batch_idx
+                )
+            resilience.check_rows_sane(
+                res.dist, batch, route=res.route, iteration=res.iterations
+            )
+            yield batch_idx, batch, res, False
+            pos += len(batch)
+            batch_idx += 1
+        stats.final_batch = degrader.batch_size
 
     def _download_rows(self, dgraph: Any, rows, pred=None):
         """Materialize one batch's device rows on the host, clearing the
@@ -443,7 +594,11 @@ class ParallelJohnsonSolver:
         """Run phase 2 in source batches; optionally checkpoint each batch
         (SURVEY.md §5 — the batch is the unit of recovery). Checkpoints are
         keyed by graph content so a different/modified graph never resumes
-        stale rows. Returns (dist rows, predecessor rows or None)."""
+        stale rows. The loop runs through the resilience layer
+        (``_resilient_batches``): a batch that OOMs is re-split smaller
+        and resumed — everything already completed is safe on disk when
+        checkpointing is on. Returns (dist rows, predecessor rows or
+        None)."""
         from paralleljohnson_tpu.utils.checkpoint import BatchCheckpointer
 
         ckpt = None
@@ -452,40 +607,32 @@ class ParallelJohnsonSolver:
             ckpt = BatchCheckpointer(
                 self.config.checkpoint_dir, graph_key=graph
             )
-        batches = self._source_batches(sources, dgraph, with_pred=with_pred)
+        try_resume = None
+        if ckpt is not None:
+            def try_resume(batch_idx, batch):
+                return ckpt.load(batch_idx, batch, with_pred=with_pred)
         rows: list[np.ndarray] = []
         preds: list[np.ndarray] = []
-        for batch_idx, batch in enumerate(batches):
-            if ckpt is not None:
-                cached = ckpt.load(batch_idx, batch, with_pred=with_pred)
-                if cached is not None:
-                    row, pred = cached
-                    rows.append(row)
-                    if with_pred:
-                        preds.append(pred)
-                    stats.batches_resumed += 1
-                    continue
-            if with_pred:
-                res = self.backend.multi_source_pred(dgraph, batch)
+        for batch_idx, batch, payload, resumed in self._resilient_batches(
+            dgraph, sources, stats, with_pred=with_pred,
+            try_resume=try_resume,
+        ):
+            if resumed:
+                row, pred = payload
             else:
-                res = self.backend.multi_source(dgraph, batch)
-            stats.accumulate(res, phase="fanout")
-            if not res.converged:
-                raise ConvergenceError(
-                    "fan-out hit max_iterations while still improving"
-                )
-            # A SINGLE-batch solve keeps device-backend rows resident on
-            # device (at RMAT-22 scale rows must never be forced to host
-            # wholesale). Multi-batch solves STREAM each batch to host:
-            # the batching exists because all rows together exceed the
-            # device budget (suggested_source_batch), so accumulating
-            # device buffers across batches would defeat it. Checkpointing
-            # (host .npz) forces the download either way.
-            row, pred = res.dist, res.pred
-            if ckpt is not None or len(batches) > 1:
-                row, pred = self._download_rows(dgraph, row, pred)
-                if ckpt is not None:
-                    ckpt.save(batch_idx, batch, row, pred=pred)
+                # A SINGLE-batch solve keeps device-backend rows resident
+                # on device (at RMAT-22 scale rows must never be forced to
+                # host wholesale). Multi-batch solves STREAM each batch to
+                # host: the batching exists because all rows together
+                # exceed the device budget (suggested_source_batch), so
+                # accumulating device buffers across batches would defeat
+                # it. Checkpointing (host .npz) forces the download either
+                # way.
+                row, pred = payload.dist, payload.pred
+                if ckpt is not None or len(batch) < len(sources):
+                    row, pred = self._download_rows(dgraph, row, pred)
+                    if ckpt is not None:
+                        ckpt.save(batch_idx, batch, row, pred=pred)
             rows.append(row)
             if with_pred:
                 preds.append(pred)

@@ -44,6 +44,14 @@ class SolverStats:
     edges_relaxed: total edge relaxations across phases.
     edges_relaxed_by_phase / iterations_by_phase: breakdowns.
     batches_resumed: source batches skipped via checkpoint resume.
+    retries: stage attempts re-run after a transient failure (watchdog
+      abandon or retryable device error — utils.resilience.run_stage).
+    oom_degradations: times the fan-out batch was halved after a device
+      OOM (utils.resilience.OOMDegrader).
+    final_batch: the source-batch size the fan-out ENDED at (None until
+      a fan-out runs; equals the starting size when nothing degraded).
+    abandoned_stages: "<stage>[#b<batch>]@a<attempt>" tags of every
+      attempt the watchdog logged-and-abandoned past its deadline.
     """
 
     phase_seconds: dict = dataclasses.field(
@@ -58,6 +66,10 @@ class SolverStats:
     )
     routes_by_phase: dict = dataclasses.field(default_factory=dict)
     batches_resumed: int = 0
+    retries: int = 0
+    oom_degradations: int = 0
+    final_batch: int | None = None
+    abandoned_stages: list = dataclasses.field(default_factory=list)
 
     def accumulate(self, result, phase: str) -> None:
         """Fold one KernelResult into the totals."""
@@ -97,6 +109,10 @@ class SolverStats:
             "iterations_by_phase": dict(self.iterations_by_phase),
             "routes_by_phase": dict(self.routes_by_phase),
             "batches_resumed": self.batches_resumed,
+            "retries": self.retries,
+            "oom_degradations": self.oom_degradations,
+            "final_batch": self.final_batch,
+            "abandoned_stages": list(self.abandoned_stages),
             "total_seconds": self.total_seconds,
             "edges_relaxed_per_sec": self.edges_relaxed_per_second(),
         }

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Tier-1 wall-clock budget guard (ROADMAP open item: keep the suite
+under ~150 s as the routing matrix grows).
+
+Reads a pytest log (default /tmp/_t1.log — the tee target of the tier-1
+command), extracts the wall-clock from pytest's summary line
+(``... passed, ... in 132.45s (0:02:12)``), and exits nonzero when it
+exceeds the budget (default 150 s, override with PJ_SUITE_BUDGET_S or
+--budget). Run at the end of the tier-1 command:
+
+    pytest tests/ -q -m 'not slow' ... | tee /tmp/_t1.log \
+      && python scripts/check_suite_budget.py /tmp/_t1.log
+
+A missing log or a log without a summary line is an error too — a guard
+that silently passes when its input vanished is not a guard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from pathlib import Path
+
+SUMMARY_RE = re.compile(
+    r"\b(?:passed|failed|error|errors|skipped|deselected|no tests ran)\b"
+    r".*\bin (\d+(?:\.\d+)?)s\b"
+)
+
+
+def suite_seconds(text: str) -> float | None:
+    """Wall-clock of the LAST pytest summary line in ``text`` (reruns
+    append; the final run is the one being graded)."""
+    secs = None
+    for line in text.splitlines():
+        m = SUMMARY_RE.search(line)
+        if m:
+            secs = float(m.group(1))
+    return secs
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("log", nargs="?", default="/tmp/_t1.log",
+                    help="pytest log file (tee'd tier-1 output)")
+    ap.add_argument("--budget", type=float,
+                    default=float(os.environ.get("PJ_SUITE_BUDGET_S", 150)),
+                    help="max allowed suite wall-clock in seconds")
+    args = ap.parse_args(argv)
+
+    path = Path(args.log)
+    if not path.exists():
+        print(f"suite-budget: log {path} not found", file=sys.stderr)
+        return 2
+    secs = suite_seconds(path.read_text(errors="replace"))
+    if secs is None:
+        print(
+            f"suite-budget: no pytest summary line in {path}",
+            file=sys.stderr,
+        )
+        return 2
+    if secs > args.budget:
+        print(
+            f"suite-budget: FAIL — suite took {secs:.1f}s "
+            f"(budget {args.budget:.0f}s). Trim with hypothesis caps / "
+            "'slow' marks before landing (ROADMAP suite-budget item).",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"suite-budget: OK — {secs:.1f}s <= {args.budget:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

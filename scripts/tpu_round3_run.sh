@@ -12,16 +12,23 @@ LOG=${1:-/tmp/tpu_round3_run.log}
 
 FAILED_STAGES=""
 run() {  # run <seconds> <label> <cmd...>
-  local t=$1 label=$2 rc; shift 2
-  echo "=== $label ===" | tee -a "$LOG"
-  timeout --signal=TERM --kill-after=30 "$t" "$@" 2>&1 | grep -v WARNING | tail -8 | tee -a "$LOG"
-  rc=${PIPESTATUS[0]}
-  echo "--- rc=$rc ---" | tee -a "$LOG"
-  [ "$rc" -ne 0 ] && FAILED_STAGES="$FAILED_STAGES $label"
-  # Evidence survives a session cut mid-pass: stage log + BASELINE.md
-  # rows land in the repo after EVERY stage, not only at the end.
-  mkdir -p bench_artifacts
-  cp "$LOG" "bench_artifacts/tpu_round5_pass.log" 2>/dev/null || true
+  # Each stage gets up to 3 attempts with 30s/60s backoff: a nonzero
+  # exit is usually the tunnel dropping mid-stage, and the window is
+  # too precious to lose a whole stage to one hiccup (ROADMAP item 1).
+  local t=$1 label=$2 rc attempt; shift 2
+  for attempt in 1 2 3; do
+    echo "=== $label (attempt $attempt) ===" | tee -a "$LOG"
+    timeout --signal=TERM --kill-after=30 "$t" "$@" 2>&1 | grep -v WARNING | tail -8 | tee -a "$LOG"
+    rc=${PIPESTATUS[0]}
+    echo "--- rc=$rc ---" | tee -a "$LOG"
+    # Evidence survives a session cut mid-pass: stage log + BASELINE.md
+    # rows land in the repo after EVERY attempt, not only at the end.
+    mkdir -p bench_artifacts
+    cp "$LOG" "bench_artifacts/tpu_round5_pass.log" 2>/dev/null || true
+    [ "$rc" -eq 0 ] && return 0
+    [ "$attempt" -lt 3 ] && sleep $((30 * attempt))
+  done
+  FAILED_STAGES="$FAILED_STAGES $label"
   return "$rc"
 }
 

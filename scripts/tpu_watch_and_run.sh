@@ -1,10 +1,14 @@
 #!/bin/bash
 # Probe the TPU tunnel periodically; the moment it is healthy, run the
-# staged measurement pass (scripts/tpu_round3_run.sh) to completion.
-# The stage list includes the round-7 pred-route micro + bench row
-# (tight-edge extraction vs the legacy argmin sweep) and the one
-# outstanding compiled pallas_sweep measurement, so both land
-# automatically in the first healthy tunnel window.
+# staged measurement pass (scripts/tpu_round3_run.sh). The pass itself
+# retries each stage up to 3x with backoff (see run() there); this
+# watcher additionally retries the WHOLE pass up to 3x with backoff when
+# it exits nonzero (a dropped tunnel mid-pass), and ALWAYS copies the
+# partial stage log into bench_artifacts/ — a window that dies halfway
+# must still leave every row it captured (ROADMAP item 1: every round so
+# far lost its on-chip evidence to exactly this).
+# The stage list includes the round-7 pred-route micro + bench row and
+# the one outstanding compiled pallas_sweep measurement.
 # Single-tenant discipline: only this watcher dials the device while it
 # runs; everything else in the session must force CPU
 # (paralleljohnson_tpu.utils.platform.honor_cpu_platform_request).
@@ -15,15 +19,29 @@ LOG=${1:-/tmp/tpu_watch.log}
 PASS_LOG=${2:-/tmp/tpu_round3_run.log}
 : > "$LOG"
 echo "watcher start $(date -u +%H:%M:%S)" | tee -a "$LOG"
+
+emit_partial() {  # the partial pass log is evidence — never lose it
+  mkdir -p bench_artifacts
+  cp "$PASS_LOG" "bench_artifacts/tpu_round5_pass.log" 2>/dev/null || true
+  cp "$LOG" "bench_artifacts/tpu_watch.log" 2>/dev/null || true
+}
+trap emit_partial EXIT
+
 while true; do
   if timeout --signal=TERM --kill-after=15 120 python -c \
       "import jax,numpy as np; assert jax.default_backend()=='tpu'; print('probe-ok', int(jax.jit(lambda x:x+1)(np.int32(1))))" \
       >> "$LOG" 2>&1; then
     echo "TUNNEL HEALTHY $(date -u +%H:%M:%S) — firing measurement pass" | tee -a "$LOG"
-    bash scripts/tpu_round3_run.sh "$PASS_LOG"
-    rc=$?
-    echo "PASS DONE rc=$rc $(date -u +%H:%M:%S)" | tee -a "$LOG"
-    exit $rc
+    for attempt in 1 2 3; do
+      bash scripts/tpu_round3_run.sh "$PASS_LOG"
+      rc=$?
+      emit_partial
+      echo "PASS ATTEMPT $attempt rc=$rc $(date -u +%H:%M:%S)" | tee -a "$LOG"
+      [ "$rc" -eq 0 ] && exit 0
+      [ "$attempt" -lt 3 ] && { echo "pass failed; backoff $((120 * attempt))s" | tee -a "$LOG"; sleep $((120 * attempt)); }
+    done
+    echo "PASS FAILED after 3 attempts (partial log preserved in bench_artifacts/)" | tee -a "$LOG"
+    exit 1
   fi
   echo "wedged $(date -u +%H:%M:%S); retry in 240s" >> "$LOG"
   sleep 240

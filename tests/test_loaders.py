@@ -170,3 +170,76 @@ def test_fixture_snap_ego():
 def test_fixture_snap_via_registry():
     g = load_graph(str(FIXTURES / "tiny_ego.txt"))
     assert g.num_nodes == 28
+
+
+# -- malformed-input diagnostics (GraphFormatError, ISSUE 3 satellite) -------
+
+
+def _write(tmp_path, text, name="bad.gr"):
+    p = tmp_path / name
+    p.write_text(text)
+    return p
+
+
+def test_dimacs_truncated_arc_line(tmp_path):
+    from paralleljohnson_tpu.graphs import GraphFormatError
+
+    p = _write(tmp_path, "p sp 3 2\na 1 2 5\na 2 3\n")
+    with pytest.raises(GraphFormatError, match=r"bad\.gr:3: truncated arc"):
+        load_dimacs(p)
+
+
+def test_dimacs_out_of_range_vertex(tmp_path):
+    from paralleljohnson_tpu.graphs import GraphFormatError
+
+    p = _write(tmp_path, "p sp 3 1\na 1 9 5\n")
+    with pytest.raises(GraphFormatError, match=r"bad\.gr:2: vertex id out of range 1\.\.3"):
+        load_dimacs(p)
+
+
+def test_dimacs_non_numeric_weight(tmp_path):
+    from paralleljohnson_tpu.graphs import GraphFormatError
+
+    p = _write(tmp_path, "p sp 2 1\na 1 2 heavy\n")
+    with pytest.raises(GraphFormatError, match=r"bad\.gr:2: non-numeric weight"):
+        load_dimacs(p)
+
+
+def test_dimacs_arc_before_problem_line(tmp_path):
+    from paralleljohnson_tpu.graphs import GraphFormatError
+
+    p = _write(tmp_path, "a 1 2 5\np sp 2 1\n")
+    with pytest.raises(GraphFormatError, match=r"bad\.gr:1: arc before"):
+        load_dimacs(p)
+
+
+def test_dimacs_missing_problem_line(tmp_path):
+    from paralleljohnson_tpu.graphs import GraphFormatError
+
+    p = _write(tmp_path, "c only comments\n")
+    with pytest.raises(GraphFormatError, match="missing 'p sp'"):
+        load_dimacs(p)
+
+
+def test_snap_truncated_and_non_numeric(tmp_path):
+    from paralleljohnson_tpu.graphs import GraphFormatError
+
+    p = _write(tmp_path, "10 20\n30\n", name="bad.txt")
+    with pytest.raises(GraphFormatError, match=r"bad\.txt:2: truncated edge"):
+        load_snap(p)
+    p2 = _write(tmp_path, "10 x\n", name="bad2.txt")
+    with pytest.raises(GraphFormatError, match=r"bad2\.txt:1: non-numeric vertex"):
+        load_snap(p2)
+    p3 = _write(tmp_path, "10 20 heavy\n", name="bad3.txt")
+    with pytest.raises(GraphFormatError, match=r"bad3\.txt:1: non-numeric weight"):
+        load_snap(p3)
+
+
+def test_graph_format_error_is_value_error(tmp_path):
+    """Callers (e.g. the CLI's except ValueError) keep working."""
+    from paralleljohnson_tpu.graphs import GraphFormatError
+
+    assert issubclass(GraphFormatError, ValueError)
+    p = _write(tmp_path, "p sp 3 1\na 1 9 5\n")
+    with pytest.raises(ValueError):
+        load_dimacs(p)

@@ -1,0 +1,448 @@
+"""Fault-tolerant solve engine tests (ISSUE 3 tentpole).
+
+Every recovery path runs on CPU via the deterministic fault-injection
+harness (``utils.faults``): OOM-adaptive batch degradation, watchdog
+abandon of hung stages, checkpoint-resume equivalence after a mid-fan-out
+crash, the sharded→single-device fallback, the distance-sanity guard,
+and the bench harness's failed-row tagging.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import (
+    Fault,
+    FaultPlan,
+    ParallelJohnsonSolver,
+    RetryPolicy,
+    SolveCorruptionError,
+    SolverConfig,
+    StageAbandonedError,
+)
+from paralleljohnson_tpu.graphs import erdos_renyi
+from paralleljohnson_tpu.utils import resilience
+from paralleljohnson_tpu.utils.faults import InjectedFaultError, InjectedOOMError
+
+
+def _solver(**kw):
+    kw.setdefault("backend", "numpy")
+    kw.setdefault("retry_backoff_s", 0.001)
+    return ParallelJohnsonSolver(SolverConfig(**kw))
+
+
+@pytest.fixture
+def graph():
+    return erdos_renyi(48, 0.1, seed=2)
+
+
+# -- RetryPolicy / classification --------------------------------------------
+
+
+def test_retry_policy_backoff_deterministic():
+    p = RetryPolicy(max_attempts=4, backoff_s=0.1, factor=2.0, jitter_frac=0.1)
+    assert p.backoff("fanout", 1) == 0.0
+    b2, b3 = p.backoff("fanout", 2), p.backoff("fanout", 3)
+    # exponential base with +/-10% jitter, repeatable
+    assert 0.09 <= b2 <= 0.11 and 0.18 <= b3 <= 0.22
+    assert b2 == p.backoff("fanout", 2)  # deterministic, not wall-clock
+
+
+def test_retry_policy_validation():
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError, match="deadline_s"):
+        RetryPolicy(deadline_s=0)
+    with pytest.raises(ValueError, match="retry_attempts"):
+        SolverConfig(retry_attempts=0)
+    with pytest.raises(ValueError, match="stage_deadline_s"):
+        SolverConfig(stage_deadline_s=-1)
+    with pytest.raises(ValueError, match="min_source_batch"):
+        SolverConfig(min_source_batch=0)
+
+
+def test_is_oom_error_classification():
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert resilience.is_oom_error(MemoryError("boom"))
+    assert resilience.is_oom_error(InjectedOOMError("x"))
+    assert resilience.is_oom_error(XlaRuntimeError("RESOURCE_EXHAUSTED: hbm"))
+    assert resilience.is_oom_error(RuntimeError("Out of memory allocating"))
+    assert not resilience.is_oom_error(RuntimeError("shape mismatch"))
+    assert not resilience.is_oom_error(ValueError("RESOURCE_EXHAUSTED"))
+
+
+def test_fault_plan_attempt_counting():
+    plan = FaultPlan([Fault(stage="s", kind="oom", attempt=2, times=2)])
+    assert plan.fire("s") is None           # attempt 1 clean
+    assert plan.fire("s") is not None       # attempt 2 fails
+    assert plan.fire("s") is not None       # attempt 3 fails (times=2)
+    assert plan.fire("s") is None           # attempt 4 clean
+    # batches count independently
+    assert plan.fire("s", batch=0) is None
+    assert plan.attempts("s") == 4 and plan.attempts("s", 0) == 1
+    with pytest.raises(ValueError, match="kind"):
+        Fault(stage="s", kind="explode")
+
+
+# -- OOM degradation ---------------------------------------------------------
+
+
+def test_oom_degradation_schedule(graph):
+    """32 -> 16 -> 8: two injected OOMs on the first batch walk the
+    halving schedule; the solve completes batched at 8 with results
+    identical to the uninterrupted run."""
+    ref = _solver(source_batch_size=32).solve(graph)
+    plan = FaultPlan([
+        Fault(stage="fanout", kind="oom", attempt=1, batch=0, times=2),
+    ])
+    r = _solver(source_batch_size=32, fault_plan=plan).solve(graph)
+    assert r.stats.oom_degradations == 2
+    assert r.stats.final_batch == 8
+    np.testing.assert_array_equal(ref.matrix, r.matrix)
+    # the plan actually fired what we think it fired
+    assert [k for (_, _, _, k) in plan.fired] == ["oom", "oom"]
+
+
+def test_oom_at_floor_propagates(graph):
+    """Below min_source_batch there is nothing left to shrink — the OOM
+    must surface, not loop."""
+    plan = FaultPlan([
+        Fault(stage="fanout", kind="oom", attempt=1, batch=0, times=50),
+    ])
+    with pytest.raises(MemoryError):
+        _solver(
+            source_batch_size=8, min_source_batch=8, fault_plan=plan
+        ).solve(graph)
+
+
+def test_degrade_resume_with_predecessors(graph):
+    """Acceptance: injected-OOM fan-out completes at a smaller batch with
+    oom_degradations >= 1 and dist AND pred bitwise-equal to the
+    uninterrupted run."""
+    ref = _solver(source_batch_size=16).solve(graph, predecessors=True)
+    plan = FaultPlan([Fault(stage="fanout", kind="oom", attempt=1, batch=1)])
+    r = _solver(source_batch_size=16, fault_plan=plan).solve(
+        graph, predecessors=True
+    )
+    assert r.stats.oom_degradations >= 1
+    assert r.stats.final_batch == 8
+    np.testing.assert_array_equal(np.asarray(ref.dist), np.asarray(r.dist))
+    np.testing.assert_array_equal(
+        np.asarray(ref.predecessors), np.asarray(r.predecessors)
+    )
+
+
+def test_oom_degradation_solve_reduced(graph):
+    """solve_reduced streams through the same resilient batch driver."""
+    ref = _solver(source_batch_size=16).solve_reduced(
+        graph, reduce_rows="checksum"
+    )
+    plan = FaultPlan([Fault(stage="fanout", kind="oom", attempt=1, batch=0)])
+    r = _solver(source_batch_size=16, fault_plan=plan).solve_reduced(
+        graph, reduce_rows="checksum"
+    )
+    assert r.stats.oom_degradations == 1
+    assert np.isclose(float(sum(ref.values)), float(sum(r.values)))
+
+
+# -- checkpoint-resume under mid-fan-out OOM ---------------------------------
+
+
+def test_checkpoint_resume_after_fatal_oom(graph, tmp_path):
+    """Acceptance: a run killed by OOM mid-fan-out leaves its completed
+    batches checkpointed; the resumed run skips them and the final
+    dist/pred are bitwise-equal to an uninterrupted solve."""
+    ref = _solver(source_batch_size=8).solve(graph, predecessors=True)
+    # batch 1 OOMs on every attempt AND at the floor -> the run dies,
+    # but batch 0 was already saved.
+    plan = FaultPlan([
+        Fault(stage="fanout", kind="oom", attempt=1, batch=1, times=50),
+    ])
+    cfg = dict(source_batch_size=8, checkpoint_dir=str(tmp_path))
+    with pytest.raises(MemoryError):
+        _solver(fault_plan=plan, **cfg).solve(graph, predecessors=True)
+    assert len(list(tmp_path.rglob("rows_*.npz"))) == 1
+    resumed = _solver(**cfg).solve(graph, predecessors=True)
+    assert resumed.stats.batches_resumed == 1
+    np.testing.assert_array_equal(
+        np.asarray(ref.dist), np.asarray(resumed.dist)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.predecessors), np.asarray(resumed.predecessors)
+    )
+
+
+def test_checkpoint_plus_degrade_same_run(graph, tmp_path):
+    """Degradation mid-run with checkpointing on: completed pre-OOM
+    batches are saved at the original size, post-OOM batches at the
+    degraded size, and the assembled matrix is exact."""
+    ref = _solver(source_batch_size=16).solve(graph)
+    plan = FaultPlan([Fault(stage="fanout", kind="oom", attempt=1, batch=1)])
+    r = _solver(
+        source_batch_size=16, checkpoint_dir=str(tmp_path), fault_plan=plan
+    ).solve(graph)
+    assert r.stats.oom_degradations == 1
+    np.testing.assert_array_equal(ref.matrix, r.matrix)
+    # 1 batch of 16 + 4 of 8 = 5 checkpoint files
+    assert len(list(tmp_path.rglob("rows_*.npz"))) == 5
+
+
+# -- watchdog ----------------------------------------------------------------
+
+
+def test_watchdog_abandons_hung_stage_then_retry_succeeds(graph):
+    """Acceptance: the watchdog abandons a stage past its deadline; the
+    retry (no fault on attempt 2) completes the solve."""
+    plan = FaultPlan([
+        Fault(stage="fanout", kind="timeout", attempt=1, sleep_s=5.0),
+    ])
+    ref = _solver(source_batch_size=48).solve(graph)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        r = _solver(
+            source_batch_size=48, fault_plan=plan, stage_deadline_s=0.1
+        ).solve(graph)
+    assert r.stats.abandoned_stages == ["fanout#b0@a1"]
+    assert r.stats.retries == 1
+    np.testing.assert_array_equal(ref.matrix, r.matrix)
+
+
+def test_watchdog_permanent_hang_raises(graph):
+    plan = FaultPlan([
+        Fault(stage="fanout", kind="timeout", attempt=1, times=9, sleep_s=5.0),
+    ])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with pytest.raises(StageAbandonedError, match="all 2 attempts"):
+            _solver(
+                source_batch_size=48, fault_plan=plan,
+                stage_deadline_s=0.1, retry_attempts=2,
+            ).solve(graph)
+
+
+def test_transient_error_is_retried(graph):
+    """A non-OOM transient device error consumes a plain retry."""
+    plan = FaultPlan([Fault(stage="fanout", kind="error", attempt=1)])
+    r = _solver(source_batch_size=48, fault_plan=plan).solve(graph)
+    assert r.stats.retries == 1
+    ref = _solver(source_batch_size=48).solve(graph)
+    np.testing.assert_array_equal(ref.matrix, r.matrix)
+
+
+def test_transient_error_exhausts_attempts(graph):
+    plan = FaultPlan([Fault(stage="fanout", kind="error", attempt=1, times=9)])
+    with pytest.raises(InjectedFaultError):
+        _solver(
+            source_batch_size=48, fault_plan=plan, retry_attempts=2
+        ).solve(graph)
+
+
+def test_bellman_ford_stage_retry(tiny_graph):
+    """The potentials pass (negative weights) runs through the same
+    retry machinery."""
+    plan = FaultPlan([Fault(stage="bellman_ford", kind="error", attempt=1)])
+    ref = _solver().solve(tiny_graph)
+    r = _solver(fault_plan=plan).solve(tiny_graph)
+    assert r.stats.retries == 1
+    np.testing.assert_array_equal(ref.matrix, r.matrix)
+
+
+# -- distance-sanity guard ---------------------------------------------------
+
+
+def test_nan_rows_raise_corruption_error(graph):
+    plan = FaultPlan([Fault(stage="fanout", kind="nan", batch=0)])
+    with pytest.raises(SolveCorruptionError, match="NaN"):
+        _solver(source_batch_size=16, fault_plan=plan).solve(graph)
+
+
+def test_nan_rows_never_reach_checkpoints(graph, tmp_path):
+    """The guard fires BEFORE the checkpoint write: poisoned rows must
+    not be resumable."""
+    plan = FaultPlan([Fault(stage="fanout", kind="nan", batch=1)])
+    with pytest.raises(SolveCorruptionError):
+        _solver(
+            source_batch_size=16, checkpoint_dir=str(tmp_path),
+            fault_plan=plan,
+        ).solve(graph)
+    # batch 0 (clean) saved; the poisoned batch 1 never was
+    saved = list(tmp_path.rglob("rows_*.npz"))
+    assert len(saved) == 1
+    for f in saved:
+        with np.load(f) as data:
+            assert not np.isnan(data["rows"]).any()
+
+
+def test_nan_potentials_raise(tiny_graph):
+    plan = FaultPlan([Fault(stage="bellman_ford", kind="nan")])
+    with pytest.raises(SolveCorruptionError, match="bellman_ford"):
+        _solver(fault_plan=plan).solve(tiny_graph)
+
+
+def test_check_rows_sane_direct():
+    rows = np.zeros((2, 4), np.float32)
+    sources = np.array([0, 3])
+    resilience.check_rows_sane(rows, sources, route="vm", iteration=3)
+    bad = rows.copy()
+    bad[1, 2] = np.nan
+    with pytest.raises(SolveCorruptionError, match="route='vm'"):
+        resilience.check_rows_sane(bad, sources, route="vm", iteration=3)
+    neg = rows.copy()
+    neg[0, 0] = -1.0
+    with pytest.raises(SolveCorruptionError, match="own source"):
+        resilience.check_rows_sane(neg, sources, route="vm", iteration=3)
+
+
+# -- bench harness failed-row tagging ----------------------------------------
+
+
+def test_bench_pass_emits_row_for_failed_config(monkeypatch):
+    """Acceptance: a failing config writes a partial row tagged
+    'failed' and the pass still emits a row for every config."""
+    from paralleljohnson_tpu import benchmarks
+
+    def boom(backend, preset):
+        raise RuntimeError("injected config failure")
+
+    monkeypatch.setitem(benchmarks.CONFIGS, "er1k_apsp", boom)
+    records = benchmarks.run(
+        ["er1k_apsp", "dimacs_ny_bf"], backend="numpy", preset="smoke"
+    )
+    assert [r.config for r in records] == ["er1k_apsp", "dimacs_ny_bf"]
+    assert "injected config failure" in records[0].detail["failed"]
+    assert records[0].edges_relaxed == 0
+    assert "failed" not in records[1].detail
+    # every record (including the failed one) serializes to a JSON line
+    for r in records:
+        assert r.as_json_line().startswith("{")
+
+
+def test_bench_failed_row_never_clobbers_baseline(tmp_path):
+    from paralleljohnson_tpu.benchmarks import BenchRecord, update_baseline_md
+
+    md = tmp_path / "BASELINE.md"
+    good = BenchRecord("cfg", "jax", "full", 1.0, 100, 100.0, 1, {"ok": 1})
+    update_baseline_md([good], str(md))
+    assert "| cfg | jax | full | 1.000 " in md.read_text()
+    failed = BenchRecord(
+        "cfg", "jax", "full", 0.1, 0, 0.0, 1, {"failed": "tunnel died"}
+    )
+    update_baseline_md([failed], str(md))
+    text = md.read_text()
+    assert "| cfg | jax | full | 1.000 " in text  # good row survives
+    assert "tunnel died" not in text
+    # ...but a failed row for a NEVER-measured config does land
+    failed2 = BenchRecord(
+        "cfg2", "jax", "full", 0.1, 0, 0.0, 1, {"failed": "tunnel died"}
+    )
+    update_baseline_md([failed2], str(md))
+    assert "cfg2" in md.read_text()
+
+
+# -- sharded -> single-device fallback ---------------------------------------
+
+
+def test_sharded_fanout_falls_back_to_single_device():
+    """Acceptance: a collective failure on the sharded fan-out degrades
+    the solve to single-device instead of dying, with a route tag that
+    says so."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device simulated mesh")
+    g = erdos_renyi(64, 0.08, seed=41)
+    ref = ParallelJohnsonSolver(SolverConfig(backend="jax")).solve(g)
+    plan = FaultPlan([
+        Fault(stage="sharded_fanout", kind="error", attempt=1, times=99),
+    ])
+    solver = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", fault_plan=plan, retry_attempts=1)
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        res = solver.solve(g)
+    route = res.stats.routes_by_phase["fanout"]
+    assert "1dev-fallback" in route
+    np.testing.assert_allclose(
+        np.asarray(res.dist), np.asarray(ref.dist), rtol=1e-6
+    )
+    # the failure pinned this backend instance to one device; later
+    # solves stay single-device without re-failing
+    assert solver.backend._mesh().devices.size == 1
+    res2 = solver.solve(g)
+    np.testing.assert_allclose(
+        np.asarray(res2.dist), np.asarray(ref.dist), rtol=1e-6
+    )
+
+
+def test_sharded_oom_degrades_batch_not_mesh():
+    """OOM inside the sharded path belongs to the batch degrader (shrink
+    and stay sharded), not the single-device fallback."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device simulated mesh")
+    g = erdos_renyi(64, 0.08, seed=41)
+    ref = ParallelJohnsonSolver(SolverConfig(backend="jax")).solve(g)
+    plan = FaultPlan([
+        Fault(stage="sharded_fanout", kind="oom", attempt=1),
+    ])
+    solver = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", fault_plan=plan, source_batch_size=64)
+    )
+    res = solver.solve(g)
+    assert res.stats.oom_degradations == 1
+    assert res.stats.final_batch == 32
+    assert solver.backend._mesh().devices.size > 1  # mesh untouched
+    np.testing.assert_allclose(
+        np.asarray(res.dist), np.asarray(ref.dist), rtol=1e-6
+    )
+
+
+# -- stats plumbing ----------------------------------------------------------
+
+
+def test_stats_dict_contains_resilience_fields(graph):
+    r = _solver(source_batch_size=48).solve(graph)
+    d = r.stats.as_dict()
+    assert d["retries"] == 0
+    assert d["oom_degradations"] == 0
+    assert d["final_batch"] == 48
+    assert d["abandoned_stages"] == []
+
+
+# -- CLI surface -------------------------------------------------------------
+
+
+def test_cli_resilience_flags_and_json_stats(capsys):
+    import json
+
+    from paralleljohnson_tpu import cli
+
+    rc = cli.main([
+        "solve", "er:n=32,p=0.1", "--backend", "numpy",
+        "--batch-size", "16", "--retry-attempts", "2",
+        "--min-source-batch", "4", "--stage-deadline", "30", "--json",
+    ])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["retries"] == 0
+    assert payload["oom_degradations"] == 0
+    assert payload["final_batch"] == 16
+    assert payload["abandoned_stages"] == []
+
+
+def test_cli_info_reports_resilience_defaults(capsys):
+    import json
+
+    from paralleljohnson_tpu import cli
+
+    assert cli.main(["info", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    r = payload["resilience"]
+    assert r["retry_attempts"] == 3
+    assert r["min_source_batch"] == 8
+    assert "halve the source batch" in r["oom_degradation"]
