@@ -125,6 +125,15 @@ class Backend(abc.ABC):
         device backends override (HBM hygiene before multi-batch row
         downloads — the RMAT-22 crash mitigation)."""
 
+    def stage_rows_async(self, *arrays: Any) -> None:
+        """Start device-to-host transfers of ``arrays`` WITHOUT blocking
+        (a scheduling hint, never correctness): the pipelined fan-out
+        calls this the moment a batch's rows pass the sanity guard, so
+        the D2H DMA runs under the next batch's compute and the later
+        ``np.asarray`` mostly just collects an already-finished copy.
+        No-op for host backends (rows are already host memory); device
+        backends override (``jax.Array.copy_to_host_async``)."""
+
     # -- optional fast paths (defaults compose the kernels host-side) -------
 
     def reweight(self, dgraph: Any, potentials: np.ndarray) -> Any:

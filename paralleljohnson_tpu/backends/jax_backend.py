@@ -641,6 +641,17 @@ class JaxBackend(Backend):
 
     name = "jax"
 
+    def __init__(self, config=None) -> None:
+        super().__init__(config)
+        # Persistent XLA/Mosaic compile cache (ROADMAP item 1): opt-in
+        # via SolverConfig.compilation_cache_dir / PJ_COMPILE_CACHE, so
+        # the 3x-retry TPU passes stop re-paying compiles per attempt.
+        from paralleljohnson_tpu.utils.platform import (
+            enable_compilation_cache,
+        )
+
+        enable_compilation_cache(self.config.compilation_cache_dir)
+
     @property
     def _dtype(self):
         if self.config.precision == "f64" and not jax.config.jax_enable_x64:
@@ -685,6 +696,22 @@ class JaxBackend(Backend):
         dgraph._struct_cache.clear()
         dgraph._by_dst_cache.clear()
 
+    def stage_rows_async(self, *arrays) -> None:
+        """Kick off the D2H copies early (``jax.Array.copy_to_host_async``)
+        so the pipelined fan-out's row download DMA runs under the next
+        batch's compute; the later ``np.asarray`` then collects a mostly
+        finished transfer instead of starting one. Purely a scheduling
+        hint — failures are swallowed (the synchronous download still
+        happens and is the correctness path)."""
+        for a in arrays:
+            start = getattr(a, "copy_to_host_async", None)
+            if start is None:
+                continue
+            try:
+                start()
+            except Exception:  # noqa: BLE001 — hint only, never correctness
+                pass
+
     def _memory_budget_bytes(self) -> int:
         """Usable accelerator memory for one fan-out call. Prefers the
         device's own bytes_limit (TPU HBM); CPU hosts get a conservative
@@ -708,10 +735,17 @@ class JaxBackend(Backend):
         carry, the update, and XLA temporaries. ``with_pred`` adds ~3
         more (the int32 pred block itself plus the extraction pass's
         (best_du, best_u) scan carries — ops.pred), so a pred solve no
-        longer silently overshoots the budget the plain sizing promised."""
+        longer silently overshoots the budget the plain sizing promised.
+        The pipelined fan-out (``config.pipeline_depth`` > 1) additionally
+        holds one computed-but-unmaterialized [B, V] block per extra
+        in-flight slot (plus its pred block on pred solves) while the
+        next batch computes — budgeted here so double-buffering cannot
+        OOM a batch the serial sizing promised would fit."""
         v = max(dgraph.num_nodes, 1)
         itemsize = jnp.dtype(self._dtype).itemsize
         blocks = 9 if with_pred else 6
+        carry_slots = max(0, int(self.config.pipeline_depth) - 1)
+        blocks += carry_slots * (2 if with_pred else 1)
         # Per-DEVICE budget: row blocks shard over the "sources" axis only
         # (on a 2-D mesh they replicate over "edges"), so the global B is
         # n_sources x what one device can hold.

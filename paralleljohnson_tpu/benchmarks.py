@@ -64,6 +64,8 @@ _SIZES = {
                           sources=16,  mini_sources=64,  full_sources=512),
     "rmat_apsp":     dict(scale=8,     mini_scale=12,    full_scale=20,
                           sources=8,   mini_sources=32,  full_sources=128),
+    "rmat_apsp_pipelined": dict(scale=8, mini_scale=12,  full_scale=20,
+                          sources=32,  mini_sources=64,  full_sources=128),
     "batch_small":   dict(count=32,    mini_count=512,   full_count=10000),
 }
 
@@ -116,6 +118,13 @@ def _routes(res) -> dict:
         out["final_batch"] = s.final_batch
     if getattr(s, "abandoned_stages", None):
         out["abandoned_stages"] = list(s.abandoned_stages)
+    # Pipeline overlap accounting (round-9): a row that claims a wall-
+    # clock win must be attributable to overlap (overlap_saved_s > 0
+    # with the download/ckpt costs it hid), not to noise.
+    for key in ("download_s", "ckpt_wait_s", "overlap_saved_s"):
+        val = float(getattr(s, key, 0.0) or 0.0)
+        if val:
+            out[key] = round(val, 4)
     return out
 
 
@@ -293,6 +302,61 @@ def bench_rmat_apsp(backend: str, preset: str) -> BenchRecord:
     )
 
 
+def bench_rmat_apsp_pipelined(backend: str, preset: str) -> BenchRecord:
+    """Config 4b (round-9 tentpole): the rmat fan-out as a MULTI-batch
+    checkpointed solve, measured serial (``pipeline_depth=1``) vs
+    double-buffered (``pipeline_depth=2``) on the same graph — so
+    BENCH/BASELINE can attribute any s22-class improvement to
+    compute/transfer/IO overlap rather than noise. The timed row is the
+    pipelined run; the detail column records the serial wall, the
+    speedup, and the overlap accounting (``overlap_saved_s`` > 0 is the
+    proof the win came from the pipeline). Rows are cross-checked
+    bitwise between the two runs — a pipelined result that drifted is a
+    bug, not a measurement."""
+    import tempfile
+
+    from paralleljohnson_tpu.graphs import rmat
+
+    scale = _sz("rmat_apsp_pipelined", "scale", preset)
+    n_sources = _sz("rmat_apsp_pipelined", "sources", preset)
+    g = rmat(scale, 16, seed=42)
+    rng = np.random.default_rng(1)
+    sources = np.sort(
+        rng.choice(g.num_nodes, size=min(n_sources, g.num_nodes),
+                   replace=False)
+    )
+    bs = max(1, len(sources) // 4)  # >= 4 batches: the window needs work
+    # Warm WITHOUT a checkpoint dir: a warmed checkpoint would let the
+    # timed runs resume instead of computing.
+    _solver(backend, source_batch_size=bs).multi_source(g, sources)
+    with tempfile.TemporaryDirectory() as d_serial, \
+            tempfile.TemporaryDirectory() as d_pipe:
+        serial = _solver(backend, source_batch_size=bs, pipeline_depth=1,
+                         checkpoint_dir=d_serial)
+        t0 = time.perf_counter()
+        sres = serial.multi_source(g, sources)
+        serial_wall = time.perf_counter() - t0
+        pipe = _solver(backend, source_batch_size=bs, pipeline_depth=2,
+                       checkpoint_dir=d_pipe)
+        t0 = time.perf_counter()
+        res = pipe.multi_source(g, sources)
+        wall = time.perf_counter() - t0
+    detail = {
+        "scale": scale, "nodes": g.num_nodes, "edges": g.num_real_edges,
+        "sources": len(sources), "source_batch": bs,
+        "serial_wall_s": round(serial_wall, 6),
+        "pipeline_speedup": round(serial_wall / max(wall, 1e-9), 3),
+        **_routes(res),
+    }
+    if not np.array_equal(np.asarray(sres.dist), np.asarray(res.dist)):
+        detail["failed"] = "pipelined rows != serial rows"
+    return BenchRecord(
+        "rmat_apsp_pipelined", backend, preset, wall,
+        res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
+        detail,
+    )
+
+
 def bench_batch_small(backend: str, preset: str) -> BenchRecord:
     """Config 5 (BASELINE.json:11): many-small-graphs vmapped APSP
     (full: 10k random 256-node graphs)."""
@@ -352,6 +416,7 @@ CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "dimacs_ny_scrambled_pred": bench_dimacs_ny_scrambled_pred,
     "ego_fb_nsource": bench_ego_fb_nsource,
     "rmat_apsp": bench_rmat_apsp,
+    "rmat_apsp_pipelined": bench_rmat_apsp_pipelined,
     "batch_small": bench_batch_small,
 }
 

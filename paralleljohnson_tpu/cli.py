@@ -79,6 +79,16 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="max Gauss-Seidel inner iterations per block "
                         "visit (bounds extra propagation, not correctness)")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="max fan-out batches in flight (double-buffered "
+                        "pipeline: batch k's row download + checkpoint "
+                        "write run behind batch k+1's device compute; "
+                        "each extra slot carries one more [B, V] block "
+                        "in device memory); 1 = strictly serial")
+    p.add_argument("--compilation-cache-dir", default=None, metavar="DIR",
+                   help="persistent JAX compilation cache directory so "
+                        "re-runs skip Mosaic/XLA compiles (default: "
+                        "$PJ_COMPILE_CACHE if set, else off)")
     p.add_argument("--retry-attempts", type=int, default=3,
                    help="max attempts per solve stage before the failure "
                         "propagates (1 disables retries)")
@@ -138,6 +148,8 @@ def _config(args) -> "SolverConfig":
         gs_inner_cap=args.gs_inner_cap,
         pred_extraction=tristate[args.pred_extraction],
         checkpoint_dir=args.checkpoint_dir,
+        pipeline_depth=args.pipeline_depth,
+        compilation_cache_dir=args.compilation_cache_dir,
         validate=args.validate,
         retry_attempts=args.retry_attempts,
         stage_deadline_s=args.stage_deadline,
@@ -194,6 +206,15 @@ def _report(res, args) -> None:
             print(f"  resilience: {'; '.join(parts)}")
         if s.batches_resumed:
             print(f"  batches resumed from checkpoint: {s.batches_resumed}")
+        # Pipeline summary — only when the fan-out actually staged work
+        # off the critical path (a serial solve stays quiet).
+        if s.download_s or s.ckpt_wait_s or s.overlap_saved_s:
+            print(
+                f"  pipeline (depth {s.final_pipeline_depth}): "
+                f"download {s.download_s * 1e3:.2f} ms, "
+                f"ckpt wait {s.ckpt_wait_s * 1e3:.2f} ms, "
+                f"overlap saved {s.overlap_saved_s * 1e3:.2f} ms"
+            )
         if args.output:
             print(f"  wrote {args.output}")
 
@@ -298,9 +319,24 @@ def main(argv: list[str] | None = None) -> int:
                 "stage_deadline_s": _dc.stage_deadline_s,
                 "min_source_batch": _dc.min_source_batch,
                 "oom_degradation": (
-                    "on RESOURCE_EXHAUSTED: clear_caches, halve the "
-                    "source batch (floor min_source_batch), resume from "
-                    "the failed batch"
+                    "on RESOURCE_EXHAUSTED: collapse the pipeline window "
+                    "to 1, then clear_caches + halve the source batch "
+                    "(floor min_source_batch), resume from the failed "
+                    "batch"
+                ),
+            },
+            # The pipelined fan-out defaults (README "Pipelined
+            # execution"): per-solve download_s / ckpt_wait_s /
+            # overlap_saved_s prove the overlap in the stats output.
+            "pipeline": {
+                "pipeline_depth": _dc.pipeline_depth,
+                "compilation_cache_dir": _dc.compilation_cache_dir,
+                "compilation_cache_env": "PJ_COMPILE_CACHE",
+                "overlap": (
+                    "batch k's D2H row download + checkpoint write run "
+                    "behind batch k+1's device compute; each extra "
+                    "in-flight slot carries one [B, V] block of HBM "
+                    "(budgeted by suggested_source_batch)"
                 ),
             },
         }

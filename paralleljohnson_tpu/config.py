@@ -137,6 +137,21 @@ class SolverConfig:
         True forces (given >1 device), False keeps single-chip sweeps.
       checkpoint_dir: if set, per-source-batch distance rows are saved here
         and resumed after preemption (SURVEY.md §5 checkpoint/resume).
+      pipeline_depth: max fan-out batches in flight in the double-buffered
+        pipeline — batch k's D2H row download + checkpoint serialization
+        run on a background stage while batch k+1's device compute
+        proceeds, so the multi-GB transfers and fsyncs of RMAT-22-class
+        solves leave the critical path. Each extra slot carries one more
+        computed-but-unmaterialized [B, V] block in device memory
+        (``suggested_source_batch`` budgets the carry); on device OOM the
+        window collapses to 1 BEFORE the batch is halved. 1 = the
+        pre-pipeline strictly serial loop (bitwise-identical results
+        either way — the pipeline changes scheduling, never arithmetic).
+      compilation_cache_dir: persistent JAX compilation cache directory
+        (``jax_compilation_cache_dir``), so re-runs — and especially the
+        3x-retry TPU measurement passes — stop re-paying Mosaic/XLA
+        compiles. None falls back to the PJ_COMPILE_CACHE env var; both
+        unset leaves the cache off.
       validate: cross-check results against the scipy oracle (slow; tests).
       retry_attempts: max attempts per solve stage before the failure
         propagates (``utils.resilience.RetryPolicy``); 1 disables
@@ -178,6 +193,8 @@ class SolverConfig:
     pred_extraction: bool | str = "auto"
     edge_shard: bool | str = "auto"
     checkpoint_dir: str | None = None
+    pipeline_depth: int = 2
+    compilation_cache_dir: str | None = None
     validate: bool = False
     retry_attempts: int = 3
     retry_backoff_s: float = 0.05
@@ -273,6 +290,10 @@ class SolverConfig:
         if self.min_source_batch < 1:
             raise ValueError(
                 f"min_source_batch must be >= 1, got {self.min_source_batch}"
+            )
+        if self.pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {self.pipeline_depth}"
             )
 
     def retry_policy(self):

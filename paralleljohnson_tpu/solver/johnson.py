@@ -16,6 +16,8 @@ configured :class:`~paralleljohnson_tpu.backends.Backend`.
 
 from __future__ import annotations
 
+import collections
+import concurrent.futures
 import dataclasses
 import time
 from typing import Any
@@ -268,27 +270,37 @@ class ParallelJohnsonSolver:
             dgraph = self.backend.upload(graph)
         h, dgraph = self._potentials(graph, dgraph, stats)
         values = []
+        n_src = len(sources)
+
+        def finalize(batch_idx, batch, res, resumed):
+            """Per-batch streaming stage: un-reweight + reduce. Runs on
+            the pipeline's background worker (depth > 1), so a reducer
+            that materializes rows host-side overlaps the next batch's
+            device compute — the same overlap the checkpointed path gets."""
+            rows = res.dist
+            if graph.has_negative_weights:
+                rows = _unreweight(rows, h, batch)
+            # Same HBM-hygiene gate as _fanout's downloads: a reducer
+            # may materialize the rows host-side, and at RMAT-22
+            # scale the layout caches must not still be resident
+            # when it does (the s22 crash mitigation).
+            if (
+                len(batch) < n_src
+                and int(getattr(rows, "nbytes", 0) or 0)
+                >= _DOWNLOAD_CLEAR_MIN_BYTES
+            ):
+                self.backend.clear_caches(dgraph)
+            return reduce_rows(rows, batch)
+
         with phase_timer(stats, "fanout"):
             # Same resilience driver as solve(): retry/watchdog per batch,
-            # OOM -> halve-and-resume (streaming mode has no checkpoint —
-            # reduced values accumulate host-side as batches complete).
-            for _, batch, res, _ in self._resilient_batches(
-                dgraph, sources, stats
+            # OOM -> collapse the pipeline window, then halve-and-resume
+            # (streaming mode has no checkpoint — reduced values
+            # accumulate host-side in batch order as finalizes drain).
+            for _, _, value, _ in self._resilient_batches(
+                dgraph, sources, stats, finalize=finalize
             ):
-                rows = res.dist
-                if graph.has_negative_weights:
-                    rows = _unreweight(rows, h, batch)
-                # Same HBM-hygiene gate as _fanout's downloads: a reducer
-                # may materialize the rows host-side, and at RMAT-22
-                # scale the layout caches must not still be resident
-                # when it does (the s22 crash mitigation).
-                if (
-                    len(batch) < len(sources)
-                    and int(getattr(rows, "nbytes", 0) or 0)
-                    >= _DOWNLOAD_CLEAR_MIN_BYTES
-                ):
-                    self.backend.clear_caches(dgraph)
-                values.append(reduce_rows(rows, batch))
+                values.append(value)
         return ReducedResult(
             values=values, sources=sources, potentials=h, stats=stats
         )
@@ -487,25 +499,45 @@ class ParallelJohnsonSolver:
         *,
         with_pred: bool = False,
         try_resume=None,
+        finalize=None,
+        stage_async=None,
     ):
-        """Drive the fan-out batch loop through the resilience layer.
+        """Drive the fan-out batch loop through the resilience layer as a
+        double-buffered pipeline (the round-9 tentpole).
 
-        Yields ``(batch_idx, batch, payload, resumed)`` per completed
-        batch — ``payload`` is the checkpointer's cached ``(rows, pred)``
-        when ``resumed``, else the backend's KernelResult. Per batch:
+        Yields ``(batch_idx, batch, result, resumed)`` per batch, in
+        batch order. When a ``finalize`` stage is given (the download /
+        checkpoint / streaming-reduce step), ``result`` is its return
+        value; otherwise the raw payload — the checkpointer's cached
+        ``(rows, pred)`` when ``resumed``, else the backend's
+        KernelResult.
 
-        - retry + per-attempt watchdog per ``config.retry_policy()``
-          (a hung device call is logged-and-abandoned, then retried);
-        - on device OOM: checkpoint state is already safe (completed
-          batches were saved as they finished), the degrader clears the
-          backend caches and HALVES the batch (floor
-          ``config.min_source_batch``, re-consulting
-          ``suggested_source_batch``), and the failed source range is
-          re-split and resumed — the batch is the unit of recovery;
-        - converged rows pass the distance-sanity guard BEFORE anyone
-          can checkpoint or consume them;
-        - deterministic faults (``config.fault_plan``) are injected per
-          attempt, so tier-1 CPU tests exercise all of the above.
+        Pipeline (``config.pipeline_depth`` = max batches in flight;
+        1 = the strictly serial pre-round-9 loop, bitwise-identical
+        results either way):
+
+        - batch k's ``finalize`` runs on a single background worker
+          while batch k+1's device compute proceeds on this thread, so
+          multi-GB D2H row downloads and checkpoint serialization leave
+          the critical path (``stage_async`` — JAX's
+          ``copy_to_host_async`` — starts the DMA before the worker even
+          picks the batch up);
+        - at most ``pipeline_depth - 1`` finalizes sit in the window,
+          each carrying one computed-but-unmaterialized [B, V] block
+          (+ pred) in device memory; ``suggested_source_batch`` budgets
+          exactly that carry;
+        - ``finalize`` runs under the SAME retry policy / watchdog
+          deadline / fault plan as compute (stage ``"download"``), so a
+          hung transfer is logged-and-abandoned like a hung kernel, and
+          ``FaultPlan`` can kill a run mid-download;
+        - on device OOM the window COLLAPSES to 1 first — the in-flight
+          carry is the cheapest memory to give back — and only a repeat
+          OOM walks the PR-3 batch-halving schedule (clear caches, halve,
+          floor ``min_source_batch``, resume the failed range);
+        - converged rows pass the distance-sanity guard BEFORE any
+          finalize can download or commit them; non-OOM background
+          failures surface as ``SolveCorruptionError`` (never silent
+          loss); deterministic faults exercise every path on CPU.
         """
         policy = self.config.retry_policy()
         faults = self.config.fault_plan
@@ -516,57 +548,171 @@ class ParallelJohnsonSolver:
             min_batch=self.config.min_source_batch,
             with_pred=with_pred,
         )
+        depth = (
+            max(1, int(self.config.pipeline_depth))
+            if finalize is not None
+            else 1
+        )
+        stats.final_pipeline_depth = depth
         n = len(sources)
         pos = 0
         batch_idx = 0
-        while pos < n:
-            batch = sources[pos : pos + degrader.batch_size]
-            if try_resume is not None:
-                cached = try_resume(batch_idx, batch)
-                if cached is not None:
-                    stats.batches_resumed += 1
-                    yield batch_idx, batch, cached, True
+        # In-flight finalize window: (batch_idx, batch, payload, future).
+        pending: collections.deque = collections.deque()
+        worker = None
+
+        def run_finalize(bi, b, payload, resumed):
+            """One finalize, timed, through the resilience layer (stage
+            "download": retry + watchdog + fault injection). Returns
+            (result, duration) so the drain can price the overlap."""
+            if finalize is None:
+                return payload, 0.0
+            if resumed:
+                return finalize(bi, b, payload, True), 0.0
+            t0 = time.perf_counter()
+            out = resilience.run_stage(
+                lambda: finalize(bi, b, payload, False),
+                stage="download",
+                policy=policy,
+                stats=stats,
+                faults=faults,
+                batch=bi,
+                retryable=_transient_error,
+            )
+            dur = time.perf_counter() - t0
+            stats.download_s += dur
+            return out, dur
+
+        def collapse_window() -> None:
+            """OOM step 0: go serial — give back the in-flight [B, V]
+            carry before any batch halving (the window is the cheapest
+            memory on the table)."""
+            nonlocal depth
+            depth = 1
+            stats.final_pipeline_depth = 1
+            try:
+                self.backend.clear_caches(dgraph)
+            except Exception:  # noqa: BLE001 — hygiene must not mask
+                pass
+
+        def drain_one():
+            """Wait for the oldest staged finalize; account the blocked
+            time (ckpt_wait_s) and the hidden time (overlap_saved_s)."""
+            nonlocal depth
+            bi, b, payload, fut = pending.popleft()
+            t0 = time.perf_counter()
+            try:
+                out, dur = fut.result()
+            except Exception as e:
+                stats.ckpt_wait_s += time.perf_counter() - t0
+                if resilience.is_oom_error(e):
+                    if depth > 1:
+                        # The staged materialization itself OOMed: give
+                        # back the window and retry THIS finalize
+                        # serially before anything harsher.
+                        collapse_window()
+                        out, _ = run_finalize(bi, b, payload, False)
+                        return bi, b, out, False
+                    raise
+                if isinstance(
+                    e,
+                    (
+                        resilience.StageAbandonedError,
+                        resilience.SolveCorruptionError,
+                    ),
+                ):
+                    raise
+                raise resilience.SolveCorruptionError(
+                    f"pipelined download/checkpoint stage failed for "
+                    f"batch {bi}: {type(e).__name__}: {e}"
+                ) from e
+            wait = time.perf_counter() - t0
+            stats.ckpt_wait_s += wait
+            stats.overlap_saved_s += max(0.0, dur - wait)
+            return bi, b, out, False
+
+        try:
+            while pos < n:
+                batch = sources[pos : pos + degrader.batch_size]
+                if try_resume is not None:
+                    cached = try_resume(batch_idx, batch)
+                    if cached is not None:
+                        while pending:  # keep yields in batch order
+                            yield drain_one()
+                        stats.batches_resumed += 1
+                        out, _ = run_finalize(batch_idx, batch, cached, True)
+                        yield batch_idx, batch, out, True
+                        pos += len(batch)
+                        batch_idx += 1
+                        continue
+
+                def kernel(b=batch):
+                    if with_pred:
+                        return self.backend.multi_source_pred(dgraph, b)
+                    return self.backend.multi_source(dgraph, b)
+
+                try:
+                    res = resilience.run_stage(
+                        kernel,
+                        stage="fanout",
+                        policy=policy,
+                        stats=stats,
+                        faults=faults,
+                        batch=batch_idx,
+                        retryable=_transient_error,
+                    )
+                except Exception as e:
+                    if resilience.is_oom_error(e):
+                        if depth > 1:
+                            while pending:  # commit the good in-flight work
+                                yield drain_one()
+                            collapse_window()
+                            continue  # retry THIS batch serially, same size
+                        degrader.degrade(e)  # re-raises at the floor
+                        stats.oom_degradations += 1
+                        continue  # re-split THIS range smaller; pos unchanged
+                    raise
+                stats.accumulate(res, phase="fanout")
+                if not res.converged:
+                    raise ConvergenceError(
+                        "fan-out hit max_iterations while still improving"
+                    )
+                if faults is not None:
+                    res.dist = faults.poison_rows(
+                        "fanout", res.dist, batch=batch_idx
+                    )
+                resilience.check_rows_sane(
+                    res.dist, batch, route=res.route, iteration=res.iterations
+                )
+                # A batch with nothing to overlap against (the only batch
+                # of the solve) stays inline — single-batch device solves
+                # keep their rows resident exactly as before.
+                if depth > 1 and (pending or pos + len(batch) < n):
+                    if stage_async is not None:
+                        stage_async(res)
+                    if worker is None:
+                        worker = concurrent.futures.ThreadPoolExecutor(
+                            max_workers=1, thread_name_prefix="pj-pipeline"
+                        )
+                    fut = worker.submit(
+                        run_finalize, batch_idx, batch, res, False
+                    )
+                    pending.append((batch_idx, batch, res, fut))
                     pos += len(batch)
                     batch_idx += 1
-                    continue
-
-            def kernel(b=batch):
-                if with_pred:
-                    return self.backend.multi_source_pred(dgraph, b)
-                return self.backend.multi_source(dgraph, b)
-
-            try:
-                res = resilience.run_stage(
-                    kernel,
-                    stage="fanout",
-                    policy=policy,
-                    stats=stats,
-                    faults=faults,
-                    batch=batch_idx,
-                    retryable=_transient_error,
-                )
-            except Exception as e:
-                if resilience.is_oom_error(e):
-                    degrader.degrade(e)  # re-raises at the floor
-                    stats.oom_degradations += 1
-                    continue  # re-split THIS range smaller; pos unchanged
-                raise
-            stats.accumulate(res, phase="fanout")
-            if not res.converged:
-                raise ConvergenceError(
-                    "fan-out hit max_iterations while still improving"
-                )
-            if faults is not None:
-                res.dist = faults.poison_rows(
-                    "fanout", res.dist, batch=batch_idx
-                )
-            resilience.check_rows_sane(
-                res.dist, batch, route=res.route, iteration=res.iterations
-            )
-            yield batch_idx, batch, res, False
-            pos += len(batch)
-            batch_idx += 1
-        stats.final_batch = degrader.batch_size
+                    while len(pending) >= depth:
+                        yield drain_one()
+                else:
+                    out, _ = run_finalize(batch_idx, batch, res, False)
+                    yield batch_idx, batch, out, False
+                    pos += len(batch)
+                    batch_idx += 1
+            while pending:
+                yield drain_one()
+            stats.final_batch = degrader.batch_size
+        finally:
+            if worker is not None:
+                worker.shutdown(wait=True, cancel_futures=True)
 
     def _download_rows(self, dgraph: Any, rows, pred=None):
         """Materialize one batch's device rows on the host, clearing the
@@ -594,12 +740,19 @@ class ParallelJohnsonSolver:
         """Run phase 2 in source batches; optionally checkpoint each batch
         (SURVEY.md §5 — the batch is the unit of recovery). Checkpoints are
         keyed by graph content so a different/modified graph never resumes
-        stale rows. The loop runs through the resilience layer
-        (``_resilient_batches``): a batch that OOMs is re-split smaller
-        and resumed — everything already completed is safe on disk when
-        checkpointing is on. Returns (dist rows, predecessor rows or
-        None)."""
-        from paralleljohnson_tpu.utils.checkpoint import BatchCheckpointer
+        stale rows. The loop runs through the pipelined resilience driver
+        (``_resilient_batches``): batch k's D2H download + checkpoint
+        serialization run behind batch k+1's compute (pipeline_depth > 1),
+        a batch that OOMs first collapses the window and then is re-split
+        smaller and resumed — everything already completed is safe on disk
+        when checkpointing is on, and the solve does not return until the
+        checkpoint writer's flush barrier confirms every commit. Returns
+        (dist rows, predecessor rows or None)."""
+        from paralleljohnson_tpu.utils.checkpoint import (
+            AsyncCheckpointWriter,
+            BatchCheckpointer,
+            checked_save,
+        )
 
         ckpt = None
         if self.config.checkpoint_dir:
@@ -611,31 +764,83 @@ class ParallelJohnsonSolver:
         if ckpt is not None:
             def try_resume(batch_idx, batch):
                 return ckpt.load(batch_idx, batch, with_pred=with_pred)
+
+        depth = max(1, int(self.config.pipeline_depth))
+        faults = self.config.fault_plan
+        fault_hook = None
+        if faults is not None:
+            def fault_hook(batch_idx):
+                active = faults.fire("ckpt_write", batch=batch_idx)
+                if active is not None:
+                    active.wrap(lambda: None)()
+
+        writer = None
+        if ckpt is not None and depth > 1:
+            # Checkpoint serialization + checksumming on a bounded
+            # background writer; flush() below is the commit barrier.
+            writer = AsyncCheckpointWriter(
+                ckpt, max_pending=depth, fault_hook=fault_hook
+            )
+
+        n_src = len(sources)
+
+        def finalize(batch_idx, batch, payload, resumed):
+            if resumed:
+                return payload  # (rows, pred) host arrays from the ckpt
+            # A SINGLE-batch solve keeps device-backend rows resident
+            # on device (at RMAT-22 scale rows must never be forced to
+            # host wholesale). Multi-batch solves STREAM each batch to
+            # host: the batching exists because all rows together
+            # exceed the device budget (suggested_source_batch), so
+            # accumulating device buffers across batches would defeat
+            # it. Checkpointing (host .npz) forces the download either
+            # way.
+            row, pred = payload.dist, payload.pred
+            if ckpt is not None or len(batch) < n_src:
+                row, pred = self._download_rows(dgraph, row, pred)
+                if ckpt is not None:
+                    if writer is not None:
+                        writer.submit(batch_idx, batch, row, pred=pred)
+                    else:
+                        checked_save(
+                            ckpt, batch_idx, batch, row, pred=pred,
+                            fault_hook=fault_hook,
+                        )
+            return row, pred
+
+        def stage_async(res):
+            # Start the D2H DMA the moment the rows pass the sanity
+            # guard — it then runs under the next batch's compute.
+            self.backend.stage_rows_async(res.dist, res.pred)
+
         rows: list[np.ndarray] = []
         preds: list[np.ndarray] = []
-        for batch_idx, batch, payload, resumed in self._resilient_batches(
+        gen = self._resilient_batches(
             dgraph, sources, stats, with_pred=with_pred,
-            try_resume=try_resume,
-        ):
-            if resumed:
-                row, pred = payload
-            else:
-                # A SINGLE-batch solve keeps device-backend rows resident
-                # on device (at RMAT-22 scale rows must never be forced to
-                # host wholesale). Multi-batch solves STREAM each batch to
-                # host: the batching exists because all rows together
-                # exceed the device budget (suggested_source_batch), so
-                # accumulating device buffers across batches would defeat
-                # it. Checkpointing (host .npz) forces the download either
-                # way.
-                row, pred = payload.dist, payload.pred
-                if ckpt is not None or len(batch) < len(sources):
-                    row, pred = self._download_rows(dgraph, row, pred)
-                    if ckpt is not None:
-                        ckpt.save(batch_idx, batch, row, pred=pred)
-            rows.append(row)
-            if with_pred:
-                preds.append(pred)
+            try_resume=try_resume, finalize=finalize,
+            stage_async=stage_async,
+        )
+        try:
+            for batch_idx, batch, (row, pred), resumed in gen:
+                rows.append(row)
+                if with_pred:
+                    preds.append(pred)
+            if writer is not None:
+                # Commit barrier: resume semantics require every batch on
+                # disk before this solve can report success. Blocked time
+                # here is the pipeline's residual serial cost.
+                t0 = time.perf_counter()
+                writer.flush()
+                wait = time.perf_counter() - t0
+                stats.ckpt_wait_s += wait
+                stats.overlap_saved_s += max(0.0, writer.busy_s - wait)
+        finally:
+            gen.close()
+            if writer is not None:
+                # Teardown drains queued commits (completed batches stay
+                # resumable even when the solve is dying) without raising
+                # over the original error.
+                writer.close()
         dist = rows[0] if len(rows) == 1 else np.concatenate(rows, axis=0)
         if not with_pred:
             return dist, None

@@ -4,14 +4,28 @@ The unit of recovery is the source batch: each completed batch of distance
 rows is written as an ``.npz`` keyed by batch index plus a hash of the
 sources it covers; resuming skips batches whose file exists and matches.
 Survives preemption mid-APSP (relevant for RMAT-22-scale runs on TPU pods).
+
+:class:`AsyncCheckpointWriter` (the round-9 pipeline) moves the
+serialization + checksumming + fsync of each commit onto a bounded
+background writer thread so the solve's critical path only pays an
+enqueue; the ``flush()`` barrier preserves resume semantics (the solve
+does not return success until every commit landed), and a writer failure
+surfaces as ``SolveCorruptionError`` on the next ``submit``/``flush`` —
+never silent loss. Atomicity is unchanged: a write that dies mid-file
+leaves only a ``.tmp.npz`` that ``load``/``completed_batches`` ignore.
 """
 
 from __future__ import annotations
 
 import hashlib
+import queue
+import threading
+import time
 from pathlib import Path
 
 import numpy as np
+
+from paralleljohnson_tpu.utils.resilience import SolveCorruptionError
 
 
 def _sources_digest(sources: np.ndarray) -> str:
@@ -114,3 +128,145 @@ class BatchCheckpointer:
             # a crashed save leaves rows_*.tmp.npz — never published, not done
             if not p.name.endswith(".tmp.npz")
         )
+
+
+def checked_save(
+    ckpt: BatchCheckpointer,
+    batch_idx: int,
+    sources: np.ndarray,
+    rows: np.ndarray,
+    *,
+    pred: np.ndarray | None = None,
+    fault_hook=None,
+) -> None:
+    """One checkpoint commit with the ``"ckpt_write"`` fault-injection
+    point in front of it; ANY failure (injected or real — disk full,
+    permission, serialization) surfaces as :class:`SolveCorruptionError`
+    so a lost commit is always diagnosable, never silent. Shared by the
+    serial (pipeline_depth=1) inline path and the background writer so
+    both depths exercise identical failure semantics."""
+    try:
+        if fault_hook is not None:
+            fault_hook(batch_idx)
+        ckpt.save(batch_idx, sources, rows, pred=pred)
+    except BaseException as e:  # noqa: BLE001 — re-raised, classified
+        raise SolveCorruptionError(
+            f"checkpoint write failed for batch {batch_idx}: "
+            f"{type(e).__name__}: {e} (the batch is NOT committed; "
+            "resume will recompute it)"
+        ) from e
+
+
+class AsyncCheckpointWriter:
+    """Bounded background checkpoint writer (round-9 pipeline).
+
+    ``submit`` enqueues one batch commit and returns immediately (it
+    blocks only when ``max_pending`` commits are already queued — the
+    backpressure that bounds host-memory carry); a single daemon worker
+    drains the queue FIFO through :func:`checked_save`. ``flush`` is the
+    barrier callers run before declaring the solve complete: it waits
+    for the queue to drain and re-raises the first worker failure. A
+    failure also re-raises on the next ``submit`` so a dead writer can
+    never silently swallow later batches. ``close`` stops the worker
+    after draining what is already queued (good rows still commit even
+    when the solve is dying of an unrelated error — completed work stays
+    resumable) and never raises.
+
+    ``fault_hook(batch_idx)``: optional ``"ckpt_write"`` fault-injection
+    point, fired on the WRITER thread so an injected death happens
+    mid-commit exactly like a real one. ``busy_s`` accumulates worker
+    busy time for the solver's overlap accounting.
+    """
+
+    def __init__(
+        self,
+        ckpt: BatchCheckpointer,
+        *,
+        max_pending: int = 2,
+        fault_hook=None,
+    ) -> None:
+        self.ckpt = ckpt
+        self.fault_hook = fault_hook
+        self.busy_s = 0.0
+        self.saved = 0
+        self._exc: BaseException | None = None
+        self._closed = False
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(max_pending)))
+        self._worker = threading.Thread(
+            target=self._loop, name="pj-ckpt-writer", daemon=True
+        )
+        self._worker.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                batch_idx, sources, rows, pred = item
+                t0 = time.perf_counter()
+                try:
+                    checked_save(
+                        self.ckpt, batch_idx, sources, rows, pred=pred,
+                        fault_hook=self.fault_hook,
+                    )
+                    self.saved += 1
+                except BaseException as e:  # noqa: BLE001 — relayed
+                    if self._exc is None:
+                        self._exc = e
+                finally:
+                    self.busy_s += time.perf_counter() - t0
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        e = self._exc
+        if isinstance(e, SolveCorruptionError):
+            raise e
+        raise SolveCorruptionError(
+            f"background checkpoint writer failed: {type(e).__name__}: {e}"
+        ) from e
+
+    def submit(
+        self,
+        batch_idx: int,
+        sources: np.ndarray,
+        rows: np.ndarray,
+        *,
+        pred: np.ndarray | None = None,
+    ) -> None:
+        """Enqueue one commit (blocks on backpressure; raises the stored
+        writer failure instead of queueing onto a dead writer)."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointWriter is closed")
+        while True:
+            if self._exc is not None:
+                self._raise_pending()
+            try:
+                self._q.put((batch_idx, sources, rows, pred), timeout=0.05)
+                return
+            except queue.Full:
+                continue
+
+    def flush(self) -> None:
+        """Barrier: every submitted commit is on disk (or the first
+        failure re-raises). Run before a checkpointed solve returns."""
+        self._q.join()
+        if self._exc is not None:
+            self._raise_pending()
+
+    def close(self) -> None:
+        """Drain what is queued, stop the worker, never raise (teardown
+        path: an unrelated solve error must not be masked, and completed
+        rows should still commit so resume can use them)."""
+        if self._closed:
+            return
+        self._closed = True
+        while True:
+            try:
+                self._q.put(None, timeout=0.1)
+                break
+            except queue.Full:
+                if not self._worker.is_alive():
+                    return
+        self._worker.join(timeout=60.0)

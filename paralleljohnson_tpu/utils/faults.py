@@ -18,6 +18,15 @@ Attempt counting is per (stage, batch) key and lives on the plan, so the
 schedule is a pure function of the call sequence — replaying the same
 solve replays the same failures (no wall-clock randomness anywhere).
 
+Stages with injection points: ``"fanout"`` / ``"bellman_ford"`` /
+``"batch_apsp"`` (compute, via ``resilience.run_stage``),
+``"sharded_fanout"`` (inside the collective path), and — round-9
+pipeline — ``"download"`` (the staged D2H materialization of a batch's
+rows, also via ``run_stage``) and ``"ckpt_write"`` (fired on the
+checkpoint writer thread mid-commit, surfacing as
+``SolveCorruptionError``; a killed commit leaves only an uncommitted
+``.tmp.npz``, so resume recomputes exactly that batch).
+
 Kinds:
 - ``"oom"``     raises :class:`InjectedOOMError` (a ``MemoryError``
                 subclass — classified by ``resilience.is_oom_error``

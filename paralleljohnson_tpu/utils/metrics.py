@@ -52,6 +52,24 @@ class SolverStats:
       a fan-out runs; equals the starting size when nothing degraded).
     abandoned_stages: "<stage>[#b<batch>]@a<attempt>" tags of every
       attempt the watchdog logged-and-abandoned past its deadline.
+    download_s: total wall-clock in the fan-out's download/finalize
+      stage (host materialization of device rows + checkpoint submit,
+      or the streaming reducer). In serial mode (pipeline_depth=1) this
+      sits on the critical path; pipelined it runs behind the next
+      batch's compute.
+    ckpt_wait_s: wall-clock the MAIN solve thread spent blocked on the
+      pipeline — draining staged downloads and the checkpoint writer's
+      flush barrier. This is the residual serial cost of the off-path
+      work; near-zero means the overlap fully hid it.
+    overlap_saved_s: estimated wall-clock the pipeline removed from the
+      critical path (background stage busy time minus the time the main
+      thread actually waited on it, floored at 0 per batch). Exactly 0
+      for pipeline_depth=1 — the bench proof that an improvement came
+      from overlap, not noise.
+    final_pipeline_depth: the in-flight window the fan-out ENDED at
+      (None until a fan-out runs): the configured pipeline_depth, or 1
+      after an OOM collapsed the window (which happens BEFORE any batch
+      halving).
     """
 
     phase_seconds: dict = dataclasses.field(
@@ -70,6 +88,10 @@ class SolverStats:
     oom_degradations: int = 0
     final_batch: int | None = None
     abandoned_stages: list = dataclasses.field(default_factory=list)
+    download_s: float = 0.0
+    ckpt_wait_s: float = 0.0
+    overlap_saved_s: float = 0.0
+    final_pipeline_depth: int | None = None
 
     def accumulate(self, result, phase: str) -> None:
         """Fold one KernelResult into the totals."""
@@ -113,6 +135,10 @@ class SolverStats:
             "oom_degradations": self.oom_degradations,
             "final_batch": self.final_batch,
             "abandoned_stages": list(self.abandoned_stages),
+            "download_s": self.download_s,
+            "ckpt_wait_s": self.ckpt_wait_s,
+            "overlap_saved_s": self.overlap_saved_s,
+            "final_pipeline_depth": self.final_pipeline_depth,
             "total_seconds": self.total_seconds,
             "edges_relaxed_per_sec": self.edges_relaxed_per_second(),
         }

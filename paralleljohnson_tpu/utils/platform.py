@@ -22,3 +22,41 @@ def honor_cpu_platform_request() -> bool:
 
     jax.config.update("jax_platforms", "cpu")
     return True
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Enable jax's persistent compilation cache (ROADMAP item 1: the
+    3x-retry TPU measurement passes must stop re-paying Mosaic/XLA
+    compiles inside precious tunnel windows).
+
+    Opt-in resolution: an explicit ``cache_dir``
+    (``SolverConfig.compilation_cache_dir`` / ``--compilation-cache-dir``)
+    wins, else the ``PJ_COMPILE_CACHE`` env var; neither set is a no-op.
+    jax also honors ``JAX_COMPILATION_CACHE_DIR`` natively — this hook
+    exists so the CLI / SolverConfig path gets the cache without
+    exporting jax-internal env vars, and so a broken cache dir degrades
+    to a warning instead of killing the solve. Returns the resolved
+    directory (created if needed) or None.
+    """
+    path = cache_dir or os.environ.get("PJ_COMPILE_CACHE") or None
+    if not path:
+        return None
+    from pathlib import Path
+
+    try:
+        p = Path(path).expanduser()
+        p.mkdir(parents=True, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", str(p))
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        import warnings
+
+        warnings.warn(
+            f"could not enable the jax compilation cache at {path!r}: "
+            f"{type(e).__name__}: {e}; compiles will not persist",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return None
+    return str(p)

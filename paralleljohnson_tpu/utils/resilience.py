@@ -28,6 +28,14 @@ three mechanisms the solver composes:
 Deterministic fault injection (``utils.faults``) threads through
 ``run_stage`` so every retry / degrade / checkpoint-resume path is
 exercised in tier-1 CPU tests without a TPU.
+
+The round-9 pipelined fan-out composes with all of it: the staged D2H
+download runs through ``run_stage`` too (stage ``"download"`` — same
+retry policy, same watchdog deadline, same fault plan as compute), the
+checkpoint writer's failures surface as :class:`SolveCorruptionError`
+(``utils.checkpoint.AsyncCheckpointWriter``), and an OOM first collapses
+the in-flight window to 1 — giving back the extra [B, V] carry — before
+:class:`OOMDegrader` halves the batch.
 """
 
 from __future__ import annotations

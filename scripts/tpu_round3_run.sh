@@ -6,7 +6,15 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 unset JAX_PLATFORMS XLA_FLAGS
+# Persistent compile cache (ROADMAP item 1): each stage retries up to 3x
+# and the watcher retries the whole pass 3x — without the cache every
+# retry re-pays the Mosaic/XLA compiles inside the tunnel window. Both
+# spellings are exported: jax honors JAX_COMPILATION_CACHE_DIR natively,
+# and PJ_COMPILE_CACHE routes through SolverConfig.compilation_cache_dir
+# (utils.platform.enable_compilation_cache) for code paths that build
+# their own backends.
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-$HOME/.cache/pj_jax_cache}
+export PJ_COMPILE_CACHE=${PJ_COMPILE_CACHE:-$JAX_COMPILATION_CACHE_DIR}
 LOG=${1:-/tmp/tpu_round3_run.log}
 : > "$LOG"
 
@@ -83,6 +91,11 @@ run 900 pred-route python scripts/tpu_pred_micro.py
 # 4d) the recorded pred bench row (route tag + legacy-sweep speedup in
 #     the detail column)
 run 900 jax-dimacs-pred python -m paralleljohnson_tpu.cli bench dimacs_ny_scrambled_pred --backend jax --preset full --update-baseline BASELINE.md
+
+# 4e) pipelined fan-out bench row (round-9 tentpole): serial vs depth-2
+#     on the same graph; the detail column's overlap_saved_s attributes
+#     any win to compute/transfer/IO overlap rather than noise
+run 1800 jax-rmat-pipelined python -m paralleljohnson_tpu.cli bench rmat_apsp_pipelined --backend jax --preset full --update-baseline BASELINE.md
 
 # 5) driver metric (should reflect the blocked kernel now)
 run 1200 bench.py python bench.py

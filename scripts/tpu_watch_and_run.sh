@@ -15,6 +15,11 @@
 set -u
 cd "$(dirname "$0")/.."
 unset JAX_PLATFORMS XLA_FLAGS
+# Compile cache survives pass retries AND watcher restarts (ROADMAP
+# item 1): the probe below and every pass attempt reuse compiled
+# kernels instead of re-paying Mosaic/XLA inside the healthy window.
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-$HOME/.cache/pj_jax_cache}
+export PJ_COMPILE_CACHE=${PJ_COMPILE_CACHE:-$JAX_COMPILATION_CACHE_DIR}
 LOG=${1:-/tmp/tpu_watch.log}
 PASS_LOG=${2:-/tmp/tpu_round3_run.log}
 : > "$LOG"

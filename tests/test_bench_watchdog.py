@@ -21,6 +21,7 @@ def _fake_child(body: str) -> list[str]:
     return [sys.executable, "-u", "-c", body]
 
 
+@pytest.mark.slow  # ~6 s of real watchdog sleep (round-9 suite-budget trim; the parse path is also covered by test_final_result_preferred_over_rungs)
 def test_result_kept_despite_teardown_hang():
     """A parsed RESULT survives a child that wedges after printing it."""
     measured = bench._tpu_attempt(
@@ -143,6 +144,7 @@ def test_clean_crash_after_rung_keeps_rung_and_retry_flag():
     assert measured.get("_clean_failure") and measured["edges_per_sec"] == 7.0
 
 
+@pytest.mark.slow  # ~5 s real first-stage deadline (round-9 suite-budget trim; the kill path stays in tier-1 via test_stage_timeout_kills_silent_child)
 def test_first_stage_timeout_fails_fast():
     """A child that never emits its first heartbeat (wedged device init)
     must be cut off by the tighter first-stage deadline, not the full

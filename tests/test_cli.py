@@ -76,6 +76,7 @@ def test_bad_graph_spec_exit_code(capsys):
     assert "error:" in capsys.readouterr().err
 
 
+@pytest.mark.slow  # ~6 s of jax.profiler trace IO (round-9 suite-budget trim; device_trace itself stays in tier-1 via test_utils.py::test_device_trace_writes_profile)
 def test_cli_profile_and_log_stats(tmp_path, capsys):
     """--profile writes a device trace; --log-stats emits one JSON line."""
     import json

@@ -1,0 +1,385 @@
+"""Pipelined fan-out engine tests (ISSUE 4 tentpole).
+
+The double-buffered pipeline must change SCHEDULING only, never results:
+dist/pred rows are bitwise-identical at any depth, checkpoint-resume
+survives a run killed mid-download or mid-ckpt-write, OOM gives back the
+in-flight window before the PR-3 batch-halving schedule engages, and a
+background-writer failure surfaces as SolveCorruptionError — never
+silent loss. Everything runs on CPU via the deterministic fault plan.
+"""
+
+import pathlib
+import warnings
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import (
+    Fault,
+    FaultPlan,
+    ParallelJohnsonSolver,
+    SolveCorruptionError,
+    SolverConfig,
+)
+from paralleljohnson_tpu.graphs import erdos_renyi
+from paralleljohnson_tpu.utils.checkpoint import (
+    AsyncCheckpointWriter,
+    BatchCheckpointer,
+)
+
+
+def _solver(**kw):
+    kw.setdefault("backend", "numpy")
+    kw.setdefault("retry_backoff_s", 0.001)
+    return ParallelJohnsonSolver(SolverConfig(**kw))
+
+
+@pytest.fixture
+def graph():
+    return erdos_renyi(48, 0.1, seed=2)
+
+
+# -- bitwise equivalence: pipelined vs serial --------------------------------
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+@pytest.mark.parametrize("depth", [2, 3])
+def test_pipelined_matches_serial_dist_and_pred(graph, backend, depth):
+    """Acceptance: depth>1 dist AND pred rows are bitwise-equal to the
+    strictly serial depth=1 run, across backends/routes."""
+    ref = _solver(
+        backend=backend, source_batch_size=8, pipeline_depth=1
+    ).solve(graph, predecessors=True)
+    r = _solver(
+        backend=backend, source_batch_size=8, pipeline_depth=depth
+    ).solve(graph, predecessors=True)
+    np.testing.assert_array_equal(np.asarray(ref.dist), np.asarray(r.dist))
+    np.testing.assert_array_equal(
+        np.asarray(ref.predecessors), np.asarray(r.predecessors)
+    )
+    assert r.stats.final_pipeline_depth == depth
+
+
+def test_pipelined_solve_reduced_matches_serial(graph):
+    ref = _solver(
+        backend="jax", source_batch_size=8, pipeline_depth=1
+    ).solve_reduced(graph, reduce_rows="checksum")
+    r = _solver(
+        backend="jax", source_batch_size=8, pipeline_depth=2
+    ).solve_reduced(graph, reduce_rows="checksum")
+    assert len(ref.values) == len(r.values)
+    for a, b in zip(ref.values, r.values):
+        assert float(a) == float(b)  # bitwise: scheduling, not arithmetic
+
+
+def test_pipelined_checkpoint_files_identical(graph, tmp_path):
+    """The committed checkpoint set is identical serial vs pipelined —
+    same filenames (batch index + sources digest), same row bytes."""
+    d1, d2 = tmp_path / "serial", tmp_path / "pipe"
+    _solver(
+        source_batch_size=8, pipeline_depth=1, checkpoint_dir=str(d1)
+    ).solve(graph)
+    _solver(
+        source_batch_size=8, pipeline_depth=2, checkpoint_dir=str(d2)
+    ).solve(graph)
+    f1 = sorted(p.relative_to(d1) for p in d1.rglob("rows_*.npz"))
+    f2 = sorted(p.relative_to(d2) for p in d2.rglob("rows_*.npz"))
+    assert f1 == f2 and len(f1) == 6
+    for rel in f1:
+        with np.load(d1 / rel) as a, np.load(d2 / rel) as b:
+            np.testing.assert_array_equal(a["rows"], b["rows"])
+
+
+def test_serial_results_unchanged_under_fault_injection(graph):
+    """Acceptance: depth=1 bitwise-matches the serial engine under fault
+    injection — an injected transient fanout error consumes a retry and
+    changes nothing else."""
+    ref = _solver(source_batch_size=16, pipeline_depth=1).solve(graph)
+    plan = FaultPlan([Fault(stage="fanout", kind="error", attempt=1, batch=1)])
+    r = _solver(
+        source_batch_size=16, pipeline_depth=1, fault_plan=plan
+    ).solve(graph)
+    assert r.stats.retries == 1
+    assert r.stats.overlap_saved_s == 0.0  # serial saves nothing
+    np.testing.assert_array_equal(ref.matrix, r.matrix)
+
+
+# -- OOM: window collapses before the batch halves ---------------------------
+
+
+def test_oom_under_depth2_collapses_window_before_halving(graph):
+    """Acceptance: the FIRST OOM at depth=2 gives back the in-flight
+    window (depth -> 1) at the SAME batch size; only a repeat OOM walks
+    the PR-3 halving schedule."""
+    ref = _solver(source_batch_size=16, pipeline_depth=1).solve(graph)
+    plan = FaultPlan([Fault(stage="fanout", kind="oom", attempt=1, batch=0)])
+    r = _solver(
+        source_batch_size=16, pipeline_depth=2, fault_plan=plan
+    ).solve(graph)
+    assert r.stats.final_pipeline_depth == 1   # window collapsed...
+    assert r.stats.oom_degradations == 0       # ...before any halving
+    assert r.stats.final_batch == 16
+    np.testing.assert_array_equal(ref.matrix, r.matrix)
+
+    plan = FaultPlan([
+        Fault(stage="fanout", kind="oom", attempt=1, batch=0, times=2),
+    ])
+    r = _solver(
+        source_batch_size=16, pipeline_depth=2, fault_plan=plan
+    ).solve(graph)
+    assert r.stats.final_pipeline_depth == 1
+    assert r.stats.oom_degradations == 1       # second OOM halves
+    assert r.stats.final_batch == 8
+    np.testing.assert_array_equal(ref.matrix, r.matrix)
+    assert [k for (_, _, _, k) in plan.fired] == ["oom", "oom"]
+
+
+# -- killed mid-download / mid-ckpt-write: resume equivalence ----------------
+
+
+def test_run_killed_mid_download_resumes_exactly(graph, tmp_path):
+    """Acceptance: a FaultPlan that kills the run in the staged download
+    leaves only committed batches; the resumed run skips them and the
+    final dist/pred are bitwise-equal to an uninterrupted solve."""
+    ref = _solver(source_batch_size=8, pipeline_depth=1).solve(
+        graph, predecessors=True
+    )
+    cfg = dict(
+        source_batch_size=8, pipeline_depth=2, checkpoint_dir=str(tmp_path)
+    )
+    plan = FaultPlan([
+        Fault(stage="download", kind="error", attempt=1, batch=1, times=99),
+    ])
+    with pytest.raises(SolveCorruptionError, match="download"):
+        _solver(fault_plan=plan, **cfg).solve(graph, predecessors=True)
+    committed = list(tmp_path.rglob("rows_*.npz"))
+    assert committed  # batch 0 landed before the death
+    res = _solver(**cfg).solve(graph, predecessors=True)
+    assert res.stats.batches_resumed == len(committed)
+    np.testing.assert_array_equal(np.asarray(ref.dist), np.asarray(res.dist))
+    np.testing.assert_array_equal(
+        np.asarray(ref.predecessors), np.asarray(res.predecessors)
+    )
+
+
+def test_run_killed_mid_ckpt_write_resumes_exactly(graph, tmp_path):
+    """Acceptance: a FaultPlan that kills the background checkpoint
+    writer surfaces as SolveCorruptionError (not silent loss); the
+    poisoned batch is NOT committed (atomic tmp+rename) and the resumed
+    run recomputes it bitwise."""
+    ref = _solver(source_batch_size=8, pipeline_depth=1).solve(graph)
+    cfg = dict(
+        source_batch_size=8, pipeline_depth=2, checkpoint_dir=str(tmp_path)
+    )
+    plan = FaultPlan([
+        Fault(stage="ckpt_write", kind="error", attempt=1, batch=1, times=99),
+    ])
+    with pytest.raises(SolveCorruptionError, match="ckpt|checkpoint"):
+        _solver(fault_plan=plan, **cfg).solve(graph)
+    committed = {
+        int(p.name.split("_")[1]) for p in tmp_path.rglob("rows_*.npz")
+    }
+    assert 1 not in committed  # the killed commit never published
+    res = _solver(**cfg).solve(graph)
+    assert res.stats.batches_resumed == len(committed)
+    np.testing.assert_array_equal(ref.matrix, res.matrix)
+
+
+def test_ckpt_write_fault_surfaces_at_depth1_too(graph, tmp_path):
+    """The serial path runs the SAME ckpt_write fault point, so depth=1
+    exercises identical failure semantics."""
+    plan = FaultPlan([
+        Fault(stage="ckpt_write", kind="error", attempt=1, batch=0, times=99),
+    ])
+    with pytest.raises(SolveCorruptionError, match="checkpoint write"):
+        _solver(
+            source_batch_size=8, pipeline_depth=1,
+            checkpoint_dir=str(tmp_path), fault_plan=plan,
+        ).solve(graph)
+
+
+def test_transient_download_fault_consumes_a_retry(graph, tmp_path):
+    plan = FaultPlan([Fault(stage="download", kind="error", attempt=1, batch=1)])
+    ref = _solver(source_batch_size=8, pipeline_depth=1).solve(graph)
+    r = _solver(
+        source_batch_size=8, pipeline_depth=2,
+        checkpoint_dir=str(tmp_path), fault_plan=plan,
+    ).solve(graph)
+    assert r.stats.retries == 1
+    np.testing.assert_array_equal(ref.matrix, r.matrix)
+
+
+def test_watchdog_deadline_covers_staged_download(graph, tmp_path):
+    """The staged transfer runs under the same watchdog as compute: a
+    wedged download is logged-and-abandoned, then retried."""
+    plan = FaultPlan([
+        Fault(stage="download", kind="timeout", attempt=1, batch=1,
+              sleep_s=5.0),
+    ])
+    ref = _solver(source_batch_size=8, pipeline_depth=1).solve(graph)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        r = _solver(
+            source_batch_size=8, pipeline_depth=2,
+            checkpoint_dir=str(tmp_path), fault_plan=plan,
+            stage_deadline_s=0.1,
+        ).solve(graph)
+    assert any(t.startswith("download#b1@") for t in r.stats.abandoned_stages)
+    assert r.stats.retries == 1
+    np.testing.assert_array_equal(ref.matrix, r.matrix)
+
+
+# -- AsyncCheckpointWriter unit ----------------------------------------------
+
+
+def test_async_writer_flush_barrier_and_busy_accounting(tmp_path):
+    ckpt = BatchCheckpointer(tmp_path)
+    w = AsyncCheckpointWriter(ckpt, max_pending=2)
+    rows = np.arange(12, dtype=np.float32).reshape(3, 4)
+    for i in range(4):
+        w.submit(i, np.arange(3) + i, rows + i)
+    w.flush()  # barrier: all four commits are on disk when this returns
+    assert ckpt.completed_batches() == [0, 1, 2, 3]
+    assert w.saved == 4 and w.busy_s >= 0.0
+    loaded, _ = ckpt.load(2, np.arange(3) + 2)
+    np.testing.assert_array_equal(loaded, rows + 2)
+    w.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        w.submit(9, np.arange(3), rows)
+
+
+def test_async_writer_exception_surfaces_on_submit_and_flush(tmp_path):
+    def boom(batch_idx):
+        raise RuntimeError("disk on fire")
+
+    w = AsyncCheckpointWriter(
+        BatchCheckpointer(tmp_path), max_pending=1, fault_hook=boom
+    )
+    rows = np.zeros((2, 2), np.float32)
+    w.submit(0, np.arange(2), rows)
+    with pytest.raises(SolveCorruptionError, match="disk on fire"):
+        w.flush()
+    # ...and a dead writer refuses further work loudly, not silently
+    with pytest.raises(SolveCorruptionError):
+        for i in range(1, 50):
+            w.submit(i, np.arange(2), rows)
+    w.close()
+    assert not list(pathlib.Path(tmp_path).rglob("rows_*.npz"))
+
+
+# -- memory model / config / CLI surface -------------------------------------
+
+
+def test_suggested_batch_budgets_pipeline_carry(monkeypatch):
+    """Each extra in-flight slot holds one more [B, V] block (two with
+    pred): depth=2 divides the budget by 7 (11 with pred) instead of the
+    serial 6 (9)."""
+    from paralleljohnson_tpu.backends import get_backend
+
+    g = erdos_renyi(64, 0.1, seed=12)
+    budget = 132 * 64 * 4  # 132 [B=1, V=64] f32 blocks
+
+    def batch_at(depth, with_pred=False):
+        be = get_backend(
+            "jax", SolverConfig(mesh_shape=(1,), pipeline_depth=depth)
+        )
+        monkeypatch.setattr(
+            type(be), "_memory_budget_bytes", lambda self: budget
+        )
+        return be.suggested_source_batch(be.upload(g), with_pred=with_pred)
+
+    assert batch_at(1) == 22                   # 132 // 6
+    assert batch_at(2) == 18                   # 132 // 7
+    assert batch_at(3) == 16                   # 132 // 8
+    assert batch_at(1, with_pred=True) == 14   # 132 // 9
+    assert batch_at(2, with_pred=True) == 12   # 132 // 11
+
+
+def test_pipeline_depth_validation():
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        SolverConfig(pipeline_depth=0)
+
+
+def test_single_batch_device_rows_stay_resident(graph):
+    """A single-batch jax solve must keep its rows on device at any
+    depth — the pipeline never forces an RMAT-22-scale wholesale
+    download."""
+    res = _solver(backend="jax", pipeline_depth=2).solve(graph)
+    assert not isinstance(res.dist, np.ndarray)
+
+
+def test_stats_and_cli_expose_pipeline_fields(capsys):
+    import json
+
+    from paralleljohnson_tpu import cli
+
+    rc = cli.main([
+        "solve", "er:n=32,p=0.1", "--backend", "numpy",
+        "--batch-size", "8", "--pipeline-depth", "3", "--json",
+    ])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["final_pipeline_depth"] == 3
+    assert payload["overlap_saved_s"] >= 0.0
+    assert "download_s" in payload and "ckpt_wait_s" in payload
+
+    assert cli.main(["info", "--json"]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["pipeline"]["pipeline_depth"] == 2
+    assert info["pipeline"]["compilation_cache_env"] == "PJ_COMPILE_CACHE"
+
+
+def test_compilation_cache_opt_in(tmp_path, monkeypatch):
+    """SolverConfig.compilation_cache_dir / PJ_COMPILE_CACHE enable the
+    persistent jax compile cache; unset leaves jax's default alone."""
+    import jax
+
+    from paralleljohnson_tpu.utils.platform import enable_compilation_cache
+
+    monkeypatch.delenv("PJ_COMPILE_CACHE", raising=False)
+    assert enable_compilation_cache(None) is None
+
+    d = tmp_path / "cc"
+    assert enable_compilation_cache(str(d)) == str(d)
+    assert d.is_dir()
+    assert jax.config.jax_compilation_cache_dir == str(d)
+
+    d2 = tmp_path / "cc_env"
+    monkeypatch.setenv("PJ_COMPILE_CACHE", str(d2))
+    assert enable_compilation_cache(None) == str(d2)
+    assert jax.config.jax_compilation_cache_dir == str(d2)
+
+    # the backend applies the config knob at construction
+    d3 = tmp_path / "cc_cfg"
+    monkeypatch.delenv("PJ_COMPILE_CACHE", raising=False)
+    from paralleljohnson_tpu.backends import get_backend
+
+    get_backend("jax", SolverConfig(compilation_cache_dir=str(d3)))
+    assert jax.config.jax_compilation_cache_dir == str(d3)
+
+
+def test_overlap_saved_with_slow_ckpt_sink(graph, tmp_path, monkeypatch):
+    """A deliberately slowed checkpoint sink: the pipelined run hides
+    the sink behind compute (overlap_saved_s > 0) while the serial run
+    pays it on the critical path — the tier-1-scale version of
+    scripts/pipeline_offchip_validation.py."""
+    import time as _time
+
+    real_save = BatchCheckpointer.save
+
+    def slow_save(self, batch_idx, sources, rows, *, pred=None):
+        _time.sleep(0.05)
+        return real_save(self, batch_idx, sources, rows, pred=pred)
+
+    monkeypatch.setattr(BatchCheckpointer, "save", slow_save)
+    serial = _solver(
+        source_batch_size=8, pipeline_depth=1,
+        checkpoint_dir=str(tmp_path / "s"),
+    ).solve(graph)
+    pipe = _solver(
+        source_batch_size=8, pipeline_depth=2,
+        checkpoint_dir=str(tmp_path / "p"),
+    ).solve(graph)
+    assert serial.stats.overlap_saved_s == 0.0
+    assert pipe.stats.overlap_saved_s > 0.0
+    np.testing.assert_array_equal(serial.matrix, pipe.matrix)

@@ -238,9 +238,11 @@ def test_zero_weight_tight_cycle_forced_extraction_raises():
 
 def test_suggested_source_batch_accounts_for_pred_block(monkeypatch):
     """with_pred batches must budget the extra int32 [B, V] pred block +
-    extraction carries: 9 [B, V]-equivalents instead of 6."""
+    extraction carries: 9 [B, V]-equivalents instead of 6
+    (pipeline_depth=1 here isolates the pred accounting; the pipeline
+    carry on top is covered in tests/test_pipeline.py)."""
     g = erdos_renyi(64, 0.1, seed=12)
-    be = get_backend("jax", SolverConfig(mesh_shape=(1,)))
+    be = get_backend("jax", SolverConfig(mesh_shape=(1,), pipeline_depth=1))
     dg = be.upload(g)
     monkeypatch.setattr(
         type(be), "_memory_budget_bytes", lambda self: 90 * 64 * 4

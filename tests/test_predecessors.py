@@ -49,6 +49,7 @@ def test_johnson_predecessors_negative_weights():
     _check_paths(g, res)
 
 
+@pytest.mark.slow  # ~5 s of 8-device compile (round-9 suite-budget trim; sharded pred extraction stays in tier-1 via test_pred_extraction.py::test_sharded_pred_extraction_route_and_validity)
 def test_sharded_predecessors_match_local():
     g = erdos_renyi(48, 0.1, seed=5)
     sources = np.arange(16)

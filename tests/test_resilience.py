@@ -93,12 +93,16 @@ def test_fault_plan_attempt_counting():
 def test_oom_degradation_schedule(graph):
     """32 -> 16 -> 8: two injected OOMs on the first batch walk the
     halving schedule; the solve completes batched at 8 with results
-    identical to the uninterrupted run."""
+    identical to the uninterrupted run. pipeline_depth=1 pins the pure
+    PR-3 schedule — at depth > 1 the first OOM collapses the pipeline
+    window instead (tests/test_pipeline.py)."""
     ref = _solver(source_batch_size=32).solve(graph)
     plan = FaultPlan([
         Fault(stage="fanout", kind="oom", attempt=1, batch=0, times=2),
     ])
-    r = _solver(source_batch_size=32, fault_plan=plan).solve(graph)
+    r = _solver(
+        source_batch_size=32, pipeline_depth=1, fault_plan=plan
+    ).solve(graph)
     assert r.stats.oom_degradations == 2
     assert r.stats.final_batch == 8
     np.testing.assert_array_equal(ref.matrix, r.matrix)
@@ -124,9 +128,9 @@ def test_degrade_resume_with_predecessors(graph):
     uninterrupted run."""
     ref = _solver(source_batch_size=16).solve(graph, predecessors=True)
     plan = FaultPlan([Fault(stage="fanout", kind="oom", attempt=1, batch=1)])
-    r = _solver(source_batch_size=16, fault_plan=plan).solve(
-        graph, predecessors=True
-    )
+    r = _solver(
+        source_batch_size=16, pipeline_depth=1, fault_plan=plan
+    ).solve(graph, predecessors=True)
     assert r.stats.oom_degradations >= 1
     assert r.stats.final_batch == 8
     np.testing.assert_array_equal(np.asarray(ref.dist), np.asarray(r.dist))
@@ -141,9 +145,9 @@ def test_oom_degradation_solve_reduced(graph):
         graph, reduce_rows="checksum"
     )
     plan = FaultPlan([Fault(stage="fanout", kind="oom", attempt=1, batch=0)])
-    r = _solver(source_batch_size=16, fault_plan=plan).solve_reduced(
-        graph, reduce_rows="checksum"
-    )
+    r = _solver(
+        source_batch_size=16, pipeline_depth=1, fault_plan=plan
+    ).solve_reduced(graph, reduce_rows="checksum")
     assert r.stats.oom_degradations == 1
     assert np.isclose(float(sum(ref.values)), float(sum(r.values)))
 
@@ -182,7 +186,8 @@ def test_checkpoint_plus_degrade_same_run(graph, tmp_path):
     ref = _solver(source_batch_size=16).solve(graph)
     plan = FaultPlan([Fault(stage="fanout", kind="oom", attempt=1, batch=1)])
     r = _solver(
-        source_batch_size=16, checkpoint_dir=str(tmp_path), fault_plan=plan
+        source_batch_size=16, pipeline_depth=1,
+        checkpoint_dir=str(tmp_path), fault_plan=plan,
     ).solve(graph)
     assert r.stats.oom_degradations == 1
     np.testing.assert_array_equal(ref.matrix, r.matrix)
@@ -391,7 +396,8 @@ def test_sharded_oom_degrades_batch_not_mesh():
         Fault(stage="sharded_fanout", kind="oom", attempt=1),
     ])
     solver = ParallelJohnsonSolver(
-        SolverConfig(backend="jax", fault_plan=plan, source_batch_size=64)
+        SolverConfig(backend="jax", fault_plan=plan, source_batch_size=64,
+                     pipeline_depth=1)
     )
     res = solver.solve(g)
     assert res.stats.oom_degradations == 1
