@@ -28,6 +28,12 @@ from paralleljohnson_tpu.utils.resilience import (
     SolveCorruptionError,
     StageAbandonedError,
 )
+from paralleljohnson_tpu.utils.telemetry import (
+    HeartbeatReporter,
+    Telemetry,
+    Tracer,
+    write_prom_metrics,
+)
 
 __version__ = "0.1.0"
 
@@ -39,8 +45,12 @@ __all__ = [
     "ConvergenceError",
     "Fault",
     "FaultPlan",
+    "HeartbeatReporter",
     "NegativeCycleError",
     "RetryPolicy",
+    "Telemetry",
+    "Tracer",
+    "write_prom_metrics",
     "SolveCorruptionError",
     "StageAbandonedError",
     "ValidationError",

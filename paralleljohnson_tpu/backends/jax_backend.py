@@ -961,6 +961,13 @@ class JaxBackend(Backend):
             forced=self.config.gauss_seidel is True,
         )
 
+    @property
+    def _telemetry(self):
+        """The solve's ``utils.telemetry.Telemetry`` (or None) — handed to
+        the ``parallel.mesh`` sharded entry points so each collective
+        dispatch lands as a span on the flight record."""
+        return getattr(self.config, "telemetry", None)
+
     def _shard_fault_hook(self):
         """Fault-injection hook handed to the ``parallel.mesh`` sharded
         entry points (``config.fault_plan`` stage ``"sharded_fanout"``):
@@ -1049,6 +1056,7 @@ class JaxBackend(Backend):
                         1, -(-dgraph.src.shape[0] // emesh.devices.size)
                     ),
                     fault_hook=self._shard_fault_hook(),
+                    telemetry=self._telemetry,
                 )
                 iters = int(iters)
                 improving = bool(improving)
@@ -1371,6 +1379,7 @@ class JaxBackend(Backend):
                         mesh, res.dist, sources_d,
                         dgraph.src, dgraph.dst, dgraph.weights,
                         num_nodes=dgraph.num_nodes, edge_chunk=chunk,
+                        telemetry=self._telemetry,
                     )
                 else:
                     chunk = _edge_chunk_for(b, dgraph.src.shape[0])
@@ -1432,6 +1441,7 @@ class JaxBackend(Backend):
                     num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
                     with_pred=True, with_row_sweeps=True,
                     fault_hook=self._shard_fault_hook(),
+                    telemetry=self._telemetry,
                 )
             except Exception as e:
                 return self._sharded_fallback(
@@ -1555,6 +1565,7 @@ class JaxBackend(Backend):
                         offsets=lay["offsets"], max_iter=max_iter,
                         num_entries=lay["num_entries"],
                         fault_hook=self._shard_fault_hook(),
+                        telemetry=self._telemetry,
                     )
                     dia_route = "dia-sharded"
                 else:
@@ -1613,6 +1624,7 @@ class JaxBackend(Backend):
                         max_outer=max_iter, inner_cap=self.config.gs_inner_cap,
                         real_edges_host=bundle["real_edges_host"],
                         fault_hook=self._shard_fault_hook(),
+                        telemetry=self._telemetry,
                     )
                     gs_route = "gs-sharded"
                 else:
@@ -1659,6 +1671,7 @@ class JaxBackend(Backend):
                     num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
                     layout=layout, with_row_sweeps=True,
                     fault_hook=self._shard_fault_hook(),
+                    telemetry=self._telemetry,
                 )
             except Exception as e:
                 return self._sharded_fallback(e, dgraph, sources)
@@ -1683,6 +1696,7 @@ class JaxBackend(Backend):
                     num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
                     layout=layout, with_row_sweeps=True,
                     fault_hook=self._shard_fault_hook(),
+                    telemetry=self._telemetry,
                 )
             except Exception as e:
                 return self._sharded_fallback(e, dgraph, sources)

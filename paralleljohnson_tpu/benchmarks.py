@@ -21,14 +21,24 @@ sanity), ``full`` (the attested benchmark sizes).
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 import json
 import time
+from pathlib import Path
 from typing import Callable
 
 import numpy as np
 
 from paralleljohnson_tpu.utils.reductions import finite_frac as _finite_frac
+
+# Per-config telemetry for a bench pass (``run(..., telemetry_dir=...)``):
+# a contextvar because the config callables build their own solvers via
+# ``_solver`` — the pass sets it around each config so every solver the
+# config constructs records into that config's flight file.
+_BENCH_TELEMETRY: contextvars.ContextVar = contextvars.ContextVar(
+    "pj_bench_telemetry", default=None
+)
 
 
 @dataclasses.dataclass
@@ -93,6 +103,7 @@ def _solver(backend: str, **cfg_overrides):
     from paralleljohnson_tpu.config import SolverConfig
     from paralleljohnson_tpu.solver import ParallelJohnsonSolver
 
+    cfg_overrides.setdefault("telemetry", _BENCH_TELEMETRY.get())
     return ParallelJohnsonSolver(SolverConfig(backend=backend, **cfg_overrides))
 
 
@@ -426,7 +437,15 @@ def run(
     *,
     backend: str = "jax",
     preset: str = "mini",
+    telemetry_dir: str | None = None,
 ) -> list[BenchRecord]:
+    """Run the named configs. ``telemetry_dir`` (CLI ``--trace-dir``)
+    turns on the flight recorder per config: each config's solvers
+    record spans/events into ``<dir>/flight-<config>.jsonl`` (plus a
+    Chrome trace on success and a shared ``heartbeat.json``), a
+    succeeding row folds the telemetry summary into its detail, and a
+    FAILED row's detail points at the flight-recorder path — the first
+    artifact to read on a dead TPU pass."""
     if preset not in _PRESETS:
         raise ValueError(f"preset must be one of {_PRESETS}, got {preset!r}")
     names = names or list(CONFIGS)
@@ -437,9 +456,23 @@ def run(
         )
     records = []
     for name in names:
+        tel = None
+        token = None
+        if telemetry_dir is not None:
+            from paralleljohnson_tpu.utils.telemetry import Telemetry
+
+            tel = Telemetry.create(
+                trace_dir=telemetry_dir,
+                heartbeat_file=Path(telemetry_dir) / "heartbeat.json",
+                label=name,
+            )
+            tel.progress(config=name, preset=preset, backend=backend)
+            token = _BENCH_TELEMETRY.set(tel)
         t0 = time.perf_counter()
         try:
             rec = CONFIGS[name](backend, preset)
+            if tel is not None:
+                rec.detail["telemetry"] = tel.summary()
         except Exception as e:  # noqa: BLE001 — survive per-config death
             # A failed config writes a PARTIAL row tagged with the reason
             # instead of aborting the whole pass: every on-chip window
@@ -451,6 +484,19 @@ def run(
                 time.perf_counter() - t0, 0, 0.0, 1,
                 {"failed": f"{type(e).__name__}: {e}"},
             )
+            if tel is not None:
+                tel.event("config_failed", config=name,
+                          error=type(e).__name__)
+                if tel.tracer.flight_path is not None:
+                    # The row is partial; the flight record has the story.
+                    rec.detail["flight_recorder"] = str(
+                        tel.tracer.flight_path
+                    )
+        finally:
+            if token is not None:
+                _BENCH_TELEMETRY.reset(token)
+            if tel is not None:
+                tel.close()
         try:
             rec.detail["platform"] = _platform()
         except Exception:  # noqa: BLE001 — a dead device must not kill the row

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
@@ -119,6 +120,53 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="write a jax.profiler (Perfetto/XProf) trace here")
     p.add_argument("--log-stats", action="store_true",
                    help="emit a structured JSON stats line to stderr")
+    _add_observability(p)
+
+
+def _add_observability(p: argparse.ArgumentParser) -> None:
+    """Flight-recorder telemetry flags (README "Observability"). Defaults
+    come from PJ_TRACE_DIR / PJ_HEARTBEAT_FILE / PJ_HEARTBEAT_INTERVAL /
+    PJ_METRICS_FILE so the TPU pass scripts can turn telemetry on for
+    every stage with four exports instead of editing every command."""
+    p.add_argument("--trace-dir", default=os.environ.get("PJ_TRACE_DIR"),
+                   metavar="DIR",
+                   help="flight-recorder directory: incremental span/event "
+                        "JSONL (readable even after a killed worker) plus "
+                        "a Perfetto-loadable Chrome trace on completion "
+                        "(default: $PJ_TRACE_DIR if set, else off)")
+    p.add_argument("--heartbeat-file",
+                   default=os.environ.get("PJ_HEARTBEAT_FILE"),
+                   metavar="JSON",
+                   help="atomically rewrite this progress JSON every "
+                        "--heartbeat-interval seconds (stage/batch/attempt, "
+                        "batches done, host RSS, device HBM in-use); a "
+                        "stale mtime means hung, a fresh one progressing "
+                        "(default: $PJ_HEARTBEAT_FILE if set, else off)")
+    p.add_argument("--heartbeat-interval", type=float,
+                   default=float(os.environ.get("PJ_HEARTBEAT_INTERVAL",
+                                                "5.0")),
+                   metavar="SECONDS",
+                   help="heartbeat rewrite period (default: "
+                        "$PJ_HEARTBEAT_INTERVAL or 5)")
+    p.add_argument("--metrics-file",
+                   default=os.environ.get("PJ_METRICS_FILE"),
+                   metavar="PROM",
+                   help="write the solve's stats as a Prometheus textfile "
+                        "(pjtpu_edges_relaxed_total, pjtpu_solve_seconds, "
+                        "pjtpu_retries_total, ...) for scrape-based "
+                        "monitoring (default: $PJ_METRICS_FILE if set)")
+
+
+def _telemetry(args, label: str):
+    """Build the Telemetry façade the flags describe (None when off)."""
+    from paralleljohnson_tpu.utils.telemetry import Telemetry
+
+    return Telemetry.create(
+        trace_dir=args.trace_dir,
+        heartbeat_file=args.heartbeat_file,
+        heartbeat_interval_s=args.heartbeat_interval,
+        label=label,
+    )
 
 
 def _config(args) -> "SolverConfig":
@@ -154,10 +202,20 @@ def _config(args) -> "SolverConfig":
         retry_attempts=args.retry_attempts,
         stage_deadline_s=args.stage_deadline,
         min_source_batch=args.min_source_batch,
+        telemetry=_telemetry(args, args.command),
     )
 
 
+def _write_metrics(stats, args) -> None:
+    if getattr(args, "metrics_file", None):
+        from paralleljohnson_tpu.utils.telemetry import write_prom_metrics
+
+        write_prom_metrics(stats, args.metrics_file,
+                           labels={"command": args.command})
+
+
 def _report(res, args) -> None:
+    _write_metrics(res.stats, args)
     if getattr(args, "log_stats", False):
         from paralleljohnson_tpu.utils.profiling import log_stats
 
@@ -259,6 +317,12 @@ def main(argv: list[str] | None = None) -> int:
                          choices=["smoke", "mini", "full"])
     p_bench.add_argument("--update-baseline", default=None, metavar="MD",
                          help="rewrite the measured table in this BASELINE.md")
+    p_bench.add_argument("--trace-dir",
+                         default=os.environ.get("PJ_TRACE_DIR"), metavar="DIR",
+                         help="per-config flight recorder: span/event JSONL "
+                              "+ Chrome trace + heartbeat.json under DIR; "
+                              "failed rows reference their flight file "
+                              "(default: $PJ_TRACE_DIR if set, else off)")
 
     p_info = sub.add_parser(
         "info",
@@ -290,7 +354,8 @@ def main(argv: list[str] | None = None) -> int:
         from paralleljohnson_tpu import benchmarks
 
         records = benchmarks.run(
-            args.configs or None, backend=args.backend, preset=args.preset
+            args.configs or None, backend=args.backend, preset=args.preset,
+            telemetry_dir=args.trace_dir,
         )
         for r in records:
             print(r.as_json_line())
@@ -304,6 +369,9 @@ def main(argv: list[str] | None = None) -> int:
         from paralleljohnson_tpu.config import SolverConfig as _SC
 
         _dc = _SC()
+        _dc_heartbeat_default = float(
+            os.environ.get("PJ_HEARTBEAT_INTERVAL", "5.0")
+        )
         info = {
             "backends": available_backends(),
             "loaders": available_loaders(),
@@ -324,6 +392,33 @@ def main(argv: list[str] | None = None) -> int:
                     "(floor min_source_batch), resume from the failed "
                     "batch"
                 ),
+            },
+            # The flight-recorder telemetry surface (README
+            # "Observability"): what each knob produces and the offline
+            # tool that reads a dead run's artifacts.
+            "observability": {
+                "flags": {
+                    "--trace-dir": "incremental span/event JSONL "
+                                   "(flight-<cmd>.jsonl, readable after a "
+                                   "kill) + Perfetto trace-<cmd>.json",
+                    "--heartbeat-file": "progress JSON atomically "
+                                        "rewritten every interval "
+                                        "(stage/batch, batches_done, host "
+                                        "RSS, device HBM in-use)",
+                    "--heartbeat-interval": _dc_heartbeat_default,
+                    "--metrics-file": "Prometheus textfile export "
+                                      "(pjtpu_* counters/gauges)",
+                },
+                "env_defaults": ["PJ_TRACE_DIR", "PJ_HEARTBEAT_FILE",
+                                 "PJ_HEARTBEAT_INTERVAL", "PJ_METRICS_FILE"],
+                "offline_reader": "python scripts/trace_summary.py "
+                                  "<flight.jsonl> [--chrome trace.json]",
+                "hung_vs_progressing": (
+                    "a heartbeat mtime older than PJ_HEARTBEAT_STALE_S "
+                    "means hung (retry now); fresh means progressing "
+                    "(the TPU pass extends the stage deadline)"
+                ),
+                "disabled_by_default": True,
             },
             # The pipelined fan-out defaults (README "Pipelined
             # execution"): per-solve download_s / ckpt_wait_s /
@@ -382,7 +477,9 @@ def main(argv: list[str] | None = None) -> int:
 
     from paralleljohnson_tpu.utils.profiling import device_trace
 
+    cfg = None
     try:
+        cfg = _config(args)
         if args.command == "solve":
             g = load_graph(args.graph)
             sources = None
@@ -410,9 +507,10 @@ def main(argv: list[str] | None = None) -> int:
                     )
                     return 1
                 with device_trace(args.profile):
-                    red = ParallelJohnsonSolver(_config(args)).solve_reduced(
+                    red = ParallelJohnsonSolver(cfg).solve_reduced(
                         g, sources=sources, reduce_rows=args.reduce
                     )
+                _write_metrics(red.stats, args)
                 if args.log_stats:
                     from paralleljohnson_tpu.utils.profiling import log_stats
 
@@ -427,14 +525,14 @@ def main(argv: list[str] | None = None) -> int:
                       f"{args.reduce}: {vals}")
                 return 0
             with device_trace(args.profile):
-                res = ParallelJohnsonSolver(_config(args)).solve(
+                res = ParallelJohnsonSolver(cfg).solve(
                     g, sources=sources, predecessors=args.predecessors
                 )
             _report(res, args)
         elif args.command == "sssp":
             g = load_graph(args.graph)
             with device_trace(args.profile):
-                res = ParallelJohnsonSolver(_config(args)).sssp(
+                res = ParallelJohnsonSolver(cfg).sssp(
                     g, args.source, predecessors=args.predecessors
                 )
             _report(res, args)
@@ -446,8 +544,9 @@ def main(argv: list[str] | None = None) -> int:
             graphs = random_graph_batch(args.count, args.nodes, args.p,
                                         seed=args.seed)
             with device_trace(args.profile):
-                results = ParallelJohnsonSolver(_config(args)).solve_batch(graphs)
+                results = ParallelJohnsonSolver(cfg).solve_batch(graphs)
             stats = results[0].stats
+            _write_metrics(stats, args)
             if args.log_stats:
                 from paralleljohnson_tpu.utils.profiling import log_stats
 
@@ -471,6 +570,13 @@ def main(argv: list[str] | None = None) -> int:
     except (ValueError, FileNotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    finally:
+        # Stop the heartbeat, export the Chrome trace, close the flight
+        # file — ALSO on the error paths: the telemetry of a failed
+        # solve is the artifact the flags exist for.
+        tel = getattr(cfg, "telemetry", None)
+        if tel is not None:
+            tel.close()
     return 0
 
 

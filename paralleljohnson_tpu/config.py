@@ -169,6 +169,14 @@ class SolverConfig:
         deterministic failures into solve stages — the harness tier-1
         CPU tests use to exercise every retry/degrade/resume path
         without a TPU. Production solves leave it None.
+      telemetry: a ``utils.telemetry.Telemetry`` (or None, the default)
+        — the flight-recorder subsystem: nested spans + events appended
+        to a JSONL that survives a killed worker, a heartbeat JSON
+        atomically rewritten every few seconds (stage/batch progress,
+        host RSS, device HBM in-use), and a Chrome-trace export. Off by
+        default and near-free when off (all call sites route through
+        ``telemetry.NULL_TELEMETRY``). CLI: ``--trace-dir`` /
+        ``--heartbeat-file`` / ``--heartbeat-interval``.
     """
 
     backend: str = "jax"
@@ -201,6 +209,7 @@ class SolverConfig:
     stage_deadline_s: float | None = None
     min_source_batch: int = 8
     fault_plan: object | None = None
+    telemetry: object | None = None
 
     @property
     def np_dtype(self):

@@ -51,6 +51,10 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
     )
 
 from paralleljohnson_tpu.ops import relax
+# Gives every sharded entry point a keyword-only ``telemetry=`` argument
+# wrapping the call in a flight-recorder span (utils.telemetry) — the
+# host-side wall of each collective dispatch lands on the solve's trace.
+from paralleljohnson_tpu.utils.telemetry import traced
 
 
 def make_mesh(
@@ -248,6 +252,7 @@ def _edge_sharded_bf_fn(mesh: Mesh, num_nodes: int, max_iter: int,
     return jax.jit(mapped)
 
 
+@traced("edge_sharded_bellman_ford")
 def edge_sharded_bellman_ford(
     mesh: Mesh,
     dist0,
@@ -317,6 +322,7 @@ def _sharded_gs_fanout_fn(mesh: Mesh, v_pad: int, vb: int, halo: int,
     return jax.jit(mapped)
 
 
+@traced("sharded_gs_fanout")
 def sharded_gs_fanout(
     mesh: Mesh,
     sources,
@@ -401,6 +407,7 @@ def _sharded_dia_fanout_fn(mesh: Mesh, num_nodes: int, offsets: tuple,
     return jax.jit(mapped)
 
 
+@traced("sharded_dia_fanout")
 def sharded_dia_fanout(
     mesh: Mesh,
     sources,
@@ -464,6 +471,7 @@ def _sharded_tight_pred_fn(mesh: Mesh, num_nodes: int, edge_chunk: int):
     return jax.jit(mapped)
 
 
+@traced("sharded_tight_pred")
 def sharded_tight_pred(
     mesh: Mesh,
     dist,
@@ -572,6 +580,7 @@ def _sharded_fanout_2d_fn(mesh: Mesh, num_nodes: int, max_iter: int,
     return jax.jit(mapped)
 
 
+@traced("sharded_fanout_2d")
 def sharded_fanout_2d(
     mesh: Mesh,
     sources,
@@ -628,6 +637,7 @@ def sharded_fanout_2d(
     return out
 
 
+@traced("sharded_fanout")
 def sharded_fanout(
     mesh: Mesh,
     sources,

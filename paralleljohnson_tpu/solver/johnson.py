@@ -30,6 +30,7 @@ from paralleljohnson_tpu.graphs import CSRGraph, stack_graphs
 from paralleljohnson_tpu.utils import resilience
 from paralleljohnson_tpu.utils.metrics import SolverStats, phase_timer
 from paralleljohnson_tpu.utils.reductions import finite_checksum, xp as _xp
+from paralleljohnson_tpu.utils.telemetry import resolve as _resolve_telemetry
 
 
 def _transient_error(e: BaseException) -> bool:
@@ -171,6 +172,11 @@ class ParallelJohnsonSolver:
     ) -> None:
         self.config = config or SolverConfig()
         self.backend = backend or get_backend(self.config.backend, self.config)
+        # The flight-recorder façade every stage is wired through
+        # (utils.telemetry). Defaults to the falsy NULL_TELEMETRY, whose
+        # span/event/progress are allocation-free no-ops — the disabled
+        # path must stay near-free.
+        self._tel = _resolve_telemetry(self.config.telemetry)
 
     # -- public API ---------------------------------------------------------
 
@@ -195,26 +201,30 @@ class ParallelJohnsonSolver:
             else np.asarray(sources, np.int64)
         )
 
-        with phase_timer(stats, "upload"):
-            dgraph = self.backend.upload(graph)
+        tel = self._tel
+        tel.progress(op="solve", sources_total=len(sources))
+        with tel.span("solve", op="solve", n_sources=len(sources),
+                      predecessors=predecessors):
+            with phase_timer(stats, "upload", tel):
+                dgraph = self.backend.upload(graph)
 
-        h, dgraph = self._potentials(graph, dgraph, stats)
+            h, dgraph = self._potentials(graph, dgraph, stats)
 
-        # Phase 2 — batched fan-out over sources.
-        with phase_timer(stats, "fanout"):
-            dist, pred = self._fanout(
-                dgraph, sources, stats, with_pred=predecessors
-            )
+            # Phase 2 — batched fan-out over sources.
+            with phase_timer(stats, "fanout", tel):
+                dist, pred = self._fanout(
+                    dgraph, sources, stats, with_pred=predecessors
+                )
 
-        # Phase 3 — un-reweight: d(u,v) = d'(u,v) - h(u) + h(v).
-        with phase_timer(stats, "unreweight"):
-            if graph.has_negative_weights:
-                dist = _unreweight(dist, h, sources)
-        result = SolveResult(dist=dist, sources=sources, potentials=h,
-                             stats=stats, predecessors=pred)
-        if self.config.validate:
-            self._validate(graph, result)
-        return result
+            # Phase 3 — un-reweight: d(u,v) = d'(u,v) - h(u) + h(v).
+            with phase_timer(stats, "unreweight", tel):
+                if graph.has_negative_weights:
+                    dist = _unreweight(dist, h, sources)
+            result = SolveResult(dist=dist, sources=sources, potentials=h,
+                                 stats=stats, predecessors=pred)
+            if self.config.validate:
+                self._validate(graph, result)
+            return result
 
     def solve_reduced(
         self,
@@ -266,7 +276,16 @@ class ParallelJohnsonSolver:
             if sources is None
             else np.asarray(sources, np.int64)
         )
-        with phase_timer(stats, "upload"):
+        tel = self._tel
+        tel.progress(op="solve_reduced", sources_total=len(sources))
+        with tel.span("solve", op="solve_reduced", n_sources=len(sources)):
+            return self._solve_reduced_body(
+                graph, sources, stats, reduce_rows
+            )
+
+    def _solve_reduced_body(self, graph, sources, stats, reduce_rows):
+        tel = self._tel
+        with phase_timer(stats, "upload", tel):
             dgraph = self.backend.upload(graph)
         h, dgraph = self._potentials(graph, dgraph, stats)
         values = []
@@ -292,7 +311,7 @@ class ParallelJohnsonSolver:
                 self.backend.clear_caches(dgraph)
             return reduce_rows(rows, batch)
 
-        with phase_timer(stats, "fanout"):
+        with phase_timer(stats, "fanout", tel):
             # Same resilience driver as solve(): retry/watchdog per batch,
             # OOM -> collapse the pipeline window, then halve-and-resume
             # (streaming mode has no checkpoint — reduced values
@@ -311,9 +330,16 @@ class ParallelJohnsonSolver:
         """Standalone Bellman-Ford SSSP (config BASELINE.json:8) — negative
         weights allowed, no reweighting."""
         stats = SolverStats()
-        with phase_timer(stats, "upload"):
+        tel = self._tel
+        tel.progress(op="sssp", source=int(source))
+        with tel.span("solve", op="sssp", source=int(source)):
+            return self._sssp_body(graph, source, predecessors, stats)
+
+    def _sssp_body(self, graph, source, predecessors, stats):
+        tel = self._tel
+        with phase_timer(stats, "upload", tel):
             dgraph = self.backend.upload(graph)
-        with phase_timer(stats, "bellman_ford"):
+        with phase_timer(stats, "bellman_ford", tel):
             bf = self._run_bf(
                 dgraph, stats, source=int(source), pred=predecessors
             )
@@ -346,12 +372,15 @@ class ParallelJohnsonSolver:
             )
         stats = SolverStats()
         sources = np.asarray(sources, np.int64)
-        with phase_timer(stats, "upload"):
-            dgraph = self.backend.upload(graph)
-        with phase_timer(stats, "fanout"):
-            dist, pred = self._fanout(
-                dgraph, sources, stats, with_pred=predecessors
-            )
+        tel = self._tel
+        tel.progress(op="multi_source", sources_total=len(sources))
+        with tel.span("solve", op="multi_source", n_sources=len(sources)):
+            with phase_timer(stats, "upload", tel):
+                dgraph = self.backend.upload(graph)
+            with phase_timer(stats, "fanout", tel):
+                dist, pred = self._fanout(
+                    dgraph, sources, stats, with_pred=predecessors
+                )
         return SolveResult(
             dist=dist,
             sources=sources,
@@ -365,7 +394,7 @@ class ParallelJohnsonSolver:
         graph in one vectorized run when the backend supports it."""
         stats = SolverStats()
         try:
-            with phase_timer(stats, "batch_apsp"):
+            with phase_timer(stats, "batch_apsp", self._tel):
                 batch = stack_graphs(graphs)
                 res = resilience.run_stage(
                     lambda: self.backend.batch_apsp(batch),
@@ -374,6 +403,7 @@ class ParallelJohnsonSolver:
                     stats=stats,
                     faults=self.config.fault_plan,
                     retryable=_transient_error,
+                    telemetry=self._tel,
                 )
         except NotImplementedError:
             return [self.solve(g) for g in graphs]
@@ -428,6 +458,7 @@ class ParallelJohnsonSolver:
             stats=stats,
             faults=faults,
             retryable=retryable,
+            telemetry=self._tel,
         )
         stats.accumulate(bf, phase="bellman_ford")
         if faults is not None:
@@ -446,7 +477,7 @@ class ParallelJohnsonSolver:
         demand. No negative weights -> h = 0 is already valid, skip."""
         if not graph.has_negative_weights:
             return np.zeros(graph.num_nodes, graph.dtype), dgraph
-        with phase_timer(stats, "bellman_ford"):
+        with phase_timer(stats, "bellman_ford", self._tel):
             bf = self._run_bf(dgraph, stats, source=None)
         if bf.negative_cycle:
             raise NegativeCycleError(
@@ -458,7 +489,7 @@ class ParallelJohnsonSolver:
                 "raise SolverConfig.max_iterations (or leave it None)"
             )
         h = bf.dist
-        with phase_timer(stats, "reweight"):
+        with phase_timer(stats, "reweight", self._tel):
             dgraph = self.backend.reweight(dgraph, h)
         return h, dgraph
 
@@ -541,6 +572,7 @@ class ParallelJohnsonSolver:
         """
         policy = self.config.retry_policy()
         faults = self.config.fault_plan
+        tel = self._tel
         degrader = resilience.OOMDegrader(
             self.backend,
             dgraph,
@@ -557,31 +589,54 @@ class ParallelJohnsonSolver:
         n = len(sources)
         pos = 0
         batch_idx = 0
+        done = 0
+        tel.progress(
+            sources_total=n, sources_done=0, batches_done=0,
+            current_batch_size=degrader.batch_size, pipeline_depth=depth,
+        )
         # In-flight finalize window: (batch_idx, batch, payload, future).
         pending: collections.deque = collections.deque()
         worker = None
 
-        def run_finalize(bi, b, payload, resumed):
+        def mark_done() -> None:
+            """Heartbeat progress after one batch fully finalizes — the
+            liveness signal the TPU watcher keys stage deadlines off."""
+            nonlocal done
+            done += 1
+            tel.progress(
+                batches_done=done, sources_done=pos,
+                current_batch_size=degrader.batch_size,
+                retries=stats.retries,
+                oom_degradations=stats.oom_degradations,
+                pipeline_depth=depth,
+            )
+
+        def run_finalize(bi, b, payload, resumed, parent=None):
             """One finalize, timed, through the resilience layer (stage
             "download": retry + watchdog + fault injection). Returns
-            (result, duration) so the drain can price the overlap."""
+            (result, duration) so the drain can price the overlap.
+            ``parent``: span to nest under when running on the pipeline
+            worker thread (captured at submit on the main thread)."""
             if finalize is None:
                 return payload, 0.0
-            if resumed:
-                return finalize(bi, b, payload, True), 0.0
-            t0 = time.perf_counter()
-            out = resilience.run_stage(
-                lambda: finalize(bi, b, payload, False),
-                stage="download",
-                policy=policy,
-                stats=stats,
-                faults=faults,
-                batch=bi,
-                retryable=_transient_error,
-            )
-            dur = time.perf_counter() - t0
-            stats.download_s += dur
-            return out, dur
+            with tel.span("finalize", batch=bi, parent=parent,
+                          resumed=resumed):
+                if resumed:
+                    return finalize(bi, b, payload, True), 0.0
+                t0 = time.perf_counter()
+                out = resilience.run_stage(
+                    lambda: finalize(bi, b, payload, False),
+                    stage="download",
+                    policy=policy,
+                    stats=stats,
+                    faults=faults,
+                    batch=bi,
+                    retryable=_transient_error,
+                    telemetry=tel,
+                )
+                dur = time.perf_counter() - t0
+                stats.download_s += dur
+                return out, dur
 
         def collapse_window() -> None:
             """OOM step 0: go serial — give back the in-flight [B, V]
@@ -590,6 +645,8 @@ class ParallelJohnsonSolver:
             nonlocal depth
             depth = 1
             stats.final_pipeline_depth = 1
+            tel.event("window_collapse")
+            tel.progress(pipeline_depth=1)
             try:
                 self.backend.clear_caches(dgraph)
             except Exception:  # noqa: BLE001 — hygiene must not mask
@@ -638,12 +695,16 @@ class ParallelJohnsonSolver:
                     cached = try_resume(batch_idx, batch)
                     if cached is not None:
                         while pending:  # keep yields in batch order
-                            yield drain_one()
+                            drained = drain_one()
+                            mark_done()
+                            yield drained
                         stats.batches_resumed += 1
+                        tel.event("batch_resumed", batch=batch_idx)
                         out, _ = run_finalize(batch_idx, batch, cached, True)
-                        yield batch_idx, batch, out, True
                         pos += len(batch)
                         batch_idx += 1
+                        mark_done()
+                        yield batch_idx - 1, batch, out, True
                         continue
 
                 def kernel(b=batch):
@@ -660,16 +721,28 @@ class ParallelJohnsonSolver:
                         faults=faults,
                         batch=batch_idx,
                         retryable=_transient_error,
+                        telemetry=tel,
                     )
                 except Exception as e:
                     if resilience.is_oom_error(e):
                         if depth > 1:
                             while pending:  # commit the good in-flight work
-                                yield drain_one()
+                                drained = drain_one()
+                                mark_done()
+                                yield drained
                             collapse_window()
                             continue  # retry THIS batch serially, same size
+                        old_size = degrader.batch_size
                         degrader.degrade(e)  # re-raises at the floor
                         stats.oom_degradations += 1
+                        tel.event(
+                            "oom_degrade", batch=batch_idx,
+                            old_batch=old_size, new_batch=degrader.batch_size,
+                        )
+                        tel.progress(
+                            oom_degradations=stats.oom_degradations,
+                            current_batch_size=degrader.batch_size,
+                        )
                         continue  # re-split THIS range smaller; pos unchanged
                     raise
                 stats.accumulate(res, phase="fanout")
@@ -695,20 +768,26 @@ class ParallelJohnsonSolver:
                             max_workers=1, thread_name_prefix="pj-pipeline"
                         )
                     fut = worker.submit(
-                        run_finalize, batch_idx, batch, res, False
+                        run_finalize, batch_idx, batch, res, False,
+                        tel.current_span_id(),
                     )
                     pending.append((batch_idx, batch, res, fut))
                     pos += len(batch)
                     batch_idx += 1
                     while len(pending) >= depth:
-                        yield drain_one()
+                        drained = drain_one()
+                        mark_done()
+                        yield drained
                 else:
                     out, _ = run_finalize(batch_idx, batch, res, False)
-                    yield batch_idx, batch, out, False
                     pos += len(batch)
                     batch_idx += 1
+                    mark_done()
+                    yield batch_idx - 1, batch, out, False
             while pending:
-                yield drain_one()
+                drained = drain_one()
+                mark_done()
+                yield drained
             stats.final_batch = degrader.batch_size
         finally:
             if worker is not None:
@@ -779,7 +858,8 @@ class ParallelJohnsonSolver:
             # Checkpoint serialization + checksumming on a bounded
             # background writer; flush() below is the commit barrier.
             writer = AsyncCheckpointWriter(
-                ckpt, max_pending=depth, fault_hook=fault_hook
+                ckpt, max_pending=depth, fault_hook=fault_hook,
+                telemetry=self._tel,
             )
 
         n_src = len(sources)
@@ -802,10 +882,11 @@ class ParallelJohnsonSolver:
                     if writer is not None:
                         writer.submit(batch_idx, batch, row, pred=pred)
                     else:
-                        checked_save(
-                            ckpt, batch_idx, batch, row, pred=pred,
-                            fault_hook=fault_hook,
-                        )
+                        with self._tel.span("ckpt_write", batch=batch_idx):
+                            checked_save(
+                                ckpt, batch_idx, batch, row, pred=pred,
+                                fault_hook=fault_hook,
+                            )
             return row, pred
 
         def stage_async(res):

@@ -2,5 +2,19 @@
 
 from paralleljohnson_tpu.utils.checkpoint import BatchCheckpointer
 from paralleljohnson_tpu.utils.metrics import SolverStats, phase_timer
+from paralleljohnson_tpu.utils.telemetry import (
+    HeartbeatReporter,
+    Telemetry,
+    Tracer,
+    write_prom_metrics,
+)
 
-__all__ = ["BatchCheckpointer", "SolverStats", "phase_timer"]
+__all__ = [
+    "BatchCheckpointer",
+    "HeartbeatReporter",
+    "SolverStats",
+    "Telemetry",
+    "Tracer",
+    "phase_timer",
+    "write_prom_metrics",
+]

@@ -145,10 +145,17 @@ class SolverStats:
 
 
 @contextlib.contextmanager
-def phase_timer(stats: SolverStats, phase: str):
+def phase_timer(stats: SolverStats, phase: str, telemetry=None):
     """Times a phase; also opens a ``jax.named_scope``-style profiler scope
     when JAX is importable so device traces attribute kernels to phases
-    (SURVEY.md §5 tracing)."""
+    (SURVEY.md §5 tracing), and — when a telemetry object is threaded in
+    (``utils.telemetry``) — a flight-recorder span plus a heartbeat
+    stage update.
+
+    The accumulation is in a ``finally``: a phase whose body RAISES still
+    lands its elapsed time in ``phase_seconds``, so the flight record /
+    stats of a crashed solve show where the time went (previously the
+    failed phase silently vanished from the accounting)."""
     scope = contextlib.nullcontext()
     try:
         import jax
@@ -156,7 +163,15 @@ def phase_timer(stats: SolverStats, phase: str):
         scope = jax.named_scope(phase)
     except Exception:
         pass
+    tel_span = contextlib.nullcontext()
+    if telemetry:  # NULL_TELEMETRY is falsy — disabled skips entirely
+        telemetry.progress(stage=phase)
+        # "phase:" prefix: the fanout PHASE must not collide with the
+        # per-batch "fanout" stage spans nested inside it.
+        tel_span = telemetry.span(f"phase:{phase}", kind="phase")
     t0 = time.perf_counter()
-    with scope:
-        yield
-    stats.phase_seconds[phase] += time.perf_counter() - t0
+    try:
+        with scope, tel_span:
+            yield
+    finally:
+        stats.phase_seconds[phase] += time.perf_counter() - t0

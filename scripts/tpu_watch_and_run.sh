@@ -20,15 +20,28 @@ unset JAX_PLATFORMS XLA_FLAGS
 # kernels instead of re-paying Mosaic/XLA inside the healthy window.
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-$HOME/.cache/pj_jax_cache}
 export PJ_COMPILE_CACHE=${PJ_COMPILE_CACHE:-$JAX_COMPILATION_CACHE_DIR}
+# Flight-recorder telemetry (ISSUE 5): every CLI stage of the pass picks
+# these up as flag defaults (cli._add_observability), so a worker killed
+# mid-stage leaves a readable span JSONL + a heartbeat whose freshness
+# distinguishes hung from progressing (tpu_round3_run.sh keys stage
+# deadlines off it). Preserved into bench_artifacts/telemetry/ below.
+export PJ_TRACE_DIR=${PJ_TRACE_DIR:-/tmp/pj_telemetry}
+export PJ_HEARTBEAT_FILE=${PJ_HEARTBEAT_FILE:-$PJ_TRACE_DIR/heartbeat.json}
+export PJ_HEARTBEAT_INTERVAL=${PJ_HEARTBEAT_INTERVAL:-5}
+export PJ_METRICS_FILE=${PJ_METRICS_FILE:-$PJ_TRACE_DIR/pjtpu.prom}
+mkdir -p "$PJ_TRACE_DIR"
 LOG=${1:-/tmp/tpu_watch.log}
 PASS_LOG=${2:-/tmp/tpu_round3_run.log}
 : > "$LOG"
 echo "watcher start $(date -u +%H:%M:%S)" | tee -a "$LOG"
 
 emit_partial() {  # the partial pass log is evidence — never lose it
-  mkdir -p bench_artifacts
+  mkdir -p bench_artifacts bench_artifacts/telemetry
   cp "$PASS_LOG" "bench_artifacts/tpu_round5_pass.log" 2>/dev/null || true
   cp "$LOG" "bench_artifacts/tpu_watch.log" 2>/dev/null || true
+  # Flight JSONLs + last heartbeat + Chrome traces of every stage: the
+  # artifacts scripts/trace_summary.py reads when the window died.
+  cp -r "$PJ_TRACE_DIR"/. bench_artifacts/telemetry/ 2>/dev/null || true
 }
 trap emit_partial EXIT
 

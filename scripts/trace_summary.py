@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Offline flight-recorder reader — the FIRST thing to run on a dead TPU
+pass's artifacts (ISSUE 5 satellite; README "Observability").
+
+Reads a flight JSONL (``--trace-dir``'s ``flight-*.jsonl``, preserved by
+the TPU pass under ``bench_artifacts/telemetry/``) and prints:
+
+  - the per-stage / per-batch timeline (begin, duration, attempts,
+    status — spans still OPEN at death are flagged, which is exactly
+    where the process died);
+  - the slowest spans;
+  - every resilience event (retry / abandon / oom_degrade /
+    window_collapse / batch_resumed) in order;
+  - ``--chrome OUT.json``: a Perfetto-loadable Chrome-trace export of
+    the same records (validated before writing).
+
+No dependency on the package being importable beyond ``utils.telemetry``
+(pure python — safe to run on a machine with no jax).
+
+Usage:
+  python scripts/trace_summary.py bench_artifacts/telemetry/flight-solve.jsonl
+  python scripts/trace_summary.py flight.jsonl --chrome trace.json --top 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from paralleljohnson_tpu.utils.telemetry import (  # noqa: E402
+    chrome_trace_from_records,
+    validate_chrome_trace,
+)
+
+_RESILIENCE_EVENTS = (
+    "retry", "abandon", "oom_degrade", "window_collapse", "batch_resumed",
+    "config_failed",
+)
+
+
+def load_flight(path: str | Path) -> list[dict]:
+    """Parse a flight JSONL. Every line but possibly the LAST must parse:
+    writes are line-buffered and flushed, so only a kill mid-write can
+    leave one torn trailing line (tolerated; anything torn earlier is
+    reported loudly — that would mean real corruption)."""
+    lines = Path(path).read_text(encoding="utf-8").splitlines()
+    records: list[dict] = []
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                print(f"note: torn trailing line {i + 1} skipped "
+                      "(killed mid-write)", file=sys.stderr)
+                continue
+            raise ValueError(
+                f"{path}: corrupt record at line {i + 1} "
+                "(not the last line — this is not kill damage)"
+            )
+    return records
+
+
+def build_spans(records: list[dict]) -> list[dict]:
+    """Join begin/end records into one dict per span, in begin order.
+    Spans with no end carry ``open=True`` — the death markers."""
+    spans: dict[int, dict] = {}
+    order: list[int] = []
+    for r in records:
+        if r.get("type") == "span_begin":
+            spans[r["id"]] = {
+                "id": r["id"], "parent": r.get("parent"),
+                "name": r["name"], "begin": r["t"],
+                "thread": r.get("thread", "?"),
+                "attrs": r.get("attrs") or {},
+                "open": True, "status": None, "error": None, "dur": None,
+            }
+            order.append(r["id"])
+        elif r.get("type") == "span_end":
+            s = spans.get(r["id"])
+            if s is not None:
+                s["open"] = False
+                s["status"] = r.get("status", "ok")
+                s["error"] = r.get("error")
+                s["dur"] = r["t"] - s["begin"]
+    return [spans[i] for i in order]
+
+
+def _fmt_dur(s: dict) -> str:
+    if s["open"]:
+        return "   OPEN at death"
+    return f"{s['dur'] * 1e3:12.2f} ms"
+
+
+def print_summary(records: list[dict], *, top: int = 10,
+                  out=sys.stdout) -> None:
+    spans = build_spans(records)
+    events = [r for r in records if r.get("type") == "event"]
+    meta = next((r for r in records if r.get("type") == "meta"), {})
+    print(f"flight record: {len(spans)} spans, {len(events)} events, "
+          f"pid {meta.get('pid', '?')}", file=out)
+
+    open_spans = [s for s in spans if s["open"]]
+    if open_spans:
+        print(f"\n!! {len(open_spans)} span(s) OPEN at death — the process "
+              "died inside:", file=out)
+        for s in open_spans:
+            print(f"   [{s['begin']:10.3f}s] {s['name']}"
+                  f" {s['attrs']} (thread {s['thread']})", file=out)
+
+    print("\ntimeline (per-stage / per-batch):", file=out)
+    for s in spans:
+        batch = s["attrs"].get("batch")
+        attempt = s["attrs"].get("attempt")
+        tag = "".join(
+            f" {k}={v}" for k, v in (("batch", batch), ("attempt", attempt))
+            if v is not None
+        )
+        status = "" if s["status"] in (None, "ok") else f"  << {s['error']}"
+        print(f"  [{s['begin']:10.3f}s] {_fmt_dur(s)}  {s['name']}{tag}"
+              f"  ({s['thread']}){status}", file=out)
+
+    closed = sorted(
+        (s for s in spans if not s["open"]),
+        key=lambda s: s["dur"], reverse=True,
+    )
+    print(f"\nslowest {min(top, len(closed))} spans:", file=out)
+    for s in closed[:top]:
+        print(f"  {s['dur'] * 1e3:12.2f} ms  {s['name']} {s['attrs']}",
+              file=out)
+
+    resil = [e for e in events if e["name"] in _RESILIENCE_EVENTS]
+    print(f"\nresilience events ({len(resil)}):", file=out)
+    for e in resil:
+        print(f"  [{e['t']:10.3f}s] {e['name']} {e.get('attrs') or {}}",
+              file=out)
+    if not resil:
+        print("  (none — a clean run)", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a flight-recorder JSONL (pjtpu --trace-dir)"
+    )
+    ap.add_argument("flight", help="path to a flight-*.jsonl")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many slowest spans to list")
+    ap.add_argument("--chrome", default=None, metavar="OUT.json",
+                    help="also export a Perfetto-loadable Chrome trace")
+    args = ap.parse_args(argv)
+
+    records = load_flight(args.flight)
+    print_summary(records, top=args.top)
+    if args.chrome:
+        trace = chrome_trace_from_records(records)
+        validate_chrome_trace(trace)
+        Path(args.chrome).write_text(json.dumps(trace), encoding="utf-8")
+        print(f"\nwrote Chrome trace: {args.chrome} "
+              f"({len(trace['traceEvents'])} events) — load in "
+              "https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
