@@ -21,6 +21,7 @@ from paralleljohnson_tpu.solver import (
     ValidationError,
 )
 from paralleljohnson_tpu.backends import Backend, available_backends, get_backend
+from paralleljohnson_tpu.serve import LandmarkIndex, QueryEngine, TileStore
 from paralleljohnson_tpu.utils.faults import Fault, FaultPlan
 from paralleljohnson_tpu.utils.paths import path_weight, reconstruct_path
 from paralleljohnson_tpu.utils.resilience import (
@@ -46,7 +47,10 @@ __all__ = [
     "Fault",
     "FaultPlan",
     "HeartbeatReporter",
+    "LandmarkIndex",
     "NegativeCycleError",
+    "QueryEngine",
+    "TileStore",
     "RetryPolicy",
     "Telemetry",
     "Tracer",

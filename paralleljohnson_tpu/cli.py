@@ -324,6 +324,50 @@ def main(argv: list[str] | None = None) -> int:
                               "failed rows reference their flight file "
                               "(default: $PJ_TRACE_DIR if set, else off)")
 
+    p_serve = sub.add_parser(
+        "serve",
+        help="query-serving request loop over a tile store: JSONL "
+             "queries in (stdin or --queries), one JSON answer line "
+             "per query out (README 'Query serving')",
+    )
+    p_serve.add_argument("graph", help="path or loader spec")
+    p_serve.add_argument("--store-dir", default=None, metavar="DIR",
+                         help="solve/checkpoint directory the tile store "
+                              "attaches to (finished or in-progress; "
+                              "scheduled batches persist back into it); "
+                              "absent = in-memory hot/warm tiers only")
+    p_serve.add_argument("--queries", default="-", metavar="JSONL",
+                         help="query file, '-' = stdin (default). One "
+                              "JSON object per line: {\"id\": ..., "
+                              "\"source\": S, \"dst\": T | [T,...] | null, "
+                              "\"mode\": \"exact\"|\"approx\"}")
+    p_serve.add_argument("--landmarks", type=int, default=0, metavar="K",
+                         help="build (or reuse, when persisted in the "
+                              "store) a K-pivot landmark index for "
+                              "bounded-error approximate answers "
+                              "(default: 0 = none; --miss-policy "
+                              "landmark implies 16)")
+    p_serve.add_argument("--miss-policy", default="solve",
+                         choices=["solve", "landmark"],
+                         help="store miss on an unsolved source: "
+                              "'solve' schedules one exact batch "
+                              "through the resilient solver; 'landmark' "
+                              "answers immediately with (estimate, "
+                              "max_error) bounds")
+    p_serve.add_argument("--hot-rows", type=int, default=None,
+                         help="hot-tier capacity in rows (device-"
+                              "resident; default 128)")
+    p_serve.add_argument("--warm-rows", type=int, default=None,
+                         help="warm-tier host-RAM LRU capacity in rows "
+                              "(default 4096)")
+    p_serve.add_argument("--batch-queries", type=int, default=64,
+                         help="aggregate up to this many request lines "
+                              "into one source-batched lookup")
+    p_serve.add_argument("--summary", action="store_true",
+                         help="print the serving summary JSON (engine + "
+                              "store counters, hit rate) to stderr at exit")
+    _add_common(p_serve)
+
     p_info = sub.add_parser(
         "info",
         help="environment / plugin summary; with a graph spec, also the "
@@ -332,6 +376,10 @@ def main(argv: list[str] | None = None) -> int:
     )
     p_info.add_argument("graph", nargs="?", default=None,
                         help="optional loader spec / path to diagnose")
+    p_info.add_argument("--serve-store", default=None, metavar="DIR",
+                        help="also report a tile store's persisted "
+                             "serving state (capacity, landmark count, "
+                             "hit-rate counters from serve_stats.json)")
     p_info.add_argument("--json", action="store_true", dest="as_json")
 
     args = parser.parse_args(argv)
@@ -420,6 +468,39 @@ def main(argv: list[str] | None = None) -> int:
                 ),
                 "disabled_by_default": True,
             },
+            # The query-serving surface (README "Query serving"):
+            # store tiers, the exact-vs-approx answer contract, the
+            # JSONL request format, and exit codes. Attach a store dir
+            # via --serve-store for its persisted counters.
+            "serving": {
+                "command": "pjtpu serve <graph> [--store-dir DIR] "
+                           "[--queries FILE|-]",
+                "store_tiers": {
+                    "hot": "device-resident rows, LRU (default capacity "
+                           "128; --hot-rows)",
+                    "warm": "host-RAM LRU of materialized rows (default "
+                            "4096; --warm-rows)",
+                    "cold": "checkpoint batches via the persisted "
+                            "manifest — O(1) source lookup; any solve "
+                            "--checkpoint-dir is attachable",
+                },
+                "query_format": (
+                    'JSONL, one object per line: {"id": ..., '
+                    '"source": S, "dst": T | [T, ...] | null (full '
+                    'row), "mode": "exact" | "approx"}'
+                ),
+                "answer_contract": (
+                    "exact=true answers are bitwise the solver's rows "
+                    "(max_error 0); exact=false landmark answers carry "
+                    "|answer - exact| <= max_error, never unflagged"
+                ),
+                "exit_codes": {
+                    "0": "all queries answered",
+                    "1": "some queries malformed / bad arguments",
+                    "2": "negative cycle during a scheduled solve",
+                    "3": "corruption or abandoned stage",
+                },
+            },
             # The pipelined fan-out defaults (README "Pipelined
             # execution"): per-solve download_s / ckpt_wait_s /
             # overlap_saved_s prove the overlap in the stats output.
@@ -435,6 +516,41 @@ def main(argv: list[str] | None = None) -> int:
                 ),
             },
         }
+        if args.serve_store is not None:
+            # Persisted serving state: each graph subdirectory's
+            # serve_stats.json (written by QueryEngine.close) plus the
+            # landmark index size, so capacity / hit-rate / landmark
+            # count are reportable without starting a request loop.
+            from pathlib import Path as _Path
+
+            from paralleljohnson_tpu.serve import SERVE_STATS_FILENAME
+
+            root = _Path(args.serve_store)
+            stores = []
+            for d in sorted({root, *root.glob("graph_*")}):
+                entry = {}
+                stats_f = d / SERVE_STATS_FILENAME
+                if stats_f.exists():
+                    try:
+                        entry.update(json.loads(
+                            stats_f.read_text(encoding="utf-8")
+                        ))
+                    except ValueError:
+                        entry["error"] = "unreadable serve_stats.json"
+                lm_f = d / "landmarks.npz"
+                if lm_f.exists():
+                    try:
+                        with np.load(lm_f) as z:
+                            entry["landmarks_persisted"] = int(
+                                len(z["sources"])
+                            )
+                    except Exception:  # noqa: BLE001 — report, don't die
+                        entry["landmarks_persisted"] = "unreadable"
+                if entry:
+                    entry["dir"] = str(d)
+                    stores.append(entry)
+            info["serving"]["stores"] = stores
+
         if args.graph is not None:
             # Per-graph route diagnosis: the SAME predicates dispatch
             # consults, so "why did my solve pick route X" is answerable
@@ -536,6 +652,77 @@ def main(argv: list[str] | None = None) -> int:
                     g, args.source, predecessors=args.predecessors
                 )
             _report(res, args)
+        elif args.command == "serve":
+            from paralleljohnson_tpu.serve import (
+                DEFAULT_HOT_ROWS,
+                DEFAULT_WARM_ROWS,
+                LandmarkIndex,
+                QueryEngine,
+                TileStore,
+            )
+
+            g = load_graph(args.graph)
+            store = TileStore(
+                args.store_dir, g,
+                hot_rows=(DEFAULT_HOT_ROWS if args.hot_rows is None
+                          else args.hot_rows),
+                warm_rows=(DEFAULT_WARM_ROWS if args.warm_rows is None
+                           else args.warm_rows),
+            )
+            landmarks = None
+            k = args.landmarks or (
+                16 if args.miss_policy == "landmark" else 0
+            )
+            if k > 0:
+                if store.ckpt is not None:
+                    landmarks = LandmarkIndex.load(
+                        store.ckpt.dir, expect_digest=store.digest
+                    )
+                    if landmarks is not None and landmarks.k != k:
+                        landmarks = None  # stale size: rebuild
+                if landmarks is None:
+                    landmarks = LandmarkIndex.build(g, k, config=cfg)
+                    if store.ckpt is not None:
+                        landmarks.save(store.ckpt.dir)
+            engine = QueryEngine(
+                g, store, landmarks=landmarks, config=cfg,
+                miss_policy=args.miss_policy,
+            )
+            stream = (
+                sys.stdin if args.queries == "-"
+                else open(args.queries, encoding="utf-8")
+            )
+            n_errors = 0
+            try:
+
+                def answer(buf: list) -> int:
+                    responses, errs = engine.query_lines(buf)
+                    for r in responses:
+                        print(json.dumps(r), flush=True)
+                    return errs
+
+                buf: list = []
+                for line in stream:
+                    if not line.strip():
+                        continue
+                    buf.append(line)
+                    if len(buf) >= max(1, args.batch_queries):
+                        n_errors += answer(buf)
+                        buf = []
+                if buf:
+                    n_errors += answer(buf)
+            finally:
+                if stream is not sys.stdin:
+                    stream.close()
+                engine.close()
+            if getattr(args, "metrics_file", None):
+                # The SERVE metric table (pjtpu_queries_total,
+                # pjtpu_query_latency_*), not the solver's.
+                engine.write_metrics(args.metrics_file,
+                                     labels={"command": "serve"})
+            if args.summary:
+                print(json.dumps(engine.serve_summary()), file=sys.stderr)
+            return 1 if n_errors else 0
         elif args.command == "batch":
             if args.predecessors:
                 print("error: batch mode does not support --predecessors",

@@ -183,6 +183,17 @@ class CSRGraph:
     def astype(self, dtype) -> "CSRGraph":
         return self.with_weights(self.weights.astype(dtype))
 
+    def reverse(self) -> "CSRGraph":
+        """Edge-reversed graph on the same vertex set (d_rev(u, v) =
+        d(v, u)) — what a landmark index solves to get distances TO each
+        pivot (``serve.landmarks``). Padding no-op edges are dropped:
+        the reverse is a fresh canonical CSR."""
+        e = self.num_real_edges
+        return CSRGraph.from_edges(
+            self.indices[:e], self.src[:e], self.weights[:e],
+            self.num_nodes, dtype=self.dtype,
+        )
+
     # -- padding ------------------------------------------------------------
 
     def pad_edges(self, multiple: int = 128) -> "CSRGraph":

@@ -1,0 +1,35 @@
+"""Query-serving layer (ROADMAP item 6): the first subsystem where the
+solver is a component rather than the product. A :class:`TileStore`
+tiers solved distance rows (device-hot / host-RAM-LRU-warm /
+checkpoint-cold), a :class:`QueryEngine` aggregates client queries into
+source-batched lookups and schedules exact solves for misses, and a
+:class:`LandmarkIndex` answers unsolved sources immediately with a
+certified ``(estimate, max_error)`` bound. ``pjtpu serve`` is the CLI
+front end (JSONL request loop)."""
+
+from paralleljohnson_tpu.serve.engine import (
+    QueryEngine,
+    QueryError,
+    SERVE_PROM_METRICS,
+    SERVE_STATS_FILENAME,
+    ServeStats,
+)
+from paralleljohnson_tpu.serve.landmarks import Bounds, LandmarkIndex
+from paralleljohnson_tpu.serve.store import (
+    DEFAULT_HOT_ROWS,
+    DEFAULT_WARM_ROWS,
+    TileStore,
+)
+
+__all__ = [
+    "Bounds",
+    "DEFAULT_HOT_ROWS",
+    "DEFAULT_WARM_ROWS",
+    "LandmarkIndex",
+    "QueryEngine",
+    "QueryError",
+    "SERVE_PROM_METRICS",
+    "SERVE_STATS_FILENAME",
+    "ServeStats",
+    "TileStore",
+]

@@ -1,0 +1,379 @@
+"""Query engine: source-batched lookups over a :class:`TileStore`.
+
+The serving front end (ROADMAP item 6): point-to-point and one-to-many
+queries from many concurrent clients are AGGREGATED — one
+:meth:`QueryEngine.query_batch` call resolves each distinct source row
+once (hot/warm/cold tier walk), and every source that misses the store
+is solved in ONE exact batch through the ordinary resilient solver
+(``ParallelJohnsonSolver.solve`` — retries, watchdog deadlines, OOM
+batch degradation, and the pipelined fan-out all apply; with a
+checkpoint-backed store the new rows also land on disk, growing the
+cold tier for the next process). Alternatively (``miss_policy=
+"landmark"``) a miss answers immediately from the landmark index with a
+certified ``(estimate, max_error)`` — never an unflagged approximation.
+
+The exact-vs-approximate contract every response carries:
+
+- ``exact: true`` — the distance is bitwise the solver's output for
+  (graph, source, dst); ``max_error`` is 0.
+- ``exact: false`` — ``distance`` is the landmark upper bound and
+  ``|distance - d(s, t)| <= max_error`` (``max_error`` may be +inf when
+  the landmarks carry no information about the pair — the caller sees
+  exactly how much the answer is worth).
+
+Telemetry: every batch is a ``serve_batch`` span, every query a
+``query`` span (round-10 ``Tracer``); heartbeat progress carries
+``queries_done``; :meth:`write_metrics` exports ``pjtpu_queries_total``
+/ ``pjtpu_query_latency_*`` Prometheus gauges through the same atomic
+``write_prom_metrics`` writer the solver uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from pathlib import Path
+
+import numpy as np
+
+from paralleljohnson_tpu.utils.metrics import latency_percentiles
+from paralleljohnson_tpu.utils.telemetry import resolve as _resolve_telemetry
+from paralleljohnson_tpu.utils.telemetry import write_prom_metrics
+
+SERVE_STATS_FILENAME = "serve_stats.json"
+
+# Latency reservoir cap: percentiles over the most recent samples only —
+# a long-lived server must not grow host memory linearly in queries.
+_MAX_LATENCY_SAMPLES = 65536
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """Per-engine query counters + a bounded latency reservoir."""
+
+    queries_total: int = 0
+    exact_answers: int = 0
+    approx_answers: int = 0
+    errors: int = 0
+    batches_scheduled: int = 0
+    solved_sources: int = 0
+    latencies_ms: list = dataclasses.field(default_factory=list)
+
+    def record_latency(self, ms: float) -> None:
+        if len(self.latencies_ms) >= _MAX_LATENCY_SAMPLES:
+            del self.latencies_ms[: _MAX_LATENCY_SAMPLES // 2]
+        self.latencies_ms.append(float(ms))
+
+    def percentiles(self) -> dict:
+        return latency_percentiles(self.latencies_ms)
+
+    def as_dict(self) -> dict:
+        return {
+            "queries_total": self.queries_total,
+            "exact_answers": self.exact_answers,
+            "approx_answers": self.approx_answers,
+            "errors": self.errors,
+            "batches_scheduled": self.batches_scheduled,
+            "solved_sources": self.solved_sources,
+            **{k: round(v, 4) for k, v in self.percentiles().items()},
+        }
+
+
+# Prometheus table for :func:`write_prom_metrics` — the getters take the
+# ENGINE (stats + store hit-rate live on different objects).
+SERVE_PROM_METRICS = (
+    ("pjtpu_queries_total", "counter",
+     "Queries answered by the serving engine",
+     lambda e: e.stats.queries_total),
+    ("pjtpu_query_errors_total", "counter",
+     "Malformed or out-of-range queries rejected",
+     lambda e: e.stats.errors),
+    ("pjtpu_query_exact_total", "counter",
+     "Queries answered exactly (store row or scheduled solve)",
+     lambda e: e.stats.exact_answers),
+    ("pjtpu_query_approx_total", "counter",
+     "Queries answered from the landmark index (with max_error)",
+     lambda e: e.stats.approx_answers),
+    ("pjtpu_serve_batches_scheduled_total", "counter",
+     "Exact solve batches the engine scheduled for store misses",
+     lambda e: e.stats.batches_scheduled),
+    ("pjtpu_query_hit_rate", "gauge",
+     "Fraction of row lookups served by a store tier (hot/warm/cold)",
+     lambda e: e.store.hit_rate()),
+    ("pjtpu_query_latency_p50_ms", "gauge",
+     "Median per-query latency (batch-relative, most recent samples)",
+     lambda e: e.stats.percentiles()["p50_ms"]),
+    ("pjtpu_query_latency_p99_ms", "gauge",
+     "99th-percentile per-query latency",
+     lambda e: e.stats.percentiles()["p99_ms"]),
+)
+
+_MISS_POLICIES = ("solve", "landmark")
+
+
+class QueryError(ValueError):
+    """A malformed request (bad JSON shape, out-of-range vertex)."""
+
+
+class QueryEngine:
+    """Answers queries over one graph from a tile store (+ optional
+    landmark index). ``config`` is the :class:`SolverConfig` the
+    exact-miss solver runs under; its ``checkpoint_dir`` is overridden
+    to the store's backing directory so scheduled batches persist into
+    the cold tier (or to None for an in-memory store)."""
+
+    def __init__(self, graph, store, *, landmarks=None, config=None,
+                 miss_policy: str = "solve") -> None:
+        import dataclasses as _dc
+
+        from paralleljohnson_tpu.config import SolverConfig
+        from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+        if miss_policy not in _MISS_POLICIES:
+            raise ValueError(
+                f"miss_policy must be one of {_MISS_POLICIES}, "
+                f"got {miss_policy!r}"
+            )
+        if miss_policy == "landmark" and landmarks is None:
+            raise ValueError(
+                "miss_policy='landmark' requires a LandmarkIndex "
+                "(build one or switch to miss_policy='solve')"
+            )
+        self.graph = graph
+        self.store = store
+        self.landmarks = landmarks
+        self.miss_policy = miss_policy
+        base = config or SolverConfig()
+        self.config = _dc.replace(
+            base,
+            checkpoint_dir=str(store.root) if store.ckpt is not None else None,
+        )
+        self.solver = ParallelJohnsonSolver(self.config)
+        self._tel = _resolve_telemetry(self.config.telemetry)
+        self.stats = ServeStats()
+
+    # -- request parsing -----------------------------------------------------
+
+    def _parse(self, req: dict) -> dict:
+        v = self.graph.num_nodes
+        if not isinstance(req, dict):
+            raise QueryError(f"query must be a JSON object, got {type(req).__name__}")
+        if "source" not in req:
+            raise QueryError("query is missing 'source'")
+        try:
+            source = int(req["source"])
+        except (TypeError, ValueError):
+            raise QueryError(f"bad source {req['source']!r}") from None
+        if not 0 <= source < v:
+            raise QueryError(f"source {source} out of range [0, {v})")
+        dst = req.get("dst")
+        if dst is not None:
+            many = isinstance(dst, (list, tuple))
+            try:
+                dsts = np.asarray(
+                    dst if many else [dst], np.int64
+                )
+            except (TypeError, ValueError):
+                raise QueryError(f"bad dst {dst!r}") from None
+            if dsts.ndim != 1 or (len(dsts) and (
+                    dsts.min() < 0 or dsts.max() >= v)):
+                raise QueryError(f"dst out of range [0, {v})")
+        else:
+            many = True
+            dsts = None  # full row (all V destinations)
+        mode = req.get("mode", self.miss_policy)
+        if mode == "exact":
+            mode = "solve"
+        elif mode == "approx":
+            mode = "landmark"
+        if mode not in _MISS_POLICIES:
+            raise QueryError(f"bad mode {req.get('mode')!r}")
+        if mode == "landmark" and self.landmarks is None:
+            raise QueryError("mode 'approx' needs a landmark index")
+        return {"id": req.get("id"), "source": source, "dsts": dsts,
+                "many": many, "mode": mode}
+
+    # -- the serving loop ----------------------------------------------------
+
+    def query(self, source: int, dst=None, *, mode: str | None = None) -> dict:
+        """One request (see :meth:`query_batch`). ``dst``: vertex id for
+        point-to-point, list for one-to-many, None for the full row."""
+        req: dict = {"source": source, "dst": dst}
+        if mode is not None:
+            req["mode"] = mode
+        out = self.query_batch([req])[0]
+        if "error" in out:
+            raise QueryError(out["error"])
+        return out
+
+    def query_batch(self, requests: list[dict]) -> list[dict]:
+        """Answer many requests in one pass: each distinct source's row
+        is fetched ONCE, every exact-mode miss joins one scheduled solve
+        batch, responses come back in request order. Malformed requests
+        yield ``{"error": ...}`` responses (the batch survives)."""
+        t_batch = time.perf_counter()
+        tel = self._tel
+        with tel.span("serve_batch", n_queries=len(requests)):
+            parsed: list[dict | None] = []
+            responses: list[dict | None] = []
+            for req in requests:
+                try:
+                    parsed.append(self._parse(req))
+                    responses.append(None)
+                except QueryError as e:
+                    parsed.append(None)
+                    self.stats.errors += 1
+                    responses.append({
+                        "id": req.get("id") if isinstance(req, dict) else None,
+                        "error": str(e),
+                    })
+
+            # One row fetch per distinct source; one solve for ALL
+            # exact-mode misses (the aggregation the tentpole names).
+            rows: dict[int, tuple] = {}
+            seen: set[int] = set()
+            for p in parsed:
+                if p is None or p["source"] in seen:
+                    continue
+                seen.add(p["source"])
+                row, row_tier = self.store.get(p["source"])
+                if row is not None:
+                    rows[p["source"]] = (row, row_tier)
+            missing_exact = sorted({
+                p["source"] for p in parsed
+                if p is not None and p["source"] not in rows
+                and p["mode"] == "solve"
+            })
+            if missing_exact:
+                batch = np.asarray(missing_exact, np.int64)
+                with tel.span("serve_solve", n_sources=len(batch)):
+                    res = self.solver.solve(self.graph, sources=batch)
+                self.stats.batches_scheduled += 1
+                self.stats.solved_sources += len(batch)
+                self.store.put(res.sources, res.dist, tier="hot")
+                if self.store.ckpt is not None:
+                    self.store.invalidate_cold_index()
+                for s, row in res.rows_by_source().items():
+                    rows[s] = (row, "solved")
+
+            for i, p in enumerate(parsed):
+                if p is None:
+                    continue
+                with tel.span("query", source=p["source"],
+                              many=p["many"]):
+                    responses[i] = self._answer(p, rows)
+                self.stats.queries_total += 1
+                self.stats.record_latency(
+                    (time.perf_counter() - t_batch) * 1e3
+                )
+            tel.progress(queries_done=self.stats.queries_total,
+                         batches_scheduled=self.stats.batches_scheduled)
+        return responses  # type: ignore[return-value]
+
+    def _answer(self, p: dict, rows: dict[int, tuple]) -> dict:
+        s, dsts, many = p["source"], p["dsts"], p["many"]
+        out: dict = {"id": p["id"], "source": s}
+        hit = rows.get(s)
+        if hit is not None:
+            row, tier = hit
+            vals = np.asarray(row if dsts is None else row[dsts],
+                              np.float64)
+            self.stats.exact_answers += 1
+            out.update(exact=True, max_error=0.0, tier=tier)
+        else:
+            # Landmark path — approximation, always flagged with its
+            # certified error bound.
+            est, err = self.landmarks.estimate_row(s, dsts)
+            vals = est
+            self.stats.approx_answers += 1
+            out.update(
+                exact=False, tier="landmark",
+                max_error=(
+                    [float(e) for e in err] if many else float(err[0])
+                ),
+            )
+        if many:
+            out["dst"] = None if dsts is None else [int(d) for d in dsts]
+            out["distances"] = [float(x) for x in vals]
+        else:
+            out["dst"] = int(dsts[0])
+            out["distance"] = float(vals[0])
+        return out
+
+    # -- warm-up and ops surface ---------------------------------------------
+
+    def warm(self, sources) -> int:
+        """Pre-solve ``sources`` into the store (one scheduled batch for
+        whichever of them the store does not already hold). Returns how
+        many sources were actually solved."""
+        missing = [int(s) for s in np.asarray(sources, np.int64)
+                   if self.store.get(int(s))[0] is None]
+        if not missing:
+            return 0
+        batch = np.asarray(sorted(set(missing)), np.int64)
+        with self._tel.span("serve_warm", n_sources=len(batch)):
+            res = self.solver.solve(self.graph, sources=batch)
+        self.stats.batches_scheduled += 1
+        self.stats.solved_sources += len(batch)
+        self.store.put(res.sources, res.dist, tier="hot")
+        if self.store.ckpt is not None:
+            self.store.invalidate_cold_index()
+        return len(batch)
+
+    def query_lines(self, lines) -> tuple[list[dict], int]:
+        """Parse JSONL request lines and answer them as one aggregated
+        batch. Returns ``(responses_in_order, n_errors)`` — a malformed
+        line becomes an ``{"error": ...}`` response, never a crash (the
+        request loop must survive any input)."""
+        requests: list[dict] = []
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+                if not isinstance(obj, dict):
+                    raise ValueError("not a JSON object")
+                requests.append(obj)
+            except ValueError as e:
+                requests.append({"_parse_error": f"line {i + 1}: {e}"})
+        for r in requests:
+            if "_parse_error" in r:
+                r.pop("source", None)  # force the engine's error path
+        responses = self.query_batch([
+            r if "_parse_error" not in r else {"source": None}
+            for r in requests
+        ])
+        for r, resp in zip(requests, responses):
+            if "_parse_error" in r and "error" in resp:
+                resp["error"] = r["_parse_error"]
+        n_errors = sum(1 for r in responses if "error" in r)
+        return responses, n_errors
+
+    def write_metrics(self, path, *, labels: dict | None = None) -> Path:
+        """Prometheus textfile export (``pjtpu_queries_total``,
+        ``pjtpu_query_latency_p50_ms`` / ``_p99_ms``, hit rate, ...)."""
+        return write_prom_metrics(self, path, labels=labels,
+                                  metrics=SERVE_PROM_METRICS)
+
+    def serve_summary(self) -> dict:
+        return {
+            "engine": self.stats.as_dict(),
+            "store": self.store.stats(),
+            "landmarks": 0 if self.landmarks is None else self.landmarks.k,
+            "miss_policy": self.miss_policy,
+        }
+
+    def close(self) -> None:
+        """Persist the serving counters next to the store's batches
+        (atomic) so ``pjtpu info --serve-store`` can report capacity,
+        landmark count, and hit rates after the loop exits. Does NOT
+        close the telemetry façade — its owner (the CLI) does."""
+        if self.store.ckpt is None:
+            return
+        path = self.store.ckpt.dir / SERVE_STATS_FILENAME
+        tmp = path.with_name(path.name + f".tmp{os.getpid()}")
+        tmp.write_text(json.dumps(self.serve_summary()), encoding="utf-8")
+        os.replace(tmp, path)

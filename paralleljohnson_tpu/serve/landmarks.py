@@ -1,0 +1,231 @@
+"""Landmark (pivot-source) distance approximation with error bounds.
+
+The fast path for queries on sources nobody solved yet (PAPERS.md
+"Faster Parallel Algorithm for Approximate Shortest Path", arXiv:
+1911.01626 — the hopset idea of answering through a small set of
+well-connected intermediate vertices). At store build, k pivot sources
+are solved EXACTLY, twice: forward rows ``fwd[L] = d(L, ·)`` on the
+graph and reverse rows ``rev[L] = d(·, L)`` on the edge-reversed graph
+(``CSRGraph.reverse``). A query (s, t) then gets directed
+triangle-inequality bounds:
+
+  upper = min_L  d(s, L) + d(L, t)             (a real path through L)
+  lower = max_L  max(d(L, t) - d(L, s),  d(s, L) - d(t, L))
+          (each from one application of d(x, z) <= d(x, y) + d(y, z);
+           vacuous terms — subtrahend +inf — are skipped)
+
+so ``lower <= d(s, t) <= upper`` always holds, with IEEE inf arithmetic
+carrying unreachability: a finite ``d(L, s)`` with infinite ``d(L, t)``
+PROVES ``d(s, t) = +inf`` (lower = +inf). The estimate returned is the
+upper bound and ``max_error = upper - lower`` — an approximation is
+never unflagged: callers get the bound, not a guess.
+
+Non-negative graphs additionally clamp ``lower >= 0`` (and the engine
+answers s == t as exactly 0 — the empty path; negative-cycle-free
+graphs cannot beat it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+
+from paralleljohnson_tpu.utils.checkpoint import graph_digest
+
+LANDMARKS_FILENAME = "landmarks.npz"
+
+
+@dataclasses.dataclass
+class Bounds:
+    """One query's certified interval. ``estimate`` is the value a caller
+    should serve (the upper bound — a realizable path length, or +inf
+    when no landmark connects the pair); ``max_error`` bounds
+    ``|estimate - exact|`` (0 when the interval pins the answer, +inf
+    when the landmarks carry no information about the pair)."""
+
+    lower: float
+    upper: float
+
+    @property
+    def estimate(self) -> float:
+        # Both bounds infinite: d(s,t) is PROVEN +inf (lower <= exact).
+        if np.isinf(self.lower) and self.lower > 0:
+            return float("inf")
+        return self.upper
+
+    @property
+    def max_error(self) -> float:
+        if np.isinf(self.lower) and self.lower > 0:
+            return 0.0  # proven unreachable: the estimate is exact
+        err = self.upper - self.lower
+        return float(err) if np.isfinite(err) else float("inf")
+
+
+class LandmarkIndex:
+    """k exact pivot solves answering any pair with a certified interval.
+
+    ``fwd``/``rev`` are host ``[k, V]`` arrays (k is small — the index
+    costs 2k exact SSSP rows, solved once through the ordinary resilient
+    solver at build). Pivots are a deterministic seeded uniform draw:
+    good enough for bound quality at this stage, and reproducible so a
+    persisted index can be validated against a rebuild.
+    """
+
+    def __init__(self, sources: np.ndarray, fwd: np.ndarray,
+                 rev: np.ndarray, *, nonnegative: bool,
+                 digest: str | None = None) -> None:
+        self.sources = np.asarray(sources, np.int64)
+        # f64 working copies: the bound arithmetic must not add rounding
+        # of its own on top of the solver's (k is small; 2 x k x V f64
+        # is cheap next to the store's row tiers).
+        self.fwd = np.asarray(fwd, np.float64)
+        self.rev = np.asarray(rev, np.float64)
+        self.nonnegative = bool(nonnegative)
+        self.digest = digest
+        if self.fwd.shape != self.rev.shape or len(self.fwd) != len(self.sources):
+            raise ValueError(
+                f"inconsistent landmark shapes: sources {self.sources.shape}, "
+                f"fwd {self.fwd.shape}, rev {self.rev.shape}"
+            )
+
+    @property
+    def k(self) -> int:
+        return len(self.sources)
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def build(cls, graph, k: int, *, config=None, seed: int = 0,
+              solver=None) -> "LandmarkIndex":
+        """Solve ``k`` seeded pivots exactly (forward + reverse graph)
+        through the resilient solver — retries, OOM degradation, and the
+        pipeline all apply, exactly like any other solve."""
+        from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+        v = graph.num_nodes
+        k = max(0, min(int(k), v))
+        rng = np.random.default_rng(seed)
+        pivots = np.sort(rng.choice(v, size=k, replace=False)) if k else (
+            np.zeros(0, np.int64)
+        )
+        if solver is None:
+            solver = ParallelJohnsonSolver(config)
+        if k == 0:
+            empty = np.zeros((0, v), graph.dtype)
+            return cls(pivots, empty, empty,
+                       nonnegative=not graph.has_negative_weights,
+                       digest=graph_digest(graph))
+        fwd = np.asarray(solver.solve(graph, sources=pivots).dist)
+        rev = np.asarray(solver.solve(graph.reverse(), sources=pivots).dist)
+        return cls(pivots, fwd, rev,
+                   nonnegative=not graph.has_negative_weights,
+                   digest=graph_digest(graph))
+
+    # -- bounds --------------------------------------------------------------
+
+    def bounds(self, s: int, t: int) -> Bounds:
+        row = self.bounds_row(s, np.array([t], np.int64))
+        return Bounds(lower=float(row[0][0]), upper=float(row[1][0]))
+
+    def bounds_row(self, s: int, dsts: np.ndarray | None = None):
+        """Vectorized one-to-many bounds from source ``s``: returns
+        ``(lower[len(dsts)], upper[len(dsts)])`` (all V destinations when
+        ``dsts`` is None)."""
+        d_s_L = self.rev[:, s]          # [k]  d(s, L)
+        d_L_s = self.fwd[:, s]          # [k]  d(L, s)
+        fwd_t = self.fwd if dsts is None else self.fwd[:, dsts]  # [k, D]
+        rev_t = self.rev if dsts is None else self.rev[:, dsts]  # [k, D]
+        n_dst = fwd_t.shape[1]
+        if self.k == 0:
+            lower = np.zeros(n_dst) if self.nonnegative else np.full(n_dst, -np.inf)
+            return lower, np.full(n_dst, np.inf)
+        with np.errstate(invalid="ignore"):
+            upper_c = d_s_L[:, None] + fwd_t        # path s -> L -> t
+            # inf + inf = inf is fine; (+inf) + (-anything) never occurs
+            # (distances are never -inf on negative-cycle-free graphs).
+            upper = np.min(upper_c, axis=0)
+            # d(L,t) - d(L,s) valid iff d(L,s) finite; vacuous -> -inf.
+            a = np.where(np.isfinite(d_L_s)[:, None], fwd_t - d_L_s[:, None],
+                         -np.inf)
+            # d(s,L) - d(t,L) valid iff d(t,L) finite; vacuous -> -inf.
+            b = np.where(np.isfinite(rev_t), d_s_L[:, None] - rev_t, -np.inf)
+        lower = np.maximum(np.max(a, axis=0), np.max(b, axis=0))
+        # f32 slack: the triangle inequality is exact for TRUE distances,
+        # but the solver's rows are f32 path sums — two independently
+        # rounded sums can violate it by a few ULP. Widen both bounds by
+        # a small relative tolerance (the ops/pred.py tight-edge idiom)
+        # so `lower <= exact <= upper` is a contract, not a coin flip;
+        # the widening is ~1e-5 relative — invisible next to any real
+        # approximation gap. The clamp at 0 (non-negative graphs) and
+        # +inf values stay exact: no slack applies to them.
+        tol = 32 * float(np.finfo(np.float32).eps)
+        with np.errstate(invalid="ignore"):  # inf-inf in discarded branches
+            finite_lo = np.isfinite(lower)
+            lower = np.where(
+                finite_lo, lower - tol * (1.0 + np.abs(lower)), lower
+            )
+            finite_up = np.isfinite(upper)
+            upper = np.where(
+                finite_up, upper + tol * (1.0 + np.abs(upper)), upper
+            )
+        if self.nonnegative:
+            lower = np.maximum(lower, 0.0)
+        return lower, upper
+
+    def estimate(self, s: int, t: int) -> tuple[float, float]:
+        """``(estimate, max_error)`` for one pair — the serving contract:
+        ``|estimate - d(s, t)| <= max_error`` (inf-aware: a proven-inf
+        pair reports ``(inf, 0)``; an unknown one ``(inf, inf)``)."""
+        b = self.bounds(s, t)
+        return b.estimate, b.max_error
+
+    def estimate_row(self, s: int, dsts: np.ndarray | None = None):
+        """Vectorized :meth:`estimate` — ``(estimates, max_errors)``
+        arrays for a one-to-many query, same per-entry semantics."""
+        lower, upper = self.bounds_row(s, dsts)
+        proven_inf = np.isinf(lower) & (lower > 0)
+        est = np.where(proven_inf, np.inf, upper)
+        with np.errstate(invalid="ignore"):
+            gap = upper - lower
+        err = np.where(proven_inf, 0.0,
+                       np.where(np.isfinite(gap), gap, np.inf))
+        return est, err
+
+    # -- persistence ---------------------------------------------------------
+
+    def save(self, directory: str | Path) -> Path:
+        """Persist next to the tile store's batches (one npz: pivots +
+        both row blocks + the graph digest that keys validity)."""
+        path = Path(directory) / LANDMARKS_FILENAME
+        tmp = path.with_suffix(".tmp.npz")
+        np.savez_compressed(
+            tmp, sources=self.sources, fwd=self.fwd, rev=self.rev,
+            nonnegative=np.array(self.nonnegative),
+            digest=np.array(self.digest or ""),
+        )
+        tmp.rename(path)
+        return path
+
+    @classmethod
+    def load(cls, directory: str | Path, *,
+             expect_digest: str | None = None) -> "LandmarkIndex | None":
+        """Load a persisted index; None when absent, unreadable, or built
+        for a different graph (digest mismatch — a stale index must
+        rebuild, never silently bound the wrong graph)."""
+        path = Path(directory) / LANDMARKS_FILENAME
+        if not path.exists():
+            return None
+        try:
+            with np.load(path) as data:
+                digest = str(data["digest"]) if "digest" in data.files else ""
+                if expect_digest is not None and digest != expect_digest:
+                    return None
+                return cls(
+                    data["sources"], data["fwd"], data["rev"],
+                    nonnegative=bool(data["nonnegative"]),
+                    digest=digest or None,
+                )
+        except Exception:  # noqa: BLE001 — a torn index is a rebuild, not a crash
+            return None

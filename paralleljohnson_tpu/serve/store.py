@@ -1,0 +1,230 @@
+"""Tiered distance-tile store (ROADMAP item 6 — the serving tentpole).
+
+The artifact of a solve is distance ROWS: ``dist[source] -> [V]``. The
+store keeps them in three tiers, hottest first, and every lookup walks
+them in order:
+
+- **hot** — rows exactly as the backend returned them, which for device
+  backends means device-resident (HBM) arrays that were never forced to
+  host; for host backends the tiers differ only in capacity. Newly
+  solved batches land here.
+- **warm** — a host-RAM LRU of materialized numpy rows. Hot evictions
+  demote here (one ``np.asarray`` per row — the D2H download happens at
+  demotion, off the solve path); warm evictions are dropped (the cold
+  tier still has them when the store is checkpoint-backed).
+- **cold** — checkpoint-backed batch files loaded through
+  :meth:`BatchCheckpointer.load` (same corruption checks as resume),
+  indexed O(1) by the persisted manifest (source -> batch file). A cold
+  hit promotes the WHOLE loaded batch into warm — the ``.npz`` decode
+  was the expensive part, and query locality across a batch's sources
+  is the common case.
+
+The store is keyed by graph content digest (``checkpoint.graph_digest``)
+through the checkpointer's per-graph subdirectory, so it can attach to
+any finished or in-progress solve directory: rows of a different or
+modified graph are invisible by construction, and a solver writing new
+batches into the same directory (the engine's exact-miss path) just
+grows the cold tier — call :meth:`invalidate_cold_index` after a
+scheduled solve so the manifest is re-read.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from pathlib import Path
+
+import numpy as np
+
+from paralleljohnson_tpu.utils.checkpoint import BatchCheckpointer, graph_digest
+
+# Tier capacities (rows). Hot is device memory — keep it a small working
+# set; warm is host RAM (a [V] f32 row at V=2^20 is 4 MB, so the default
+# warm tier tops out around 16 GB at that scale — size down via the CLI
+# flags for bigger graphs).
+DEFAULT_HOT_ROWS = 128
+DEFAULT_WARM_ROWS = 4096
+
+
+class TileStore:
+    """Tiered distance-row cache over an optional checkpoint directory.
+
+    ``directory=None`` runs hot+warm only (pure in-memory serving).
+    Thread-safe for the in-process request loop (one lock — lookups are
+    dict operations plus, on a cold hit, one npz load).
+    """
+
+    def __init__(
+        self,
+        directory: str | Path | None,
+        graph,
+        *,
+        hot_rows: int = DEFAULT_HOT_ROWS,
+        warm_rows: int = DEFAULT_WARM_ROWS,
+    ) -> None:
+        if hot_rows < 0 or warm_rows < 0:
+            raise ValueError("tier capacities must be >= 0")
+        self.graph = graph
+        self.digest = graph_digest(graph)
+        self.root = Path(directory) if directory is not None else None
+        self.ckpt = (
+            BatchCheckpointer(directory, graph_key=self.digest)
+            if directory is not None
+            else None
+        )
+        self.hot_rows = int(hot_rows)
+        self.warm_rows = int(warm_rows)
+        self._hot: collections.OrderedDict = collections.OrderedDict()
+        self._warm: collections.OrderedDict = collections.OrderedDict()
+        self._cold_index: dict[int, tuple[int, str]] | None = None
+        self._lock = threading.Lock()
+        self.hits_hot = 0
+        self.hits_warm = 0
+        self.hits_cold = 0
+        self.misses = 0
+        self.demotions = 0
+        self.evictions = 0
+        self.cold_loads = 0
+
+    # -- lookup --------------------------------------------------------------
+
+    def get(self, source: int):
+        """``(row, tier)`` for one source's distance row, or
+        ``(None, None)`` on a full miss. ``tier`` is ``"hot"`` /
+        ``"warm"`` / ``"cold"``; the row is host numpy for warm/cold and
+        whatever the backend returned (possibly device-resident) for hot.
+        Counts exactly one hit or miss per call."""
+        source = int(source)
+        with self._lock:
+            if source in self._hot:
+                self._hot.move_to_end(source)
+                self.hits_hot += 1
+                return self._hot[source], "hot"
+            if source in self._warm:
+                self._warm.move_to_end(source)
+                self.hits_warm += 1
+                return self._warm[source], "warm"
+            row = self._cold_lookup(source)
+            if row is not None:
+                self.hits_cold += 1
+                return row, "cold"
+            self.misses += 1
+            return None, None
+
+    def __contains__(self, source: int) -> bool:
+        s = int(source)
+        with self._lock:
+            return (
+                s in self._hot
+                or s in self._warm
+                or s in self._cold_sources()
+            )
+
+    # -- insertion -----------------------------------------------------------
+
+    def put(self, sources: np.ndarray, rows, *, tier: str = "hot") -> None:
+        """Insert one solved batch's rows (``rows[i]`` is the distance
+        row of ``sources[i]``). ``tier="hot"`` keeps rows as given
+        (device-resident for device backends); ``tier="warm"``
+        materializes to host numpy. Capacity overflow demotes
+        hot -> warm (materializing) and drops from warm (LRU order)."""
+        if tier not in ("hot", "warm"):
+            raise ValueError(f"tier must be hot/warm, got {tier!r}")
+        sources = np.asarray(sources, np.int64)
+        with self._lock:
+            for i, s in enumerate(sources):
+                s = int(s)
+                row = rows[i]
+                if tier == "hot" and self.hot_rows > 0:
+                    self._hot.pop(s, None)
+                    self._hot[s] = row
+                else:
+                    self._warm.pop(s, None)
+                    self._warm[s] = np.asarray(row)
+                self._evict()
+
+    def _evict(self) -> None:
+        while len(self._hot) > self.hot_rows:
+            s, row = self._hot.popitem(last=False)
+            self.demotions += 1
+            if self.warm_rows > 0:
+                self._warm.pop(s, None)
+                self._warm[s] = np.asarray(row)  # the D2H happens here
+        while len(self._warm) > self.warm_rows:
+            self._warm.popitem(last=False)
+            self.evictions += 1
+
+    # -- cold tier -----------------------------------------------------------
+
+    def _cold_sources(self) -> dict[int, tuple[int, str]]:
+        if self.ckpt is None:
+            return {}
+        if self._cold_index is None:
+            self._cold_index = self.ckpt.manifest()
+        return self._cold_index
+
+    def _cold_lookup(self, source: int):
+        entry = self._cold_sources().get(source)
+        if entry is None:
+            return None
+        batch_idx, filename = entry
+        batch_sources = self.ckpt.batch_sources(filename)
+        if batch_sources is None:
+            return None
+        self.cold_loads += 1
+        loaded = self.ckpt.load(batch_idx, batch_sources)
+        if loaded is None:  # corrupt/absent batch: a miss, never garbage
+            return None
+        rows, _ = loaded
+        # Promote the whole decoded batch: the npz decode dominated, and
+        # neighbors in a batch are the likeliest next queries.
+        for i, s in enumerate(batch_sources):
+            s = int(s)
+            if s not in self._hot and self.warm_rows > 0:
+                self._warm.pop(s, None)
+                self._warm[s] = rows[i]
+        self._evict()
+        pos = int(np.flatnonzero(batch_sources == source)[0])
+        return rows[pos]
+
+    def invalidate_cold_index(self) -> None:
+        """Re-read the manifest on next cold lookup — call after a solver
+        appended new batches to the backing directory."""
+        with self._lock:
+            self._cold_index = None
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def hits(self) -> int:
+        return self.hits_hot + self.hits_warm + self.hits_cold
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "digest": self.digest,
+                "hot_rows": len(self._hot),
+                "warm_rows": len(self._warm),
+                "cold_rows": len(self._cold_sources()),
+                "hot_capacity": self.hot_rows,
+                "warm_capacity": self.warm_rows,
+                "hits_hot": self.hits_hot,
+                "hits_warm": self.hits_warm,
+                "hits_cold": self.hits_cold,
+                "misses": self.misses,
+                "hit_rate": round(self.hit_rate(), 6),
+                "demotions": self.demotions,
+                "evictions": self.evictions,
+                "cold_loads": self.cold_loads,
+            }
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"TileStore(digest={self.digest}, hot={len(self._hot)}/"
+            f"{self.hot_rows}, warm={len(self._warm)}/{self.warm_rows}, "
+            f"cold={'on' if self.ckpt else 'off'})"
+        )

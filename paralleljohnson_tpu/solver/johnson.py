@@ -91,6 +91,13 @@ class SolveResult:
         order = np.argsort(self.sources)
         return np.asarray(self.dist)[order]
 
+    def rows_by_source(self) -> dict:
+        """Source vertex -> its distance row, in whatever memory ``dist``
+        lives (device rows stay device-resident — no implicit download).
+        The serving layer's unit of storage: ``serve.store.TileStore``
+        tiers exactly these rows."""
+        return {int(s): self.dist[i] for i, s in enumerate(self.sources)}
+
     def path(self, source: int, target: int) -> list[int]:
         """Vertex sequence of a shortest ``source -> target`` path (empty if
         unreachable). Requires a ``predecessors=True`` solve."""

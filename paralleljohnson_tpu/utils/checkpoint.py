@@ -18,6 +18,8 @@ leaves only a ``.tmp.npz`` that ``load``/``completed_batches`` ignore.
 from __future__ import annotations
 
 import hashlib
+import json
+import os
 import queue
 import threading
 import time
@@ -26,6 +28,8 @@ from pathlib import Path
 import numpy as np
 
 from paralleljohnson_tpu.utils.resilience import SolveCorruptionError
+
+MANIFEST_NAME = "manifest.json"
 
 
 def _sources_digest(sources: np.ndarray) -> str:
@@ -54,6 +58,7 @@ class BatchCheckpointer:
             digest = graph_key if isinstance(graph_key, str) else graph_digest(graph_key)
             self.dir = self.dir / f"graph_{digest}"
         self.dir.mkdir(parents=True, exist_ok=True)
+        self._manifest_lock = threading.Lock()
 
     def _path(self, batch_idx: int, sources: np.ndarray) -> Path:
         return self.dir / f"rows_{batch_idx:06d}_{_sources_digest(sources)}.npz"
@@ -84,7 +89,100 @@ class BatchCheckpointer:
             payload.update(pred=pred, pred_sha=self._sha(pred))
         np.savez_compressed(tmp, **payload)
         tmp.rename(path)  # atomic publish: partial writes never count as done
+        # Manifest AFTER the row file is published: a crash between the
+        # two leaves a valid-but-unlisted batch, which resume recomputes
+        # and re-lists — never a listed-but-missing one.
+        self._manifest_add(path.name, batch_idx, sources)
         return path
+
+    # -- manifest (O(1) cold-tile lookup for the serving layer) --------------
+
+    def _manifest_path(self) -> Path:
+        return self.dir / MANIFEST_NAME
+
+    def _read_manifest_file(self) -> dict | None:
+        p = self._manifest_path()
+        if not p.exists():
+            return None
+        try:
+            data = json.loads(p.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            return None  # torn/corrupt manifest -> callers fall back to scan
+        if not isinstance(data, dict) or "files" not in data:
+            return None
+        return data
+
+    def _write_manifest_file(self, data: dict) -> None:
+        p = self._manifest_path()
+        tmp = p.with_name(p.name + f".tmp{os.getpid()}")
+        tmp.write_text(json.dumps(data), encoding="utf-8")
+        os.replace(tmp, p)  # atomic: a reader never sees a torn manifest
+
+    def _manifest_add(self, filename: str, batch_idx: int,
+                      sources: np.ndarray) -> None:
+        with self._manifest_lock:
+            data = self._read_manifest_file() or {"version": 1, "files": {}}
+            data["files"][filename] = {
+                "batch": int(batch_idx),
+                "sources": np.asarray(sources, np.int64).tolist(),
+            }
+            self._write_manifest_file(data)
+
+    def _scan_files(self) -> list[Path]:
+        # a crashed save leaves rows_*.tmp.npz — never published, not done
+        return sorted(
+            p for p in self.dir.glob("rows_*.npz")
+            if not p.name.endswith(".tmp.npz")
+        )
+
+    def _rebuild_manifest(self) -> dict:
+        """Pre-manifest directory: rescan every published batch file once,
+        then persist the result so the next open is O(1) again."""
+        data: dict = {"version": 1, "files": {}}
+        for p in self._scan_files():
+            try:
+                with np.load(p) as npz:
+                    sources = np.asarray(npz["sources"], np.int64)
+            except Exception:  # noqa: BLE001 — corrupt batch: not listable
+                continue
+            data["files"][p.name] = {
+                "batch": int(p.name.split("_")[1]),
+                "sources": sources.tolist(),
+            }
+        try:
+            self._write_manifest_file(data)
+        except OSError:
+            pass  # read-only store dir: serve from the in-memory rebuild
+        return data
+
+    def manifest(self) -> dict[int, tuple[int, str]]:
+        """Source vertex -> ``(batch_idx, batch_filename)`` for every batch
+        this directory holds — the O(1) cold-tile index the serving layer
+        keys row lookups off (``serve.store.TileStore``). Served from the
+        persisted ``manifest.json`` (written once per :meth:`save`);
+        pre-manifest directories are rescanned once and the rebuilt
+        manifest persisted. A source solved by several batches maps to
+        the newest listing (identical rows either way: checkpoints are
+        keyed by graph content)."""
+        with self._manifest_lock:
+            data = self._read_manifest_file()
+            if data is None:
+                data = self._rebuild_manifest()
+        out: dict[int, tuple[int, str]] = {}
+        for filename in sorted(data["files"]):
+            entry = data["files"][filename]
+            for s in entry["sources"]:
+                out[int(s)] = (int(entry["batch"]), filename)
+        return out
+
+    def batch_sources(self, filename: str) -> np.ndarray | None:
+        """The exact sources array a manifest-listed batch file covers
+        (what :meth:`load` needs to re-derive the file's digest path)."""
+        with self._manifest_lock:
+            data = self._read_manifest_file()
+        if data is None or filename not in data["files"]:
+            return None
+        return np.asarray(data["files"][filename]["sources"], np.int64)
 
     def load(
         self, batch_idx: int, sources: np.ndarray, *, with_pred: bool = False
@@ -122,11 +220,18 @@ class BatchCheckpointer:
         return None
 
     def completed_batches(self) -> list[int]:
+        """Batch indices with a published row file, via the persisted
+        manifest (O(#batches), no directory re-hash per call); falls back
+        to the glob scan for pre-manifest directories. Entries whose file
+        has since been deleted are dropped — the manifest lists, the
+        filesystem decides."""
+        with self._manifest_lock:
+            data = self._read_manifest_file()
+        if data is None:
+            return sorted(int(p.name.split("_")[1]) for p in self._scan_files())
         return sorted(
-            int(p.name.split("_")[1])
-            for p in self.dir.glob("rows_*.npz")
-            # a crashed save leaves rows_*.tmp.npz — never published, not done
-            if not p.name.endswith(".tmp.npz")
+            int(e["batch"]) for f, e in data["files"].items()
+            if (self.dir / f).exists()
         )
 
 
@@ -268,7 +373,14 @@ class AsyncCheckpointWriter:
 
     def flush(self) -> None:
         """Barrier: every submitted commit is on disk (or the first
-        failure re-raises). Run before a checkpointed solve returns."""
+        failure re-raises). Run before a checkpointed solve returns.
+        After ``close`` this is a no-op — the close already drained the
+        queue, and a failure it held was either surfaced on an earlier
+        submit/flush or deliberately swallowed by the teardown path;
+        re-raising it from a later flush would mask the original error
+        (or raise out of a ``finally``)."""
+        if self._closed:
+            return
         self._q.join()
         if self._exc is not None:
             self._raise_pending()
@@ -276,7 +388,8 @@ class AsyncCheckpointWriter:
     def close(self) -> None:
         """Drain what is queued, stop the worker, never raise (teardown
         path: an unrelated solve error must not be masked, and completed
-        rows should still commit so resume can use them)."""
+        rows should still commit so resume can use them). Idempotent:
+        double-close and close-after-dead-worker are no-ops."""
         if self._closed:
             return
         self._closed = True

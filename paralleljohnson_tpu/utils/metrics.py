@@ -144,6 +144,21 @@ class SolverStats:
         }
 
 
+def latency_percentiles(samples_ms, pcts=(50, 99)) -> dict:
+    """``{"p50_ms": ..., "p99_ms": ...}`` over a latency sample list —
+    shared by the query engine's stats and the serving bench row so both
+    report the SAME definition (numpy linear-interpolation percentile).
+    Empty samples yield zeros (a row of a store that served nothing)."""
+    import numpy as np
+
+    if len(samples_ms) == 0:
+        return {f"p{p}_ms": 0.0 for p in pcts}
+    arr = np.asarray(samples_ms, np.float64)
+    return {
+        f"p{p}_ms": float(np.percentile(arr, p)) for p in pcts
+    }
+
+
 @contextlib.contextmanager
 def phase_timer(stats: SolverStats, phase: str, telemetry=None):
     """Times a phase; also opens a ``jax.named_scope``-style profiler scope

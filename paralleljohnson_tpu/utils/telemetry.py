@@ -510,10 +510,16 @@ _PROM_METRICS = (
 
 
 def write_prom_metrics(stats: Any, path: str | Path, *,
-                       labels: dict | None = None) -> Path:
-    """Write one solve's stats in Prometheus textfile-collector format
+                       labels: dict | None = None,
+                       metrics: tuple | None = None) -> Path:
+    """Write one stats object in Prometheus textfile-collector format
     (atomic tmp+rename — node_exporter may scrape mid-write). ``labels``
     adds constant labels to every sample (e.g. ``{"config": "rmat_apsp"}``).
+    ``metrics`` is the ``(name, type, help, getter)`` table to emit —
+    default the solve-stats table above; the serving layer passes its own
+    (``serve.engine.SERVE_PROM_METRICS``: pjtpu_queries_total,
+    pjtpu_query_latency_*, ...) so every subsystem exports through this
+    one atomic writer.
     """
     label_str = ""
     if labels:
@@ -522,7 +528,7 @@ def write_prom_metrics(stats: Any, path: str | Path, *,
         )
         label_str = "{" + inner + "}"
     lines = []
-    for name, mtype, help_text, get in _PROM_METRICS:
+    for name, mtype, help_text, get in (metrics or _PROM_METRICS):
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {mtype}")
         lines.append(f"{name}{label_str} {float(get(stats))}")

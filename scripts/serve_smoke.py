@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""serve-smoke: the staged TPU pass's query-serving check (ISSUE 6).
+
+Builds a tile store from a small SOLVED checkpoint directory, replays a
+canned query file through the ``pjtpu serve`` CLI (a real subprocess —
+the same entry point production would script), and asserts:
+
+- every exact answer is BITWISE-equal to the solver's rows for the same
+  (graph, source, dst);
+- the replay's hit rate over the pre-solved sources is 100% (the store
+  actually served from its tiers — zero scheduled batches);
+- approximate answers carry a max_error that bounds the true error.
+
+CPU tier-1 twin: ``tests/test_serve.py``. Run standalone:
+    python scripts/serve_smoke.py [--backend jax]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default="jax")
+    ap.add_argument("--nodes", type=int, default=512)
+    ap.add_argument("--solved-sources", type=int, default=64)
+    ap.add_argument("--queries", type=int, default=256)
+    args = ap.parse_args()
+
+    from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+    from paralleljohnson_tpu.graphs import erdos_renyi, save_dimacs
+
+    g = erdos_renyi(args.nodes, 8.0 / args.nodes, seed=29)
+    rng = np.random.default_rng(31)
+    solved = np.sort(rng.choice(
+        args.nodes, size=min(args.solved_sources, args.nodes), replace=False
+    ))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        store_dir = tmp / "store"
+        graph_file = tmp / "graph.gr"
+        save_dimacs(g, graph_file)
+
+        # 1) a small solved checkpoint dir — the artifact a real run
+        #    leaves behind (same code path: solve --checkpoint-dir).
+        cfg = SolverConfig(
+            backend=args.backend, checkpoint_dir=str(store_dir),
+            source_batch_size=max(8, len(solved) // 4),
+        )
+        res = ParallelJohnsonSolver(cfg).solve(g, sources=solved)
+        exact = {int(s): np.asarray(res.dist)[i]
+                 for i, s in enumerate(res.sources)}
+
+        # 2) canned query files: an exact replay over pre-solved
+        #    sources (hit rate must be 1.0 — zero scheduled batches) and
+        #    a separate approx replay over UNSOLVED sources (misses by
+        #    construction; every answer must be flagged with max_error).
+        unsolved = np.array(sorted(set(range(args.nodes)) - set(map(int, solved))))
+        exact_q = [{"id": i, "source": int(rng.choice(solved)),
+                    "dst": int(rng.integers(args.nodes))}
+                   for i in range(args.queries)]
+        approx_q = [{"id": i, "source": int(rng.choice(unsolved)),
+                     "dst": int(rng.integers(args.nodes)),
+                     "mode": "approx"}
+                    for i in range(32)]
+        exact_file, approx_file = tmp / "exact.jsonl", tmp / "approx.jsonl"
+        exact_file.write_text("".join(json.dumps(q) + "\n" for q in exact_q))
+        approx_file.write_text("".join(json.dumps(q) + "\n" for q in approx_q))
+
+        def replay(q_file):
+            proc = subprocess.run(
+                [sys.executable, "-m", "paralleljohnson_tpu.cli", "serve",
+                 str(graph_file), "--backend", args.backend,
+                 "--store-dir", str(store_dir), "--landmarks", "8",
+                 "--queries", str(q_file), "--summary"],
+                capture_output=True, text=True, cwd=REPO, timeout=1200,
+            )
+            if proc.returncode != 0:
+                print(proc.stdout[-2000:])
+                print(proc.stderr[-2000:])
+                raise SystemExit(
+                    f"FAIL serve-smoke: serve CLI exited {proc.returncode}"
+                )
+            responses = [json.loads(line) for line in
+                         proc.stdout.strip().splitlines()]
+            summary = json.loads(proc.stderr.strip().splitlines()[-1])
+            return responses, summary
+
+        failures = []
+
+        # 3) exact replay: bitwise answers, 100% hit rate, no solves.
+        responses, summary = replay(exact_file)
+        for r in responses:
+            if "error" in r:
+                failures.append(f"query {r.get('id')} errored: {r['error']}")
+                continue
+            want = float(exact[r["source"]][r["dst"]])
+            if not r["exact"]:
+                failures.append(f"query {r['id']}: expected exact answer")
+            elif r["distance"] != want and not (
+                    np.isinf(r["distance"]) and np.isinf(want)):
+                failures.append(
+                    f"query {r['id']}: {r['distance']} != {want} (bitwise)"
+                )
+        hit_rate = summary["store"]["hit_rate"]
+        scheduled = summary["engine"]["batches_scheduled"]
+        if hit_rate != 1.0:
+            failures.append(
+                f"exact replay hit rate {hit_rate} != 1.0 — the solved "
+                "store should have served every query from its tiers"
+            )
+        if scheduled != 0:
+            failures.append(f"{scheduled} batches scheduled on solved sources")
+
+        # 4) approx replay: every answer flagged with its error bound.
+        responses, asummary = replay(approx_file)
+        for r in responses:
+            if "error" in r:
+                failures.append(f"approx {r.get('id')} errored: {r['error']}")
+            elif r["exact"] or "max_error" not in r:
+                failures.append(f"approx {r['id']}: answer not flagged")
+        if asummary["engine"]["batches_scheduled"] != 0:
+            failures.append("approx replay scheduled a solve")
+
+        for f in failures[:10]:
+            print("FAIL:", f)
+        if failures:
+            print(f"FAIL serve-smoke: {len(failures)} failures")
+            return 1
+        print(
+            f"PASS serve-smoke: {len(exact_q)} bitwise-exact answers "
+            f"(hit rate {hit_rate}, 0 scheduled batches), "
+            f"{len(approx_q)} flagged approximations; exact-replay p50 "
+            f"{summary['engine']['p50_ms']} ms / p99 "
+            f"{summary['engine']['p99_ms']} ms"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -159,6 +159,16 @@ run 900 jax-dimacs-pred python -m paralleljohnson_tpu.cli bench dimacs_ny_scramb
 #     any win to compute/transfer/IO overlap rather than noise
 run 1800 jax-rmat-pipelined python -m paralleljohnson_tpu.cli bench rmat_apsp_pipelined --backend jax --preset full --update-baseline BASELINE.md
 
+# 4f) query-serving smoke (round-11 tentpole): build a store from a
+#     small solved checkpoint dir, replay canned queries through the
+#     real `pjtpu serve` CLI, assert 1.0 hit-rate + bitwise-exact
+#     answers + flagged approximations (CPU twin: tests/test_serve.py)
+run 900 serve-smoke python scripts/serve_smoke.py
+
+# 4g) the recorded serving bench row (queries/sec + p50/p99 latency in
+#     the detail column — serving performance tracked like kernels)
+run 900 jax-serve-bench python -m paralleljohnson_tpu.cli bench serve_queries --backend jax --preset full --update-baseline BASELINE.md
+
 # 5) driver metric (should reflect the blocked kernel now)
 run 1200 bench.py python bench.py
 

@@ -5,7 +5,12 @@ import pytest
 
 from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
 from paralleljohnson_tpu.graphs import erdos_renyi
-from paralleljohnson_tpu.utils.checkpoint import BatchCheckpointer
+from paralleljohnson_tpu.utils.checkpoint import (
+    MANIFEST_NAME,
+    AsyncCheckpointWriter,
+    BatchCheckpointer,
+)
+from paralleljohnson_tpu.utils.resilience import SolveCorruptionError
 
 
 def test_resume_skips_completed_batches(tmp_path):
@@ -89,6 +94,94 @@ def test_tampered_rows_detected(tmp_path):
     again = ParallelJohnsonSolver(cfg).solve(g)
     assert again.stats.batches_resumed == 0
     np.testing.assert_array_equal(clean.matrix, again.matrix)
+
+
+def test_manifest_written_per_save(tmp_path):
+    """Every save updates manifest.json: source -> batch-file lookup is
+    O(1) for the serving layer's cold tier, no directory re-hash."""
+    ck = BatchCheckpointer(tmp_path)
+    s0, s1 = np.array([0, 1, 2]), np.array([5, 7])
+    ck.save(0, s0, np.zeros((3, 4), np.float32))
+    ck.save(1, s1, np.ones((2, 4), np.float32))
+    assert (ck.dir / MANIFEST_NAME).exists()
+    m = ck.manifest()
+    assert set(m) == {0, 1, 2, 5, 7}
+    batch_idx, filename = m[7]
+    assert batch_idx == 1
+    np.testing.assert_array_equal(ck.batch_sources(filename), s1)
+    # load() through the manifest-listed sources round-trips the rows.
+    rows, _ = ck.load(batch_idx, ck.batch_sources(filename))
+    np.testing.assert_array_equal(rows, np.ones((2, 4), np.float32))
+    assert ck.completed_batches() == [0, 1]
+
+
+def test_completed_batches_premanifest_fallback(tmp_path):
+    """A directory from before the manifest era (or with it deleted)
+    still resolves: completed_batches falls back to the scan, and
+    manifest() rebuilds AND persists the index."""
+    ck = BatchCheckpointer(tmp_path)
+    ck.save(0, np.array([0, 1]), np.zeros((2, 4)))
+    ck.save(1, np.array([2, 3]), np.zeros((2, 4)))
+    (ck.dir / MANIFEST_NAME).unlink()
+    assert ck.completed_batches() == [0, 1]
+    fresh = BatchCheckpointer(tmp_path)  # re-open without the manifest
+    m = fresh.manifest()
+    assert set(m) == {0, 1, 2, 3}
+    assert (fresh.dir / MANIFEST_NAME).exists()  # rebuilt index persisted
+
+
+def test_manifest_entries_dropped_with_their_files(tmp_path):
+    ck = BatchCheckpointer(tmp_path)
+    ck.save(0, np.array([0]), np.zeros((1, 4)))
+    ck.save(1, np.array([1]), np.zeros((1, 4)))
+    _, filename = ck.manifest()[0]
+    (ck.dir / filename).unlink()
+    assert ck.completed_batches() == [1]
+
+
+def test_manifest_same_batch_idx_different_sources(tmp_path):
+    """Separate solves sharing a directory reuse batch indices with
+    different source digests (the serving engine's scheduled batches) —
+    the manifest keys by FILE, so neither listing clobbers the other."""
+    ck = BatchCheckpointer(tmp_path)
+    ck.save(0, np.array([0, 1]), np.zeros((2, 4)))
+    ck.save(0, np.array([8, 9]), np.ones((2, 4)))
+    m = ck.manifest()
+    assert set(m) == {0, 1, 8, 9}
+    assert m[0][1] != m[8][1]
+    assert ck.completed_batches() == [0, 0]
+
+
+def test_async_writer_close_and_flush_idempotent(tmp_path):
+    """Double-close and flush-after-close are no-ops — no hangs, no
+    re-raise of an error that already surfaced (regression: a teardown
+    flush must not mask the original failure)."""
+    ck = BatchCheckpointer(tmp_path)
+    w = AsyncCheckpointWriter(ck)
+    w.submit(0, np.array([0]), np.zeros((1, 4)))
+    w.flush()
+    w.close()
+    w.close()   # idempotent
+    w.flush()   # no-op after close: no hang, no raise
+    w.flush()
+    assert ck.completed_batches() == [0]
+    with pytest.raises(RuntimeError, match="closed"):
+        w.submit(1, np.array([1]), np.zeros((1, 4)))
+
+
+def test_async_writer_flush_after_close_does_not_rethrow(tmp_path):
+    """A writer failure surfaces ONCE (on flush), then close(); later
+    flushes stay silent instead of re-raising the surfaced error."""
+    def boom(batch_idx):
+        raise OSError("disk gone")
+
+    w = AsyncCheckpointWriter(BatchCheckpointer(tmp_path), fault_hook=boom)
+    w.submit(0, np.array([0]), np.zeros((1, 4)))
+    with pytest.raises(SolveCorruptionError, match="disk gone"):
+        w.flush()
+    w.close()
+    w.flush()  # already-surfaced error must not re-raise here
+    w.close()
 
 
 def test_legacy_checkpoint_without_checksum_resumes(tmp_path):

@@ -1,0 +1,369 @@
+"""Query-serving layer tests (ROADMAP item 6 — the round-11 tentpole).
+
+The serving contract under test:
+- every EXACT answer is bitwise-equal to ``ParallelJohnsonSolver.solve``
+  output for the same (graph, source, dst);
+- every APPROXIMATE answer carries ``max_error`` with
+  ``|answer - exact| <= max_error`` (inf-aware);
+- a cold-store query schedules exactly ONE exact batch (exact counters)
+  and later queries for that source hit the in-memory tiers;
+- the bench emits a serving row with queries_per_s / p50_ms / p99_ms.
+
+CPU tier-1 twin of the staged TPU pass's ``serve-smoke`` stage
+(``scripts/serve_smoke.py``).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.graphs import erdos_renyi, grid2d
+from paralleljohnson_tpu.serve import (
+    SERVE_STATS_FILENAME,
+    LandmarkIndex,
+    QueryEngine,
+    QueryError,
+    TileStore,
+)
+
+
+def _cfg(**kw) -> SolverConfig:
+    return SolverConfig(backend="numpy", **kw)
+
+
+def _exact_matrix(g) -> np.ndarray:
+    return np.asarray(ParallelJohnsonSolver(_cfg()).solve(g).matrix)
+
+
+# -- the exact serving contract ----------------------------------------------
+
+
+def test_exact_answers_bitwise_equal_to_solver(tmp_path):
+    g = erdos_renyi(48, 0.08, seed=3)
+    exact = _exact_matrix(g)
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg())
+    rng = np.random.default_rng(0)
+    for s, t in rng.integers(0, 48, size=(20, 2)):
+        r = engine.query(int(s), int(t))
+        assert r["exact"] is True
+        assert r["max_error"] == 0.0
+        # Bitwise: both sides are the same f32 value, losslessly widened.
+        assert r["distance"] == float(exact[s, t])
+
+
+def test_exact_contract_negative_weights(tmp_path):
+    """The Johnson path (reweight + unreweight) serves bitwise too."""
+    g = grid2d(5, 5, negative_fraction=0.2, seed=7)
+    exact = _exact_matrix(g)
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg())
+    for s, t in [(0, 24), (7, 3), (12, 12), (24, 0)]:
+        r = engine.query(s, t)
+        assert r["exact"] is True
+        assert r["distance"] == float(exact[s, t])
+
+
+def test_cold_query_schedules_one_batch_then_hits_lru(tmp_path):
+    g = erdos_renyi(32, 0.1, seed=5)
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg())
+    r1 = engine.query(4, 9)
+    assert r1["tier"] == "solved"
+    assert engine.stats.batches_scheduled == 1
+    assert engine.stats.solved_sources == 1
+    # Same source again: no new batch — the hot tier has the row.
+    r2 = engine.query(4, 11)
+    assert r2["tier"] == "hot"
+    assert engine.stats.batches_scheduled == 1
+    assert engine.store.hits_hot == 1
+    assert r1["exact"] and r2["exact"]
+
+
+def test_batch_aggregation_one_solve_for_all_misses(tmp_path):
+    """Many concurrent queries -> ONE source-batched solve: repeated
+    sources are deduped, every miss joins the same scheduled batch."""
+    g = erdos_renyi(32, 0.1, seed=6)
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg())
+    reqs = [{"id": i, "source": s, "dst": (s + 1) % 32}
+            for i, s in enumerate([3, 7, 3, 11, 7, 3])]
+    responses = engine.query_batch(reqs)
+    assert engine.stats.batches_scheduled == 1
+    assert engine.stats.solved_sources == 3  # {3, 7, 11}
+    assert [r["id"] for r in responses] == list(range(6))
+    assert all(r["exact"] for r in responses)
+    # The store was consulted once per DISTINCT source.
+    assert engine.store.misses == 3
+
+
+def test_store_attaches_to_finished_solve_dir(tmp_path):
+    """A store over a plain ``--checkpoint-dir`` solve serves from the
+    cold tier without scheduling anything; the decoded batch is
+    promoted so the next lookup is a warm hit."""
+    g = erdos_renyi(40, 0.1, seed=8)
+    cfg = _cfg(source_batch_size=10, checkpoint_dir=str(tmp_path))
+    full = ParallelJohnsonSolver(cfg).solve(g)
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg())
+    r = engine.query(17, 23)
+    assert r["tier"] == "cold"
+    assert engine.stats.batches_scheduled == 0
+    assert r["distance"] == float(np.asarray(full.matrix)[17, 23])
+    r2 = engine.query(17, 5)
+    assert r2["tier"] == "warm"
+    assert engine.store.cold_loads == 1
+
+
+def test_one_to_many_and_full_row(tmp_path):
+    g = erdos_renyi(24, 0.15, seed=9)
+    exact = _exact_matrix(g)
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg())
+    r = engine.query(2, [0, 5, 23])
+    np.testing.assert_array_equal(r["distances"], exact[2, [0, 5, 23]])
+    full = engine.query(2)  # dst omitted = the whole row
+    assert len(full["distances"]) == 24
+    np.testing.assert_array_equal(full["distances"], exact[2])
+
+
+def test_tier_demotion_and_eviction(tmp_path):
+    g = erdos_renyi(24, 0.15, seed=10)
+    store = TileStore(None, g, hot_rows=2, warm_rows=3)
+    res = ParallelJohnsonSolver(_cfg()).solve(g, sources=np.arange(6))
+    store.put(res.sources, np.asarray(res.dist))
+    assert store.stats()["hot_rows"] == 2
+    assert store.stats()["warm_rows"] == 3
+    assert store.demotions == 4   # 6 hot inserts through a 2-slot tier
+    assert store.evictions == 1   # 4 demotions through a 3-slot warm tier
+    row, tier = store.get(5)
+    assert tier == "hot"
+    row, tier = store.get(3)
+    assert tier == "warm"
+    # Evicted early sources are gone (no cold tier behind this store).
+    assert store.get(0) == (None, None)
+    assert store.misses == 1
+
+
+# -- the approximate serving contract ----------------------------------------
+
+
+def _assert_bounds_hold(lm, exact_matrix, v):
+    for s in range(v):
+        lower, upper = lm.bounds_row(s)
+        ex = exact_matrix[s].astype(np.float64)
+        assert np.all(lower <= ex), (
+            f"lower bound violated at source {s}: "
+            f"max excess {np.max(lower - ex)}"
+        )
+        assert np.all(ex <= upper), (
+            f"upper bound violated at source {s}"
+        )
+        est, err = lm.estimate_row(s)
+        # upper - estimate <= max_error, and the answer error is bounded.
+        both_inf = np.isinf(est) & np.isinf(ex)
+        with np.errstate(invalid="ignore"):  # inf-inf in the masked branch
+            diff = np.where(both_inf, 0.0, np.abs(est - ex))
+        assert np.all(diff <= err)
+
+
+def test_landmark_bounds_deterministic_random_graphs():
+    """Always-on twin of the hypothesis property test (this CI image may
+    lack hypothesis): lower <= exact <= upper and |estimate - exact| <=
+    max_error on seeded sparse graphs with disconnected pairs."""
+    for seed in range(4):
+        g = erdos_renyi(28, 0.07, seed=seed)  # sparse: real inf pairs
+        exact = _exact_matrix(g)
+        assert np.isinf(exact).any(), "fixture should have disconnected pairs"
+        lm = LandmarkIndex.build(g, 4, config=_cfg(), seed=seed)
+        _assert_bounds_hold(lm, exact, g.num_nodes)
+
+
+def test_landmark_bounds_property_hypothesis():
+    pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    @st.composite
+    def graphs(draw):
+        n = draw(st.integers(2, 16))
+        m = draw(st.integers(0, 3 * n))
+        pairs = draw(st.lists(
+            st.tuples(st.integers(0, n - 1), st.integers(0, n - 1)),
+            min_size=m, max_size=m,
+        ))
+        pairs = [(u, v) for u, v in pairs if u != v]
+        ws = draw(st.lists(
+            st.floats(0, 10, allow_nan=False, width=32),
+            min_size=len(pairs), max_size=len(pairs),
+        ))
+        if not pairs:
+            from paralleljohnson_tpu.graphs import CSRGraph
+
+            return CSRGraph.from_edges([], [], [], n)
+        from paralleljohnson_tpu.graphs import CSRGraph
+
+        s, d = zip(*pairs)
+        return CSRGraph.from_edges(s, d, ws, n)
+
+    @settings(max_examples=15, deadline=None)
+    @given(graphs(), st.integers(0, 2**31 - 1))
+    def check(g, seed):
+        exact = _exact_matrix(g)
+        lm = LandmarkIndex.build(
+            g, min(3, g.num_nodes), config=_cfg(), seed=seed
+        )
+        _assert_bounds_hold(lm, exact, g.num_nodes)
+
+    check()
+
+
+def test_landmark_miss_policy_answers_flagged(tmp_path):
+    g = erdos_renyi(40, 0.08, seed=11)
+    exact = _exact_matrix(g)
+    lm = LandmarkIndex.build(g, 5, config=_cfg(), seed=1)
+    engine = QueryEngine(g, TileStore(tmp_path, g), landmarks=lm,
+                         config=_cfg(), miss_policy="landmark")
+    rng = np.random.default_rng(2)
+    for s, t in rng.integers(0, 40, size=(15, 2)):
+        r = engine.query(int(s), int(t))
+        assert r["exact"] is False
+        assert r["tier"] == "landmark"
+        e = float(exact[s, t])
+        if np.isinf(r["distance"]) and np.isinf(e):
+            continue
+        assert abs(r["distance"] - e) <= r["max_error"]
+    # No exact batch was ever scheduled on this policy.
+    assert engine.stats.batches_scheduled == 0
+    assert engine.stats.approx_answers == 15
+
+
+def test_landmark_policy_requires_index(tmp_path):
+    g = erdos_renyi(8, 0.3, seed=1)
+    with pytest.raises(ValueError, match="landmark"):
+        QueryEngine(g, TileStore(tmp_path, g), config=_cfg(),
+                    miss_policy="landmark")
+
+
+def test_per_request_mode_override(tmp_path):
+    """mode='approx' on a single request answers from landmarks even
+    under the default solve policy — and never schedules a batch."""
+    g = erdos_renyi(30, 0.1, seed=12)
+    lm = LandmarkIndex.build(g, 4, config=_cfg(), seed=0)
+    engine = QueryEngine(g, TileStore(tmp_path, g), landmarks=lm,
+                         config=_cfg(), miss_policy="solve")
+    r = engine.query(3, 9, mode="approx")
+    assert r["exact"] is False and "max_error" in r
+    assert engine.stats.batches_scheduled == 0
+
+
+def test_landmark_index_persistence_and_digest_guard(tmp_path):
+    g = erdos_renyi(20, 0.15, seed=13)
+    lm = LandmarkIndex.build(g, 3, config=_cfg(), seed=0)
+    lm.save(tmp_path)
+    loaded = LandmarkIndex.load(tmp_path, expect_digest=lm.digest)
+    assert loaded is not None and loaded.k == 3
+    np.testing.assert_array_equal(loaded.fwd, lm.fwd)
+    # A different graph's digest must refuse the stale index.
+    assert LandmarkIndex.load(tmp_path, expect_digest="ffff") is None
+
+
+# -- errors, metrics, persistence --------------------------------------------
+
+
+def test_query_errors_survive_the_batch(tmp_path):
+    g = erdos_renyi(16, 0.2, seed=14)
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg())
+    responses = engine.query_batch([
+        {"source": 999, "dst": 0},
+        {"source": 1, "dst": 2},
+        {"source": 1, "dst": [0, 99]},
+        "not an object",
+    ])
+    assert "error" in responses[0]
+    assert responses[1]["exact"] is True
+    assert "error" in responses[2]
+    assert "error" in responses[3]
+    assert engine.stats.errors == 3
+    with pytest.raises(QueryError):
+        engine.query(-1, 0)
+
+
+def test_serve_prom_metrics(tmp_path):
+    g = erdos_renyi(16, 0.2, seed=15)
+    engine = QueryEngine(g, TileStore(tmp_path / "store", g), config=_cfg())
+    engine.query(0, 5)
+    engine.query(0, 6)
+    out = engine.write_metrics(tmp_path / "serve.prom",
+                               labels={"command": "serve"})
+    text = out.read_text()
+    assert 'pjtpu_queries_total{command="serve"} 2.0' in text
+    assert "pjtpu_query_latency_p50_ms" in text
+    assert "pjtpu_query_latency_p99_ms" in text
+    assert 'pjtpu_serve_batches_scheduled_total{command="serve"} 1.0' in text
+
+
+def test_serve_stats_persisted_for_info(tmp_path):
+    g = erdos_renyi(16, 0.2, seed=16)
+    store = TileStore(tmp_path, g)
+    engine = QueryEngine(g, store, config=_cfg())
+    engine.query(2, 3)
+    engine.close()
+    stats_file = store.ckpt.dir / SERVE_STATS_FILENAME
+    payload = json.loads(stats_file.read_text())
+    assert payload["engine"]["queries_total"] == 1
+    assert payload["store"]["hot_capacity"] == store.hot_rows
+
+
+# -- ops surface: bench row + CLI loop ---------------------------------------
+
+
+def test_bench_emits_serving_row():
+    from paralleljohnson_tpu import benchmarks
+
+    recs = benchmarks.run(["serve_queries"], backend="numpy",
+                          preset="smoke")
+    assert len(recs) == 1
+    detail = recs[0].detail
+    assert "failed" not in detail, detail
+    for key in ("queries_per_s", "p50_ms", "p99_ms"):
+        assert key in detail and detail[key] > 0, (key, detail)
+    assert 0.0 < detail["hit_rate"] <= 1.0
+
+
+def test_cli_serve_jsonl_loop(tmp_path, capsys):
+    from paralleljohnson_tpu import cli
+
+    queries = tmp_path / "q.jsonl"
+    queries.write_text(
+        '{"id": 0, "source": 1, "dst": 4}\n'
+        '{"id": 1, "source": 1, "dst": [2, 3]}\n'
+        '{"id": 2, "source": 6, "dst": 1, "mode": "approx"}\n'
+    )
+    rc = cli.main([
+        "serve", "er:n=32,p=0.12", "--backend", "numpy",
+        "--store-dir", str(tmp_path / "store"),
+        "--landmarks", "3", "--queries", str(queries),
+    ])
+    assert rc == 0
+    lines = [json.loads(line) for line in
+             capsys.readouterr().out.strip().splitlines()]
+    assert [r["id"] for r in lines] == [0, 1, 2]
+    assert lines[0]["exact"] is True and "distance" in lines[0]
+    assert lines[1]["distances"] and len(lines[1]["distances"]) == 2
+    assert lines[2]["exact"] is False and "max_error" in lines[2]
+    # The store dir persisted rows + landmarks + counters.
+    assert list((tmp_path / "store").glob("graph_*/rows_*.npz"))
+    assert list((tmp_path / "store").glob("graph_*/landmarks.npz"))
+
+
+def test_cli_serve_malformed_line_exit_code(tmp_path, capsys):
+    from paralleljohnson_tpu import cli
+
+    queries = tmp_path / "q.jsonl"
+    queries.write_text('{"source": 0, "dst": 1}\nnot json\n')
+    rc = cli.main([
+        "serve", "er:n=16,p=0.2", "--backend", "numpy",
+        "--queries", str(queries),
+    ])
+    assert rc == 1
+    lines = [json.loads(line) for line in
+             capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2
+    assert "distance" in lines[0]
+    assert "error" in lines[1]
