@@ -130,7 +130,9 @@ def _run_config(
     if not ok:
         print("WARNING: result mismatch vs scipy oracle", file=sys.stderr)
 
-    return {
+    roofline_bound = _append_profile(res, g, n_sources, dt, label)
+
+    measured_out = {
         "edges_per_sec": res.edges_relaxed / dt / n_chips,
         "dt": dt,
         "t_ref": t_ref,
@@ -144,6 +146,109 @@ def _run_config(
         # rung numbers aren't mistaken for default-config measurements.
         "config": "default" if dense_threshold is None else "sparse-forced",
     }
+    if roofline_bound is not None:
+        measured_out["roofline_bound"] = roofline_bound
+    return measured_out
+
+
+def _append_profile(res, g, n_sources: int, dt: float, label: str):
+    """Cost-observatory record for the driver's own measurement (ISSUE 7
+    acceptance: a CPU ``bench.py`` run persists a profile store under
+    ``bench_artifacts/profiles/``). ``_run_config`` drives the backend
+    directly (no solver), so the compiled-cost capture lives on
+    ``res.cost`` — append it with the measured wall and a roofline
+    classification. Returns the bound (or None) for the metric detail;
+    never fatal."""
+    try:
+        profile_dir = os.environ.get("PJ_PROFILE_DIR")
+        if not profile_dir:
+            return None
+        import jax
+
+        from paralleljohnson_tpu.observe import ProfileStore, classify
+
+        platform = jax.default_backend()
+        cost = getattr(res, "cost", None) or {
+            "cost_analysis_unavailable":
+                "capture disabled for this route/backend"
+        }
+        roof = classify(
+            flops=cost.get("flops"),
+            bytes_accessed=cost.get("bytes_accessed"),
+            compute_s=dt,
+            platform=platform,
+        )
+        ProfileStore(profile_dir).append({
+            "ts": time.time(),
+            "kind": "bench",
+            "label": f"bench.py-{label}",
+            "route": getattr(res, "route", None),
+            "platform": platform,
+            "nodes": g.num_nodes,
+            "edges": g.num_real_edges,
+            "batch": int(n_sources),
+            "measured": {"wall_s": dt, "compute_s": dt},
+            "edges_relaxed": int(res.edges_relaxed),
+            "cost": cost,
+            "roofline": roof,
+        })
+        return roof.get("bound")
+    except Exception as e:  # noqa: BLE001 — observability is never fatal
+        print(f"WARNING: profile-store append failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return None
+
+
+def _record_history(measured: dict) -> None:
+    """Cost-observatory hook (ISSUE 7): append this measurement to the
+    bench-regression history under $PJ_PROFILE_DIR and WARN (stderr
+    only — stdout stays the driver's single JSON line) when it regresses
+    against the per-(bench, platform) trajectory. The history row keys
+    on the measured config, not the fallback tag, so a cpu-fallback and
+    a real TPU number never share a baseline. Never fatal, never
+    changes the exit code — the driver metric must survive a broken
+    history file."""
+    try:
+        profile_dir = os.environ.get("PJ_PROFILE_DIR")
+        if not profile_dir or "edges_per_sec" not in measured:
+            return
+        from paralleljohnson_tpu.observe.regress import (
+            BenchHistory,
+            detect_regressions,
+        )
+
+        row = {
+            "bench": (
+                f"driver:rmat{measured['scale']}x"
+                f"{measured['n_sources']}src"
+            ),
+            "backend": "jax",
+            "platform": measured.get("platform", "unknown"),
+            "preset": None,
+            "wall_s": float(measured["dt"]),
+            "detail": {
+                "value": measured["edges_per_sec"],
+                "route": measured.get("route"),
+                "config": measured.get("config"),
+            },
+            "source": "bench.py",
+        }
+        hist = BenchHistory(profile_dir)
+        # Wider band than the bench rows: the driver number runs on a
+        # shared container and its own artifacts call the series noise.
+        flagged = detect_regressions([row], hist.rows(), band=0.5)
+        for f in flagged:
+            print(
+                f"WARNING: bench regression — {f['bench']} on "
+                f"{f['platform']} took {f['wall_s']:.3f}s vs baseline "
+                f"{f['baseline_s']:.3f}s ({f['slowdown']:.2f}x, "
+                f"roofline: {f['roofline_bound']})",
+                file=sys.stderr,
+            )
+        hist.append(row)
+    except Exception as e:  # noqa: BLE001 — observability is never fatal
+        print(f"WARNING: bench history append failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
 
 
 def _emit(measured: dict, tag: str) -> None:
@@ -160,7 +265,8 @@ def _emit(measured: dict, tag: str) -> None:
     detail = {
         k: measured[k]
         for k in ("platform", "scale", "n_sources", "dt", "t_ref",
-                  "oracle_ok", "route", "repeats", "config")
+                  "oracle_ok", "route", "repeats", "config",
+                  "roofline_bound")
         if k in measured and measured[k] is not None
     }
     if measured.get("platform") != "tpu":
@@ -175,6 +281,7 @@ def _emit(measured: dict, tag: str) -> None:
     if detail:
         out["detail"] = detail
     print(json.dumps(out))
+    _record_history(measured)
 
 
 def _child_main(scale: int, n_sources: int, repeats: int) -> None:
@@ -359,6 +466,15 @@ def main() -> None:
     )
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    # Cost observatory on by default for the driver bench (ISSUE 7
+    # acceptance): compiled-cost capture + per-solve profile records +
+    # the bench-regression history persist under bench_artifacts/profiles
+    # (the child process inherits the env var).
+    os.environ.setdefault(
+        "PJ_PROFILE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_artifacts", "profiles"),
+    )
     from paralleljohnson_tpu.utils.platform import honor_cpu_platform_request
 
     tag = f"rmat{scale}x{n_sources}src"

@@ -50,6 +50,12 @@ class KernelResult:
       "frontier", "vm-blocked", "dense-squaring", "sharded-1d") — flows
       into SolverStats and benchmark rows so before/after kernel
       comparisons stay reconstructable across measurement rounds.
+    cost: compiled-cost capture for this invocation's executable
+      (``observe.costs``: flops / bytes_accessed / transcendentals +
+      memory analysis, or an explicit ``cost_analysis_unavailable``
+      marker), keyed per (route, platform, shape-bucket). None when
+      capture is disabled (no profile store configured) or the backend
+      is not cost-instrumented; folds into ``SolverStats.analytic_cost``.
     """
 
     dist: Any  # np.ndarray or a device array (see docstring)
@@ -59,6 +65,7 @@ class KernelResult:
     converged: bool = True
     pred: np.ndarray | None = None  # predecessor vertices, -1 = none
     route: str | None = None  # resolved kernel route (see docstring)
+    cost: dict | None = None  # compiled-cost capture (see docstring)
 
 
 class Backend(abc.ABC):

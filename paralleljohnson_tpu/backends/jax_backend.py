@@ -651,6 +651,46 @@ class JaxBackend(Backend):
         )
 
         enable_compilation_cache(self.config.compilation_cache_dir)
+        # Compiled-cost capture (observe.costs): enabled only when a
+        # profile store is configured (SolverConfig.profile_store /
+        # PJ_PROFILE_DIR) — capture pays one AOT lower+compile per
+        # (route, platform, shape-bucket) key, so plain solves opt out.
+        from paralleljohnson_tpu.observe.costs import (
+            CostCapture,
+            resolve_profile_dir,
+        )
+
+        self.cost_capture = CostCapture(
+            enabled=resolve_profile_dir(self.config.profile_store)
+            is not None
+        )
+
+    def _observe_cost(self, route, jitfn, args, kwargs, dgraph, batch=1):
+        """Harvest XLA cost/memory analysis for ``route``'s executable at
+        these shapes (once per key; see observe.costs). Returns the
+        analytic-cost dict for ``KernelResult.cost``, or None when
+        capture is off. Never raises — an unlowerable call degrades to
+        the explicit ``cost_analysis_unavailable`` marker inside."""
+        cap = self.cost_capture
+        if not cap.enabled:
+            return None
+        return cap.capture(
+            route, jitfn, args, kwargs,
+            num_nodes=dgraph.num_nodes,
+            num_edges=dgraph.num_real_edges, batch=batch,
+        )
+
+    def _observe_unavailable(self, route, reason, dgraph, batch=1):
+        """Explicit capture marker for routes with no single
+        AOT-lowerable executable (sharded collectives, Pallas slices)."""
+        cap = self.cost_capture
+        if not cap.enabled:
+            return None
+        return cap.unavailable(
+            route, reason,
+            num_nodes=dgraph.num_nodes,
+            num_edges=dgraph.num_real_edges, batch=batch,
+        )
 
     @property
     def _dtype(self):
@@ -1068,6 +1108,11 @@ class JaxBackend(Backend):
                     # Each round relaxes the full edge list (across shards).
                     edges_relaxed=iters * dgraph.num_real_edges,
                     route="edge-sharded",
+                    cost=self._observe_unavailable(
+                        "edge-sharded",
+                        "sharded collective executables are not "
+                        "cost-instrumented", dgraph,
+                    ),
                 )
             except Exception as e:
                 if resilience.is_oom_error(e):
@@ -1099,6 +1144,11 @@ class JaxBackend(Backend):
                     # entry once (= E: the layout stores all real edges).
                     edges_relaxed=iters * lay["num_entries"],
                     route="dia",
+                    cost=self._observe_cost(
+                        "dia", dia_fixpoint, (dist0, lay["w_diag"]),
+                        dict(offsets=lay["offsets"], max_iter=max_iter),
+                        dgraph,
+                    ),
                 )
             except Exception:
                 self._auto_route_failed(
@@ -1141,6 +1191,18 @@ class JaxBackend(Backend):
                 )
                 steps = int(steps)
                 examined = relax.examined_exact(ex_hi, ex_lo)
+                bucket_cost = self._observe_cost(
+                    "bucket", _bucket_kernel,
+                    (dist0, dgraph.src, dgraph.dst, dgraph.weights,
+                     dgraph.indptr_dev(),
+                     jnp.asarray(delta, self._dtype)),
+                    dict(max_steps=max_steps,
+                         capacity=auto_capacity(v, dgraph.max_degree),
+                         max_degree=dgraph.max_degree,
+                         num_real_edges=dgraph.num_real_edges,
+                         edge_chunk=chunk),
+                    dgraph,
+                )
                 if bool(still):
                     dist_b, it2, improving = _bf_kernel(
                         dist_b, dgraph.src, dgraph.dst, dgraph.weights,
@@ -1156,6 +1218,7 @@ class JaxBackend(Backend):
                         edges_relaxed=examined
                         + it2 * dgraph.num_real_edges,
                         route="bucket+sweep",
+                        cost=bucket_cost,
                     )
                 return KernelResult(
                     dist=dist_b,
@@ -1167,6 +1230,7 @@ class JaxBackend(Backend):
                     iterations=steps,
                     edges_relaxed=examined,
                     route="bucket",
+                    cost=bucket_cost,
                 )
             except Exception:
                 self._auto_route_failed(
@@ -1205,6 +1269,15 @@ class JaxBackend(Backend):
                         rounds=iters, inner_cap=self.config.gs_inner_cap,
                     ),
                     route="gs",
+                    cost=self._observe_cost(
+                        "gs", _gs_kernel,
+                        (dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
+                         bundle["w_blk"], bundle["rank"]),
+                        dict(vb=bundle["vb"], halo=bundle["halo"],
+                             max_outer=max_iter,
+                             inner_cap=self.config.gs_inner_cap),
+                        dgraph,
+                    ),
                 )
             except Exception:
                 self._gs_auto_failed(dgraph)  # re-raises when forced
@@ -1220,6 +1293,17 @@ class JaxBackend(Backend):
             )
             edges_relaxed = relax.examined_exact(ex_hi, ex_lo)
             route = "frontier"
+            cost = self._observe_cost(
+                "frontier", _bf_frontier_kernel,
+                (dist0, dgraph.src, dgraph.dst, dgraph.weights,
+                 dgraph.indptr_dev()),
+                dict(max_iter=max_iter,
+                     capacity=self._frontier_capacity(dgraph),
+                     max_degree=dgraph.max_degree,
+                     num_real_edges=dgraph.num_real_edges,
+                     edge_chunk=chunk),
+                dgraph,
+            )
         else:
             # Stays source-major even under fanout_layout="vertex_major":
             # a [V, 1] vm block wastes 127/128 lanes of the sorted segment
@@ -1232,6 +1316,12 @@ class JaxBackend(Backend):
             )
             edges_relaxed = int(iters) * dgraph.num_real_edges
             route = "sweep"
+            cost = self._observe_cost(
+                "sweep", _bf_kernel,
+                (dist0, dgraph.src, dgraph.dst, dgraph.weights),
+                dict(max_iter=max_iter, edge_chunk=chunk),
+                dgraph,
+            )
         iters = int(iters)
         improving = bool(improving)
         return KernelResult(
@@ -1241,6 +1331,7 @@ class JaxBackend(Backend):
             iterations=iters,
             edges_relaxed=edges_relaxed,
             route=route,
+            cost=cost,
         )
 
     def _use_pred_extraction(self) -> bool:
@@ -1341,6 +1432,12 @@ class JaxBackend(Backend):
             iterations=iters,
             edges_relaxed=iters * dgraph.num_real_edges,
             route="pred-sweep",
+            cost=self._observe_cost(
+                "pred-sweep", _bf_pred_kernel,
+                (dist0, dgraph.src, dgraph.dst, dgraph.weights),
+                dict(max_iter=max_iter, edge_chunk=chunk),
+                dgraph,
+            ),
         )
 
     def multi_source_pred(self, dgraph: JaxDeviceGraph, sources: np.ndarray) -> KernelResult:
@@ -1447,6 +1544,11 @@ class JaxBackend(Backend):
                 return self._sharded_fallback(
                     e, dgraph, sources, pred_sweep=True
                 )
+            cost = self._observe_unavailable(
+                "pred-sweep-sharded",
+                "sharded collective executables are not "
+                "cost-instrumented", dgraph, batch=int(sources.shape[0]),
+            )
         else:
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
             dist, pred, iters, improving = _fanout_pred_kernel(
@@ -1454,6 +1556,12 @@ class JaxBackend(Backend):
                 num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
             )
             row_sweeps = int(iters) * int(sources.shape[0])
+            cost = self._observe_cost(
+                "pred-sweep", _fanout_pred_kernel,
+                (sources, dgraph.src, dgraph.dst, dgraph.weights),
+                dict(num_nodes=v, max_iter=max_iter, edge_chunk=chunk),
+                dgraph, batch=int(sources.shape[0]),
+            )
         iters = int(iters)
         return KernelResult(
             dist=dist,
@@ -1462,6 +1570,7 @@ class JaxBackend(Backend):
             iterations=iters,
             edges_relaxed=int(row_sweeps) * dgraph.num_real_edges,
             route="pred-sweep",
+            cost=cost,
         )
 
     def _pallas_mode(self) -> tuple[bool, bool]:
@@ -1568,6 +1677,12 @@ class JaxBackend(Backend):
                         telemetry=self._telemetry,
                     )
                     dia_route = "dia-sharded"
+                    dia_cost = self._observe_unavailable(
+                        "dia-sharded",
+                        "sharded collective executables are not "
+                        "cost-instrumented", dgraph,
+                        batch=int(sources.shape[0]),
+                    )
                 else:
                     from paralleljohnson_tpu.ops.dia import dia_fixpoint
 
@@ -1585,12 +1700,18 @@ class JaxBackend(Backend):
                         * int(sources.shape[0])
                     )
                     dia_route = "dia"
+                    dia_cost = self._observe_cost(
+                        "dia", dia_fixpoint, (dist0_bv, lay["w_diag"]),
+                        dict(offsets=lay["offsets"], max_iter=max_iter),
+                        dgraph, batch=int(sources.shape[0]),
+                    )
                 return KernelResult(
                     dist=dist,
                     converged=not bool(improving),
                     iterations=int(iters),
                     edges_relaxed=examined,
                     route=dia_route,
+                    cost=dia_cost,
                 )
             except Exception:
                 self._auto_route_failed(
@@ -1627,6 +1748,12 @@ class JaxBackend(Backend):
                         telemetry=self._telemetry,
                     )
                     gs_route = "gs-sharded"
+                    gs_cost = self._observe_unavailable(
+                        "gs-sharded",
+                        "sharded collective executables are not "
+                        "cost-instrumented", dgraph,
+                        batch=int(sources.shape[0]),
+                    )
                 else:
                     dist, rounds, improving, iters_blk = _gs_fanout_kernel(
                         sources, bundle["src_blk"], bundle["dstl_blk"],
@@ -1642,12 +1769,22 @@ class JaxBackend(Backend):
                         inner_cap=self.config.gs_inner_cap,
                     )
                     gs_route = "gs"
+                    gs_cost = self._observe_cost(
+                        "gs", _gs_fanout_kernel,
+                        (sources, bundle["src_blk"], bundle["dstl_blk"],
+                         bundle["w_blk"], bundle["rank"]),
+                        dict(v_pad=bundle["v_pad"], vb=bundle["vb"],
+                             halo=bundle["halo"], max_outer=max_iter,
+                             inner_cap=self.config.gs_inner_cap),
+                        dgraph, batch=int(sources.shape[0]),
+                    )
                 return KernelResult(
                     dist=dist,
                     converged=not bool(improving),
                     iterations=int(rounds),
                     edges_relaxed=examined,
                     route=gs_route,
+                    cost=gs_cost,
                 )
             except Exception:
                 self._gs_auto_failed(dgraph)  # re-raises when forced
@@ -1676,6 +1813,11 @@ class JaxBackend(Backend):
             except Exception as e:
                 return self._sharded_fallback(e, dgraph, sources)
             route = "sharded-2d"
+            cost = self._observe_unavailable(
+                "sharded-2d",
+                "sharded collective executables are not "
+                "cost-instrumented", dgraph, batch=int(sources.shape[0]),
+            )
         elif mesh.devices.size > 1:
             from paralleljohnson_tpu.parallel import sharded_fanout
 
@@ -1701,6 +1843,11 @@ class JaxBackend(Backend):
             except Exception as e:
                 return self._sharded_fallback(e, dgraph, sources)
             route = "sharded-1d"
+            cost = self._observe_unavailable(
+                "sharded-1d",
+                "sharded collective executables are not "
+                "cost-instrumented", dgraph, batch=int(sources.shape[0]),
+            )
         elif self._use_dense(dgraph):
             use_pallas, interpret = self._pallas_mode()
             dist, iters, improving = _dense_fanout_kernel(
@@ -1715,12 +1862,22 @@ class JaxBackend(Backend):
             regime, work_per_iter = relax.dense_fanout_regime(
                 v, int(sources.shape[0])
             )
+            dense_route = (
+                f"dense-{regime}" + ("-pallas" if use_pallas else "")
+            )
             return KernelResult(
                 dist=dist,
                 converged=not bool(improving),
                 iterations=int(iters),
                 edges_relaxed=int(iters) * work_per_iter,
-                route=f"dense-{regime}" + ("-pallas" if use_pallas else ""),
+                route=dense_route,
+                cost=self._observe_cost(
+                    dense_route, _dense_fanout_kernel,
+                    (sources, dgraph.src, dgraph.dst, dgraph.weights),
+                    dict(num_nodes=v, max_iter=max_iter,
+                         use_pallas=use_pallas, interpret=interpret),
+                    dgraph, batch=int(sources.shape[0]),
+                ),
             )
         elif layout == "vertex_major":
             use_pallas, interpret = self._pallas_mode()
@@ -1760,6 +1917,11 @@ class JaxBackend(Backend):
                 dist = dists[0] if len(dists) == 1 else jnp.concatenate(dists)
                 iters = max(iters_list)
                 route = "pallas-vm"
+                cost = self._observe_unavailable(
+                    "pallas-vm",
+                    "the sliced Pallas sweep has no single "
+                    "cost-instrumented executable", dgraph, batch=b_real,
+                )
             else:
                 chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
                 # The layout's chunk size is derived from the batch size
@@ -1795,6 +1957,14 @@ class JaxBackend(Backend):
                             )
                             iters = int(iters)
                             route = "vm-blocked"
+                            cost = self._observe_cost(
+                                "vm-blocked", _fanout_vm_blocked_kernel,
+                                (sources, lay["src_ck"], lay["dstl_ck"],
+                                 lay["w_ck"], lay["base_ck"]),
+                                dict(num_nodes=v, v_pad=lay["v_pad"],
+                                     vb=lay["vb"], max_iter=max_iter),
+                                dgraph, batch=int(sources.shape[0]),
+                            )
                     except Exception:
                         self._auto_route_failed(
                             "_vmb_disabled",
@@ -1810,6 +1980,13 @@ class JaxBackend(Backend):
                         num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
                     )
                     route = "vm"
+                    cost = self._observe_cost(
+                        "vm", _fanout_vm_kernel,
+                        (sources, src_bd, dst_bd, w_bd),
+                        dict(num_nodes=v, max_iter=max_iter,
+                             edge_chunk=chunk),
+                        dgraph, batch=int(sources.shape[0]),
+                    )
                 row_sweeps = int(iters) * int(sources.shape[0])
         else:
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
@@ -1819,6 +1996,12 @@ class JaxBackend(Backend):
             )
             row_sweeps = int(iters) * int(sources.shape[0])
             route = "sweep-sm"
+            cost = self._observe_cost(
+                "sweep-sm", _fanout_kernel,
+                (sources, dgraph.src, dgraph.dst, dgraph.weights),
+                dict(num_nodes=v, max_iter=max_iter, edge_chunk=chunk),
+                dgraph, batch=int(sources.shape[0]),
+            )
         iters = int(iters)
         # Single-chip kernels iterate every row together, so iters x B is
         # exact; the sharded path reports the psum'd per-shard total.
@@ -1828,6 +2011,7 @@ class JaxBackend(Backend):
             iterations=iters,
             edges_relaxed=int(row_sweeps) * dgraph.num_real_edges,
             route=route,
+            cost=cost,
         )
 
     def reweight(self, dgraph: JaxDeviceGraph, potentials) -> JaxDeviceGraph:
@@ -1858,12 +2042,20 @@ class JaxBackend(Backend):
             src, dst, w, num_nodes=v, graph_chunk=slab
         )
         total_iters = int(jnp.sum(iters))
+        cost = None
+        if self.cost_capture.enabled:
+            cost = self.cost_capture.capture(
+                "batch-vmapped", _batch_johnson_kernel, (src, dst, w),
+                dict(num_nodes=v, graph_chunk=slab),
+                num_nodes=v, num_edges=e, batch=g,
+            )
         return KernelResult(
             dist=dist,
             negative_cycle=bool(jnp.any(neg)),
             iterations=int(jnp.max(iters)),
             edges_relaxed=total_iters * e * v,
             route="batch-vmapped",
+            cost=cost,
         )
 
 

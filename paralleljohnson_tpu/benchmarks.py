@@ -40,6 +40,14 @@ _BENCH_TELEMETRY: contextvars.ContextVar = contextvars.ContextVar(
     "pj_bench_telemetry", default=None
 )
 
+# Cost-observatory profile store for a bench pass (``run(...,
+# profile_dir=...)``): same contextvar pattern — every solver a config
+# builds captures compiled costs and appends its profile records there,
+# so a bench pass leaves the calibration artifact behind by default.
+_BENCH_PROFILE: contextvars.ContextVar = contextvars.ContextVar(
+    "pj_bench_profile", default=None
+)
+
 
 @dataclasses.dataclass
 class BenchRecord:
@@ -106,6 +114,7 @@ def _solver(backend: str, **cfg_overrides):
     from paralleljohnson_tpu.solver import ParallelJohnsonSolver
 
     cfg_overrides.setdefault("telemetry", _BENCH_TELEMETRY.get())
+    cfg_overrides.setdefault("profile_store", _BENCH_PROFILE.get())
     return ParallelJohnsonSolver(SolverConfig(backend=backend, **cfg_overrides))
 
 
@@ -138,6 +147,19 @@ def _routes(res) -> dict:
         val = float(getattr(s, key, 0.0) or 0.0)
         if val:
             out[key] = round(val, 4)
+    # Cost-observatory attribution (ISSUE 7): the roofline bound and the
+    # captured analytic totals ride in the row detail, so a regression
+    # flag on this row arrives pre-attributed (bench_regress reads
+    # exactly these keys).
+    roof = getattr(s, "roofline", None)
+    if roof and roof.get("bound") and roof["bound"] != "unknown":
+        out["roofline_bound"] = roof["bound"]
+    cost = getattr(s, "analytic_cost", None)
+    if cost and cost.get("captures"):
+        out["analytic_flops"] = round(float(cost["flops"]), 1)
+        out["analytic_bytes"] = round(float(cost["bytes_accessed"]), 1)
+    if getattr(s, "predicted_s", None) is not None:
+        out["predicted_s"] = round(float(s.predicted_s), 6)
     return out
 
 
@@ -439,7 +461,8 @@ def bench_serve_queries(backend: str, preset: str) -> BenchRecord:
     n = _sz("serve_queries", "n", preset)
     n_queries = _sz("serve_queries", "queries", preset)
     g = erdos_renyi(n, 8.0 / n, seed=13)
-    cfg_kwargs = dict(telemetry=_BENCH_TELEMETRY.get())
+    cfg_kwargs = dict(telemetry=_BENCH_TELEMETRY.get(),
+                      profile_store=_BENCH_PROFILE.get())
     from paralleljohnson_tpu.config import SolverConfig
 
     cfg = SolverConfig(backend=backend, **cfg_kwargs)
@@ -513,6 +536,7 @@ def run(
     backend: str = "jax",
     preset: str = "mini",
     telemetry_dir: str | None = None,
+    profile_dir: str | None = None,
 ) -> list[BenchRecord]:
     """Run the named configs. ``telemetry_dir`` (CLI ``--trace-dir``)
     turns on the flight recorder per config: each config's solvers
@@ -520,7 +544,14 @@ def run(
     Chrome trace on success and a shared ``heartbeat.json``), a
     succeeding row folds the telemetry summary into its detail, and a
     FAILED row's detail points at the flight-recorder path — the first
-    artifact to read on a dead TPU pass."""
+    artifact to read on a dead TPU pass.
+
+    ``profile_dir`` (CLI ``--profile-store`` / ``$PJ_PROFILE_DIR``)
+    turns on the cost observatory per config: every solver captures
+    compiled costs + appends profile records there, rows carry their
+    roofline bound in ``detail``, and each finished row is appended to
+    the bench-regression history (``bench_history.jsonl``) so
+    ``scripts/bench_regress.py`` can grade the next pass against it."""
     if preset not in _PRESETS:
         raise ValueError(f"preset must be one of {_PRESETS}, got {preset!r}")
     names = names or list(CONFIGS)
@@ -530,6 +561,9 @@ def run(
             f"unknown config(s) {unknown}; available: {sorted(CONFIGS)}"
         )
     records = []
+    profile_token = (
+        _BENCH_PROFILE.set(profile_dir) if profile_dir is not None else None
+    )
     for name in names:
         tel = None
         token = None
@@ -577,6 +611,30 @@ def run(
         except Exception:  # noqa: BLE001 — a dead device must not kill the row
             rec.detail.setdefault("platform", "unknown")
         records.append(rec)
+    if profile_token is not None:
+        _BENCH_PROFILE.reset(profile_token)
+    if profile_dir is not None:
+        # Append each finished row to the bench-regression history next
+        # to the profile store — the trajectory bench_regress grades the
+        # next pass against. Failed rows are skipped by the normalizer
+        # (a crash is not a measurement).
+        try:
+            from paralleljohnson_tpu.observe.regress import (
+                BenchHistory,
+                normalize_record,
+            )
+
+            hist = BenchHistory(profile_dir)
+            for rec in records:
+                for row in normalize_record(
+                    json.loads(rec.as_json_line()), source="pjtpu-bench"
+                ):
+                    hist.append(row)
+        except Exception as e:  # noqa: BLE001 — history is never fatal
+            import sys
+
+            print(f"warning: bench history append failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
     return records
 
 

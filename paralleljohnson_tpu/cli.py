@@ -153,8 +153,20 @@ def _add_observability(p: argparse.ArgumentParser) -> None:
                    metavar="PROM",
                    help="write the solve's stats as a Prometheus textfile "
                         "(pjtpu_edges_relaxed_total, pjtpu_solve_seconds, "
-                        "pjtpu_retries_total, ...) for scrape-based "
-                        "monitoring (default: $PJ_METRICS_FILE if set)")
+                        "pjtpu_retries_total, pjtpu_route_predicted_s, "
+                        "pjtpu_roofline_bound{kind=...}, ...) for "
+                        "scrape-based monitoring (default: "
+                        "$PJ_METRICS_FILE if set)")
+    p.add_argument("--profile-store",
+                   default=os.environ.get("PJ_PROFILE_DIR"),
+                   metavar="DIR",
+                   help="cost-observatory profile store (README 'Cost "
+                        "observatory'): harvest XLA compiled costs per "
+                        "route, roofline-classify the solve, and append "
+                        "one record per solve to DIR/profiles.jsonl — "
+                        "the calibration cli info / bench_regress / the "
+                        "planned dispatch registry read (default: "
+                        "$PJ_PROFILE_DIR if set, else off)")
 
 
 def _telemetry(args, label: str):
@@ -202,6 +214,7 @@ def _config(args) -> "SolverConfig":
         retry_attempts=args.retry_attempts,
         stage_deadline_s=args.stage_deadline,
         min_source_batch=args.min_source_batch,
+        profile_store=args.profile_store,
         telemetry=_telemetry(args, args.command),
     )
 
@@ -264,6 +277,20 @@ def _report(res, args) -> None:
             print(f"  resilience: {'; '.join(parts)}")
         if s.batches_resumed:
             print(f"  batches resumed from checkpoint: {s.batches_resumed}")
+        # Roofline line (cost observatory) — only when the solve was
+        # actually attributable (analytic capture or dominant host IO);
+        # an unknown bound would just be noise on every plain solve.
+        roof = getattr(s, "roofline", None)
+        if roof and roof.get("bound") not in (None, "unknown"):
+            line = f"  roofline: {roof['bound']}-bound"
+            if roof.get("why"):
+                line += f" ({roof['why']})"
+            print(line)
+            if s.predicted_s is not None:
+                print(
+                    f"  cost model: predicted {s.predicted_s * 1e3:.2f} ms"
+                    f" vs measured {s.compute_seconds * 1e3:.2f} ms compute"
+                )
         # Pipeline summary — only when the fan-out actually staged work
         # off the critical path (a serial solve stays quiet).
         if s.download_s or s.ckpt_wait_s or s.overlap_saved_s:
@@ -323,6 +350,16 @@ def main(argv: list[str] | None = None) -> int:
                               "+ Chrome trace + heartbeat.json under DIR; "
                               "failed rows reference their flight file "
                               "(default: $PJ_TRACE_DIR if set, else off)")
+    p_bench.add_argument("--profile-store",
+                         default=os.environ.get("PJ_PROFILE_DIR"),
+                         metavar="DIR",
+                         help="cost-observatory profile store: every "
+                              "config's solves capture compiled costs + "
+                              "append profile records there, rows fold "
+                              "their roofline bound into detail, and the "
+                              "pass appends its rows to the bench-"
+                              "regression history (default: "
+                              "$PJ_PROFILE_DIR if set, else off)")
 
     p_serve = sub.add_parser(
         "serve",
@@ -380,6 +417,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="also report a tile store's persisted "
                              "serving state (capacity, landmark count, "
                              "hit-rate counters from serve_stats.json)")
+    p_info.add_argument("--profile-store", default=None, metavar="DIR",
+                        help="cost-observatory profile store to price "
+                             "routes from (default: $PJ_PROFILE_DIR, "
+                             "else bench_artifacts/profiles when present)")
     p_info.add_argument("--json", action="store_true", dest="as_json")
 
     args = parser.parse_args(argv)
@@ -403,7 +444,7 @@ def main(argv: list[str] | None = None) -> int:
 
         records = benchmarks.run(
             args.configs or None, backend=args.backend, preset=args.preset,
-            telemetry_dir=args.trace_dir,
+            telemetry_dir=args.trace_dir, profile_dir=args.profile_store,
         )
         for r in records:
             print(r.as_json_line())
@@ -515,7 +556,64 @@ def main(argv: list[str] | None = None) -> int:
                     "(budgeted by suggested_source_batch)"
                 ),
             },
+            # The cost-observatory surface (README "Cost observatory"):
+            # where profiles persist, what a roofline line means, and
+            # the priced route table below when a store exists.
+            "cost_observatory": {
+                "flags": {
+                    "--profile-store": (
+                        "capture XLA compiled costs per (route, "
+                        "platform, shape-bucket), roofline-classify "
+                        "each solve, append one record per solve to "
+                        "DIR/profiles.jsonl"
+                    ),
+                },
+                "env_default": "PJ_PROFILE_DIR",
+                "offline_readers": [
+                    "python scripts/cost_report.py <profile dir | "
+                    "flight.jsonl>",
+                    "python scripts/bench_regress.py --history "
+                    "<profile dir> --last 1",
+                ],
+                "bound_kinds": {
+                    "hbm": "analytic bytes / peak bandwidth >= analytic "
+                           "flops / peak compute (gather-limited)",
+                    "mxu": "compute floor above bandwidth floor "
+                           "(math-limited)",
+                    "host-io": "downloads + checkpoint waits (net of "
+                               "pipeline overlap) dominate the wall",
+                    "unknown": "no capture for this solve",
+                },
+            },
         }
+        # Priced route table from the persisted calibration — the
+        # preview the planned dispatch registry (ROADMAP item 7) will
+        # consume programmatically.
+        _store_dir = (
+            args.profile_store
+            or os.environ.get("PJ_PROFILE_DIR")
+            or ("bench_artifacts/profiles"
+                if os.path.isdir("bench_artifacts/profiles") else None)
+        )
+        if _store_dir is not None:
+            try:
+                from paralleljohnson_tpu.observe import (
+                    CostModel,
+                    ProfileStore,
+                )
+
+                _store = ProfileStore(_store_dir)
+                _model = CostModel.fit(_store)
+                info["cost_observatory"]["store"] = str(_store.path)
+                info["cost_observatory"]["records"] = len(_store.records())
+                info["cost_observatory"]["priced_routes"] = _model.table()
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                info["cost_observatory"]["store_error"] = (
+                    f"{type(e).__name__}: {e}"
+                )
+                _model = None
+        else:
+            _model = None
         if args.serve_store is not None:
             # Persisted serving state: each graph subdirectory's
             # serve_stats.json (written by QueryEngine.close) plus the
@@ -588,6 +686,32 @@ def main(argv: list[str] | None = None) -> int:
                 ),
                 "low_degree_family": bool(be._low_degree_family(dg)),
             }
+            if _model is not None and _model.entries:
+                # Price THIS graph on every calibrated route: predicted
+                # seconds at B=1 (the SSSP shape) and at the full
+                # fan-out width — what dispatch would compare.
+                priced = {}
+                for entry in _model.table():
+                    route = entry["route"]
+                    p1 = _model.predict(
+                        route, num_edges=g.num_real_edges, batch=1,
+                        platform=entry["platform"],
+                    )
+                    pb = _model.predict(
+                        route, num_edges=g.num_real_edges,
+                        batch=min(128, g.num_nodes),
+                        platform=entry["platform"],
+                    )
+                    if p1 is not None:
+                        priced[f"{route}@{entry['platform']}"] = {
+                            "predicted_s_b1": round(p1["predicted_s"], 6),
+                            "predicted_s_b128": (
+                                round(pb["predicted_s"], 6)
+                                if pb is not None else None
+                            ),
+                            "calibration_n": entry["n"],
+                        }
+                info["graph"]["priced_routes"] = priced
         print(json.dumps(info, indent=None if args.as_json else 2))
         return 0
 
@@ -622,7 +746,7 @@ def main(argv: list[str] | None = None) -> int:
                         file=sys.stderr,
                     )
                     return 1
-                with device_trace(args.profile):
+                with device_trace(args.profile, cfg.telemetry):
                     red = ParallelJohnsonSolver(cfg).solve_reduced(
                         g, sources=sources, reduce_rows=args.reduce
                     )
@@ -640,14 +764,14 @@ def main(argv: list[str] | None = None) -> int:
                 print(json.dumps(payload) if args.as_json else
                       f"{args.reduce}: {vals}")
                 return 0
-            with device_trace(args.profile):
+            with device_trace(args.profile, cfg.telemetry):
                 res = ParallelJohnsonSolver(cfg).solve(
                     g, sources=sources, predecessors=args.predecessors
                 )
             _report(res, args)
         elif args.command == "sssp":
             g = load_graph(args.graph)
-            with device_trace(args.profile):
+            with device_trace(args.profile, cfg.telemetry):
                 res = ParallelJohnsonSolver(cfg).sssp(
                     g, args.source, predecessors=args.predecessors
                 )
@@ -730,7 +854,7 @@ def main(argv: list[str] | None = None) -> int:
                 return 1
             graphs = random_graph_batch(args.count, args.nodes, args.p,
                                         seed=args.seed)
-            with device_trace(args.profile):
+            with device_trace(args.profile, cfg.telemetry):
                 results = ParallelJohnsonSolver(cfg).solve_batch(graphs)
             stats = results[0].stats
             _write_metrics(stats, args)

@@ -169,6 +169,19 @@ class SolverConfig:
         deterministic failures into solve stages — the harness tier-1
         CPU tests use to exercise every retry/degrade/resume path
         without a TPU. Production solves leave it None.
+      profile_store: cost-observatory profile-store directory (ISSUE 7,
+        ``paralleljohnson_tpu/observe``). When set (or via the
+        ``PJ_PROFILE_DIR`` env var), the jax backend harvests XLA's
+        compiled-cost analysis (FLOPs / bytes accessed /
+        transcendentals + memory analysis) once per (route, platform,
+        shape-bucket), the solver roofline-classifies every solve
+        (HBM- / MXU- / host-IO-bound) and appends one record per solve
+        to ``<dir>/profiles.jsonl`` — the calibration artifact
+        ``CostModel.predict`` and the planned dispatch registry
+        (ROADMAP item 7) consume. None (and no env var) disables
+        capture entirely; roofline attribution of measured phases still
+        runs (it is free). Capture pays one extra AOT lower+compile per
+        key. CLI: ``--profile-store``.
       telemetry: a ``utils.telemetry.Telemetry`` (or None, the default)
         — the flight-recorder subsystem: nested spans + events appended
         to a JSONL that survives a killed worker, a heartbeat JSON
@@ -209,6 +222,7 @@ class SolverConfig:
     stage_deadline_s: float | None = None
     min_source_batch: int = 8
     fault_plan: object | None = None
+    profile_store: str | None = None
     telemetry: object | None = None
 
     @property

@@ -1,0 +1,133 @@
+"""Cost observatory (ISSUE 7 tentpole) — *why a solve costs what it
+costs, and whether it is getting slower*.
+
+The flight recorder (``utils.telemetry``) says what happened; this
+package prices it. Four pieces sharing one persisted artifact:
+
+- :mod:`~paralleljohnson_tpu.observe.costs` — compiled-cost capture:
+  at jit-compile time, harvest XLA's ``cost_analysis()`` (FLOPs, bytes
+  accessed, transcendentals) and ``memory_analysis()`` (argument /
+  output / temp HBM) for every instrumented route's executable, keyed
+  by ``(route, platform, shape-bucket)``; graceful no-op markers on
+  backends/JAX versions (or routes) that don't expose them.
+- :mod:`~paralleljohnson_tpu.observe.store` — the persisted profile
+  store: append-only JSONL of per-solve records (analytic costs +
+  measured wall + exact counters + SolverStats phases), written per
+  solve when ``SolverConfig.profile_store`` / ``PJ_PROFILE_DIR`` is
+  set, and :class:`~paralleljohnson_tpu.observe.store.CostModel` — the
+  per-key calibration (measured seconds per analytic byte / FLOP /
+  edge-row) ROADMAP item 7's dispatch registry will consume.
+- :mod:`~paralleljohnson_tpu.observe.roofline` — roofline attribution:
+  analytic bytes/FLOPs + measured span times + a small per-platform
+  peak table classify each solve as HBM-bound / MXU-bound /
+  host-IO-bound, surfaced in ``SolverStats``, ``cli info``, bench row
+  ``detail``, the heartbeat JSON, and ``scripts/cost_report.py``.
+- :mod:`~paralleljohnson_tpu.observe.regress` — bench-regression
+  detection: a history store ingesting the ``BENCH_r0*.json``
+  trajectory plus fresh rows, and ``scripts/bench_regress.py``
+  comparing new measurements against per-(bench, backend, platform)
+  history with a noise band — each flagged row arrives pre-attributed
+  with its roofline classification.
+
+Everything here except :mod:`costs` is stdlib-only (no numpy, no jax),
+so the offline readers and the suite-budget guard can import it
+without initializing a device client.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from paralleljohnson_tpu.observe.costs import (  # noqa: F401
+    CostCapture,
+    resolve_profile_dir,
+    shape_bucket,
+)
+from paralleljohnson_tpu.observe.regress import (  # noqa: F401
+    BenchHistory,
+    detect_regressions,
+    normalize_record,
+)
+from paralleljohnson_tpu.observe.roofline import (  # noqa: F401
+    PLATFORM_PEAKS,
+    attribute_stats,
+    classify,
+)
+from paralleljohnson_tpu.observe.store import (  # noqa: F401
+    PROFILE_FILENAME,
+    CostModel,
+    ProfileStore,
+    solve_record,
+)
+
+
+def current_platform() -> str:
+    """The platform profiles are keyed by. Never imports jax itself —
+    the observatory must not initialize a device client behind a host
+    backend's back (same contract as the heartbeat's device sampler)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "cpu"
+    try:
+        return str(jax.default_backend())
+    except Exception:  # noqa: BLE001 — a dead device must not kill a record
+        return "unknown"
+
+
+def primary_route(stats) -> str | None:
+    """The route tag a solve's profile record is calibrated under: the
+    fan-out's (the dominant phase), else the B=1 / batch route."""
+    routes = getattr(stats, "routes_by_phase", None) or {}
+    for phase in ("fanout", "bellman_ford", "batch_apsp"):
+        if routes.get(phase):
+            return routes[phase]
+    return None
+
+
+def finalize_solve(
+    stats,
+    *,
+    config,
+    telemetry=None,
+    label: str = "solve",
+    num_nodes: int = 0,
+    num_edges: int = 0,
+    batch: int = 1,
+) -> dict | None:
+    """Post-solve observatory hook (called by the solver for every
+    completed solve): roofline-attribute ``stats``, publish the bound
+    classification to the heartbeat, and — when a profile store is
+    configured — predict this solve from the store's calibration and
+    append its record. Returns the roofline dict (also left on
+    ``stats.roofline``)."""
+    platform = current_platform()
+    roof = attribute_stats(stats, platform=platform)
+    stats.roofline = roof
+    if telemetry is not None and roof:
+        telemetry.progress(roofline_bound=roof.get("bound"))
+    store_dir = resolve_profile_dir(getattr(config, "profile_store", None))
+    if not store_dir:
+        return roof
+    store = ProfileStore(store_dir)
+    route = primary_route(stats)
+    if route is not None:
+        # Prediction from the PRE-existing calibration, before this
+        # run's own record lands — prediction vs measurement stays an
+        # honest out-of-sample comparison.
+        pred = CostModel.fit(store).predict(
+            route, num_edges=num_edges, batch=batch, platform=platform
+        )
+        if pred is not None:
+            stats.predicted_s = pred["predicted_s"]
+    store.append(
+        solve_record(
+            stats,
+            label=label,
+            platform=platform,
+            route=route,
+            num_nodes=num_nodes,
+            num_edges=num_edges,
+            batch=batch,
+        )
+    )
+    return roof

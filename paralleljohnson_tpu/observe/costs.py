@@ -1,0 +1,216 @@
+"""Compiled-cost capture — XLA's own price tag for every route.
+
+At jit-compile time every XLA executable knows its analytic cost
+(``Compiled.cost_analysis()``: FLOPs, bytes accessed, transcendentals)
+and memory footprint (``Compiled.memory_analysis()``: argument / output
+/ temp bytes). The solver has never looked: we measure wall-clocks but
+cannot say whether a route is moving bytes or doing math. This module
+harvests both, once per ``(route, platform, shape-bucket)`` key, via
+the jitted kernel's AOT path (``jitfn.lower(*args).compile()``).
+
+Cost of capture: one extra trace + compile per key (NOT per call —
+keys are cached for the life of the :class:`CostCapture`, and the
+persistent jax compilation cache makes the XLA part a hit on the TPU
+passes). Capture is therefore gated: a backend only enables it when a
+profile store is configured (``SolverConfig.profile_store`` /
+``PJ_PROFILE_DIR``), so ordinary solves pay nothing.
+
+Graceful no-op everywhere: a backend/JAX version that does not expose
+``cost_analysis`` (or a route with no single AOT-lowerable executable
+— the sharded collectives, the Pallas sweep) yields a record carrying
+an explicit ``cost_analysis_unavailable`` marker instead of numbers,
+so downstream consumers can always tell "cheap" from "unmeasured".
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+# (our key, XLA cost_analysis key) — XLA spells "bytes accessed" with a
+# space; absent keys read as 0.0 (a kernel genuinely can have zero
+# transcendentals).
+_COST_KEYS = (
+    ("flops", "flops"),
+    ("bytes_accessed", "bytes accessed"),
+    ("transcendentals", "transcendentals"),
+)
+
+
+def resolve_profile_dir(explicit: str | None = None) -> str | None:
+    """Profile-store directory resolution (mirrors the compile-cache
+    pattern): an explicit ``SolverConfig.profile_store`` wins, else the
+    ``PJ_PROFILE_DIR`` env var; neither set disables capture + store."""
+    return explicit or os.environ.get("PJ_PROFILE_DIR") or None
+
+
+def _pow2_up(n: int) -> int:
+    n = int(n)
+    if n <= 0:
+        return 0
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def shape_bucket(num_nodes: int, num_edges: int, batch: int) -> tuple[int, int, int]:
+    """Shape key for cost records: each dimension rounded UP to a power
+    of two, so e.g. ragged final batches (104 of 128) and padded edge
+    lists share their canonical bucket instead of exploding the key
+    space (the same bucketing the layout-chunk sizing uses)."""
+    return (_pow2_up(num_nodes), _pow2_up(num_edges), _pow2_up(batch))
+
+
+class CostCapture:
+    """Once-per-key harvest of XLA cost/memory analysis.
+
+    ``capture()`` returns the analytic-cost dict for the key (computed
+    on first sight, cached after); ``unavailable()`` records the
+    explicit marker for routes that cannot be AOT-lowered. Both return
+    None when the capture is disabled, so call sites stay one-liners.
+    Thread-safe: the pipelined fan-out's background worker never calls
+    in, but the sharded entry points may race the main thread.
+    """
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = bool(enabled)
+        self._cache: dict = {}
+        self._lock = threading.Lock()
+
+    # -- internals --------------------------------------------------------
+
+    @staticmethod
+    def _platform() -> str:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return "cpu"
+        try:
+            return str(jax.default_backend())
+        except Exception:  # noqa: BLE001 — a dead device must not crash capture
+            return "unknown"
+
+    def _base(self, route, platform, bucket, num_nodes, num_edges, batch):
+        return {
+            "route": route,
+            "platform": platform,
+            "shape_bucket": list(bucket),
+            "nodes": int(num_nodes),
+            "edges": int(num_edges),
+            "batch": int(batch),
+        }
+
+    # -- public -----------------------------------------------------------
+
+    def capture(
+        self,
+        route: str,
+        jitfn,
+        args: tuple,
+        kwargs: dict | None = None,
+        *,
+        num_nodes: int,
+        num_edges: int,
+        batch: int = 1,
+    ) -> dict | None:
+        """Analytic costs of ``jitfn``'s executable at these shapes.
+
+        The WHOLE body is failure-proof: any error (no ``lower`` on
+        this jax, a backend whose compiled object lacks the analyses,
+        an analysis call that raises) degrades to the explicit
+        ``cost_analysis_unavailable`` marker — capture must never fail
+        a solve that already computed correct distances."""
+        if not self.enabled:
+            return None
+        platform = self._platform()
+        bucket = shape_bucket(num_nodes, num_edges, batch)
+        key = (route, platform, bucket)
+        with self._lock:
+            cached = self._cache.get(key)
+        if cached is not None:
+            return cached
+        rec = self._base(route, platform, bucket, num_nodes, num_edges, batch)
+        compiled = None
+        try:
+            compiled = jitfn.lower(*args, **(kwargs or {})).compile()
+        except Exception as e:  # noqa: BLE001 — graceful no-op contract
+            rec["cost_analysis_unavailable"] = (
+                f"lower/compile failed: {type(e).__name__}: {e}"
+            )
+        if compiled is not None:
+            try:
+                ca = compiled.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else None
+                if not ca:
+                    rec["cost_analysis_unavailable"] = (
+                        "cost_analysis returned no properties on "
+                        f"platform {platform!r}"
+                    )
+                else:
+                    for ours, theirs in _COST_KEYS:
+                        rec[ours] = float(ca.get(theirs, 0.0))
+            except Exception as e:  # noqa: BLE001
+                rec["cost_analysis_unavailable"] = (
+                    f"cost_analysis unavailable: {type(e).__name__}: {e}"
+                )
+            try:
+                ma = compiled.memory_analysis()
+                if ma is not None:
+                    mem = {
+                        "argument_bytes": int(
+                            getattr(ma, "argument_size_in_bytes", 0)
+                        ),
+                        "output_bytes": int(
+                            getattr(ma, "output_size_in_bytes", 0)
+                        ),
+                        "temp_bytes": int(
+                            getattr(ma, "temp_size_in_bytes", 0)
+                        ),
+                        "generated_code_bytes": int(
+                            getattr(ma, "generated_code_size_in_bytes", 0)
+                        ),
+                    }
+                    # The executable's peak device footprint: everything
+                    # resident at once (args stay alive through temps).
+                    mem["peak_bytes"] = (
+                        mem["argument_bytes"]
+                        + mem["output_bytes"]
+                        + mem["temp_bytes"]
+                    )
+                    rec["memory"] = mem
+            except Exception:  # noqa: BLE001 — memory stats are best-effort
+                pass
+        with self._lock:
+            self._cache[key] = rec
+        return rec
+
+    def unavailable(
+        self,
+        route: str,
+        reason: str,
+        *,
+        num_nodes: int,
+        num_edges: int,
+        batch: int = 1,
+    ) -> dict | None:
+        """Explicit marker for a route with no single AOT-lowerable
+        executable (sharded collectives, Pallas) — "unmeasured", stated,
+        never silently zero."""
+        if not self.enabled:
+            return None
+        platform = self._platform()
+        bucket = shape_bucket(num_nodes, num_edges, batch)
+        key = (route, platform, bucket)
+        with self._lock:
+            cached = self._cache.get(key)
+            if cached is not None:
+                return cached
+        rec = self._base(route, platform, bucket, num_nodes, num_edges, batch)
+        rec["cost_analysis_unavailable"] = reason
+        with self._lock:
+            self._cache[key] = rec
+        return rec
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._cache.values())

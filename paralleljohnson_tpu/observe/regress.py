@@ -1,0 +1,258 @@
+"""Bench-regression detection — a slowdown should arrive pre-attributed.
+
+``BenchHistory`` is an append-only JSONL (``bench_history.jsonl``,
+next to the profile store) of normalized measurement rows:
+
+    {"bench": ..., "backend": ..., "platform": ..., "preset": ...,
+     "wall_s": ..., "ts": ..., "detail": {...}, "source": ...}
+
+``normalize_record`` turns every measurement format this repo already
+produces into such rows: ``pjtpu bench`` JSON lines (BenchRecord), the
+driver's ``BENCH_r0*.json`` files (both the wrapper and its ``parsed``
+payload), and the suite-budget guard's wall-clock. ``detect_regressions``
+compares fresh rows against the per-(bench, backend, platform) history
+with a noise band, and annotates each flagged row with its roofline
+classification (from the row's own detail, or the profile store's
+latest matching record) so the flag says *what kind* of slow it is.
+
+Stdlib-only: scripts (``bench_regress.py``, ``check_suite_budget.py``)
+load this module standalone, without importing the package (no jax).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+from pathlib import Path
+
+HISTORY_FILENAME = "bench_history.jsonl"
+
+# Default noise band: a fresh wall more than 35% over the historical
+# median (and more than the absolute floor — micro-benches jitter in
+# absolute ms) is a regression. Bench rows on shared CPU containers
+# routinely wobble 10-20%; 35% flags real slowdowns without paging on
+# scheduler noise.
+DEFAULT_BAND = 0.35
+DEFAULT_ABS_FLOOR_S = 0.05
+DEFAULT_MIN_HISTORY = 2
+
+
+def history_key(row: dict) -> tuple:
+    return (
+        row.get("bench"),
+        row.get("backend"),
+        row.get("platform"),
+        row.get("preset"),
+    )
+
+
+class BenchHistory:
+    """Append-only normalized-row history store.
+
+    ``path`` may be a directory (rows live in
+    ``<dir>/bench_history.jsonl``) or a file path directly."""
+
+    def __init__(self, path: str | Path) -> None:
+        p = Path(path)
+        self.path = p if p.suffix == ".jsonl" else p / HISTORY_FILENAME
+
+    def rows(self) -> list[dict]:
+        if not self.path.exists():
+            return []
+        out = []
+        lines = self.path.read_text(encoding="utf-8").splitlines()
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    continue  # torn trailing line: kill damage, tolerated
+                raise ValueError(
+                    f"{self.path}: corrupt history row at line {i + 1}"
+                )
+        return out
+
+    @staticmethod
+    def _sig(row: dict) -> str:
+        """Row identity for ingestion dedup — everything except ``ts``
+        (re-ingesting the same BENCH_r0*.json files must be idempotent;
+        the committed files carry no timestamps of their own)."""
+        return json.dumps(
+            {k: v for k, v in row.items() if k != "ts"}, sort_keys=True
+        )
+
+    def append(self, row: dict, *, dedup: bool = True) -> bool:
+        """Append one row; with ``dedup`` an exact (ts-ignored)
+        duplicate of an existing row is skipped. Returns True iff
+        written."""
+        if dedup:
+            sig = self._sig(row)
+            if any(self._sig(r) == sig for r in self.rows()):
+                return False
+        row = dict(row)
+        row.setdefault("ts", time.time())
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(row) + "\n")
+            f.flush()
+        return True
+
+
+def _driver_metric_rows(obj: dict, source: str | None) -> list[dict]:
+    """Rows from the driver bench format: the parsed payload
+    ``{"metric": "edges_relaxed_per_sec_per_chip[tag]", "value": ...,
+    "detail": {...}}``. The regression axis is the measured wall
+    (``detail.dt``, lower = better) — the headline edges/s rate is kept
+    in detail; keying strips the platform suffix from the tag so a
+    cpu-fallback row and a TPU row land under different platforms, not
+    different benches."""
+    metric = obj.get("metric", "")
+    detail = dict(obj.get("detail") or {})
+    dt = detail.get("dt")
+    if not isinstance(dt, (int, float)) or dt <= 0:
+        return []
+    tag = metric.split("[", 1)[1].rstrip("]") if "[" in metric else metric
+    # Drop the trailing platform marker ("...,cpu-fallback" / ",cpu" /
+    # ",tpu-rung") — platform is its own key axis.
+    bench = "driver:" + tag.split(",", 1)[0]
+    detail["value"] = obj.get("value")
+    detail["metric"] = metric
+    return [{
+        "bench": bench,
+        "backend": "jax",
+        "platform": detail.get("platform", "unknown"),
+        "preset": None,
+        "wall_s": float(dt),
+        "detail": detail,
+        "source": source,
+    }]
+
+
+def normalize_record(obj: dict, *, source: str | None = None) -> list[dict]:
+    """Normalize ONE parsed measurement object into history rows.
+
+    Accepted shapes: an already-normalized row (has bench + wall_s);
+    a ``pjtpu bench`` BenchRecord line (config/backend/preset/wall_s);
+    a driver metric payload (metric/value/detail); the committed
+    ``BENCH_r0*.json`` wrapper (its ``parsed`` field is the payload).
+    Unrecognized objects yield [] — ingestion skips, never crashes."""
+    if not isinstance(obj, dict):
+        return []
+    if "bench" in obj and "wall_s" in obj:
+        row = dict(obj)
+        row.setdefault("source", source)
+        return [row]
+    if "config" in obj and "wall_s" in obj:
+        detail = dict(obj.get("detail") or {})
+        if "failed" in detail:
+            return []  # a partial/failed row is not a measurement
+        return [{
+            "bench": obj["config"],
+            "backend": obj.get("backend", "unknown"),
+            "platform": detail.get("platform", "unknown"),
+            "preset": obj.get("preset"),
+            "wall_s": float(obj["wall_s"]),
+            "detail": detail,
+            "source": source,
+        }]
+    if "metric" in obj and "detail" in obj:
+        return _driver_metric_rows(obj, source)
+    if "parsed" in obj and isinstance(obj["parsed"], dict):
+        return normalize_record(obj["parsed"], source=source)
+    return []
+
+
+def load_measurements(path: str | Path) -> list[dict]:
+    """Rows from a measurement file: one JSON object (driver format) or
+    JSONL (bench rows / normalized rows)."""
+    text = Path(path).read_text(encoding="utf-8").strip()
+    rows: list[dict] = []
+    src = str(path)
+    try:
+        rows.extend(normalize_record(json.loads(text), source=src))
+        return rows
+    except json.JSONDecodeError:
+        pass
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rows.extend(normalize_record(json.loads(line), source=src))
+        except json.JSONDecodeError:
+            continue
+    return rows
+
+
+def _roofline_of(row: dict, profile_records: list[dict] | None) -> str:
+    """Roofline annotation for a flagged row: the row's own detail
+    first, else the profile store's latest record matching the row's
+    platform (and route tag, when the row carries one)."""
+    detail = row.get("detail") or {}
+    if detail.get("roofline_bound"):
+        return detail["roofline_bound"]
+    roof = detail.get("roofline")
+    if isinstance(roof, dict) and roof.get("bound"):
+        return roof["bound"]
+    if profile_records:
+        route = detail.get("route") or ""
+        best = None
+        for r in profile_records:
+            if r.get("platform") != row.get("platform"):
+                continue
+            r_roof = (r.get("roofline") or {}).get("bound")
+            if not r_roof:
+                continue
+            if route and r.get("route") and r["route"] not in route:
+                continue
+            if best is None or r.get("ts", 0) >= best.get("ts", 0):
+                best = r
+        if best is not None:
+            return (best.get("roofline") or {}).get("bound", "unknown")
+    return "unknown"
+
+
+def detect_regressions(
+    fresh: list[dict],
+    history: list[dict],
+    *,
+    band: float = DEFAULT_BAND,
+    abs_floor_s: float = DEFAULT_ABS_FLOOR_S,
+    min_history: int = DEFAULT_MIN_HISTORY,
+    profile_records: list[dict] | None = None,
+) -> list[dict]:
+    """Flag fresh rows slower than their history.
+
+    Per (bench, backend, platform, preset) key the baseline is the
+    MEDIAN of the history walls (robust to the odd wedged run); a fresh
+    wall above ``baseline * (1 + band)`` AND more than ``abs_floor_s``
+    over it is flagged. Keys with fewer than ``min_history`` rows are
+    skipped — one prior point is not a trend. Each flag carries the
+    baseline, the slowdown factor, and its roofline classification."""
+    by_key: dict[tuple, list[float]] = {}
+    for row in history:
+        w = row.get("wall_s")
+        if isinstance(w, (int, float)) and w > 0:
+            by_key.setdefault(history_key(row), []).append(float(w))
+    flagged = []
+    for row in fresh:
+        w = row.get("wall_s")
+        if not isinstance(w, (int, float)) or w <= 0:
+            continue
+        hist = by_key.get(history_key(row))
+        if not hist or len(hist) < min_history:
+            continue
+        base = statistics.median(hist)
+        if w > base * (1.0 + band) and (w - base) > abs_floor_s:
+            flagged.append({
+                **row,
+                "baseline_s": base,
+                "slowdown": w / base,
+                "band": band,
+                "history_n": len(hist),
+                "roofline_bound": _roofline_of(row, profile_records),
+            })
+    return flagged

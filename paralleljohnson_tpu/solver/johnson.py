@@ -231,6 +231,9 @@ class ParallelJohnsonSolver:
                                  stats=stats, predecessors=pred)
             if self.config.validate:
                 self._validate(graph, result)
+            self._finish_observability(
+                stats, graph, len(sources), label="solve"
+            )
             return result
 
     def solve_reduced(
@@ -327,6 +330,9 @@ class ParallelJohnsonSolver:
                 dgraph, sources, stats, finalize=finalize
             ):
                 values.append(value)
+        self._finish_observability(
+            stats, graph, n_src, label="solve_reduced"
+        )
         return ReducedResult(
             values=values, sources=sources, potentials=h, stats=stats
         )
@@ -356,6 +362,7 @@ class ParallelJohnsonSolver:
             raise ConvergenceError(
                 "Bellman-Ford hit max_iterations while still improving"
             )
+        self._finish_observability(stats, graph, 1, label="sssp")
         return SolveResult(
             dist=bf.dist[None, :],
             sources=np.array([source]),
@@ -388,6 +395,9 @@ class ParallelJohnsonSolver:
                 dist, pred = self._fanout(
                     dgraph, sources, stats, with_pred=predecessors
                 )
+        self._finish_observability(
+            stats, graph, len(sources), label="multi_source"
+        )
         return SolveResult(
             dist=dist,
             sources=sources,
@@ -433,6 +443,33 @@ class ParallelJohnsonSolver:
 
     # -- internals ----------------------------------------------------------
 
+    def _finish_observability(
+        self, stats: SolverStats, graph: CSRGraph, batch: int, *,
+        label: str,
+    ) -> None:
+        """Post-solve cost-observatory hook (ISSUE 7,
+        ``paralleljohnson_tpu/observe``): roofline-attribute ``stats``
+        (HBM- / MXU- / host-IO-bound), publish the bound to the
+        heartbeat, and append one profile-store record (+ the
+        calibrated prediction) when ``config.profile_store`` /
+        ``PJ_PROFILE_DIR`` is set. Observability must never fail a
+        solve that already computed correct distances — any error here
+        is swallowed."""
+        try:
+            from paralleljohnson_tpu import observe
+
+            observe.finalize_solve(
+                stats,
+                config=self.config,
+                telemetry=self._tel if self._tel else None,
+                label=label,
+                num_nodes=graph.num_nodes,
+                num_edges=graph.num_real_edges,
+                batch=batch,
+            )
+        except Exception:  # noqa: BLE001 — observability is never fatal
+            pass
+
     def _run_bf(
         self, dgraph: Any, stats: SolverStats, *,
         source: int | None, pred: bool = False,
@@ -468,6 +505,11 @@ class ParallelJohnsonSolver:
             telemetry=self._tel,
         )
         stats.accumulate(bf, phase="bellman_ford")
+        # Route marker on the flight record: the stage spans above were
+        # opened BEFORE dispatch resolved a route, so the tag lands as
+        # an event — trace_summary --by-route joins them back, keeping
+        # flight recordings and cost profiles on one route vocabulary.
+        self._tel.event("route", stage="bellman_ford", route=bf.route)
         if faults is not None:
             bf.dist = faults.poison_rows("bellman_ford", bf.dist)
         if bf.converged and not bf.negative_cycle:
@@ -753,6 +795,11 @@ class ParallelJohnsonSolver:
                         continue  # re-split THIS range smaller; pos unchanged
                     raise
                 stats.accumulate(res, phase="fanout")
+                # Route marker for this batch's stage spans (see _run_bf).
+                tel.event(
+                    "route", stage="fanout", batch=batch_idx,
+                    route=res.route,
+                )
                 if not res.converged:
                     raise ConvergenceError(
                         "fan-out hit max_iterations while still improving"

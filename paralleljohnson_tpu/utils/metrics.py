@@ -70,6 +70,23 @@ class SolverStats:
       (None until a fan-out runs): the configured pipeline_depth, or 1
       after an OOM collapsed the window (which happens BEFORE any batch
       halving).
+    analytic_cost: accumulated compiled-cost capture (ISSUE 7,
+      ``observe.costs``) — XLA's own flops / bytes_accessed /
+      transcendentals summed over every captured kernel invocation,
+      plus ``captures`` (how many landed), ``peak_memory_bytes`` (the
+      largest single executable footprint), and ``unavailable`` (the
+      distinct no-op markers of uninstrumented routes). None when
+      capture is off (no profile store configured) or the backend
+      reports no costs.
+    roofline: the solve's roofline attribution
+      (``observe.roofline.attribute_stats``): bound classification
+      ("hbm" / "mxu" / "host-io" / "unknown"), the derived bandwidth
+      and compute floors, and the arithmetic-intensity-vs-ridge
+      reasoning. Set by the solver for every completed solve.
+    predicted_s: the profile store's calibrated prediction for this
+      solve's route/shape, made BEFORE this run's record landed (None
+      without a store or calibration) — prediction vs ``compute_seconds``
+      is the cost model's running accuracy check.
     """
 
     phase_seconds: dict = dataclasses.field(
@@ -92,12 +109,16 @@ class SolverStats:
     ckpt_wait_s: float = 0.0
     overlap_saved_s: float = 0.0
     final_pipeline_depth: int | None = None
+    analytic_cost: dict | None = None
+    roofline: dict | None = None
+    predicted_s: float | None = None
 
     def accumulate(self, result, phase: str) -> None:
         """Fold one KernelResult into the totals."""
         self.edges_relaxed += int(result.edges_relaxed)
         self.edges_relaxed_by_phase[phase] += int(result.edges_relaxed)
         self.iterations_by_phase[phase] += int(result.iterations)
+        self._accumulate_cost(getattr(result, "cost", None))
         route = getattr(result, "route", None)
         if route:
             # A phase can change route mid-solve (e.g. an auto route degrades
@@ -111,16 +132,51 @@ class SolverStats:
             elif route not in prev.split("+"):
                 self.routes_by_phase[phase] = prev + "+" + route
 
+    def _accumulate_cost(self, cost: dict | None) -> None:
+        """Fold one KernelResult's compiled-cost capture. Every CAPTURED
+        invocation re-pays its analytic cost (a 4-batch fan-out moves
+        the bytes 4 times); unavailable markers are recorded distinctly
+        so "cheap" and "unmeasured" can never be confused."""
+        if not cost:
+            return
+        acc = self.analytic_cost
+        if acc is None:
+            acc = {
+                "flops": 0.0, "bytes_accessed": 0.0,
+                "transcendentals": 0.0, "captures": 0, "unavailable": [],
+            }
+            self.analytic_cost = acc
+        reason = cost.get("cost_analysis_unavailable")
+        if reason is not None:
+            if reason not in acc["unavailable"]:
+                acc["unavailable"].append(reason)
+        else:
+            for k in ("flops", "bytes_accessed", "transcendentals"):
+                acc[k] += float(cost.get(k, 0.0))
+            acc["captures"] += 1
+        mem = cost.get("memory")
+        if mem and mem.get("peak_bytes"):
+            acc["peak_memory_bytes"] = max(
+                acc.get("peak_memory_bytes", 0), int(mem["peak_bytes"])
+            )
+
     @property
     def total_seconds(self) -> float:
         return sum(self.phase_seconds.values())
 
-    def edges_relaxed_per_second(self) -> float:
-        """The headline metric (per chip: divide by mesh size at call site)."""
-        compute = sum(
+    @property
+    def compute_seconds(self) -> float:
+        """Wall-clock in the numeric kernel phases — the denominator of
+        the headline rate and the measurement the cost model calibrates
+        seconds-per-byte/FLOP against."""
+        return sum(
             s for k, s in self.phase_seconds.items()
             if k in ("bellman_ford", "fanout", "batch_apsp")
         )
+
+    def edges_relaxed_per_second(self) -> float:
+        """The headline metric (per chip: divide by mesh size at call site)."""
+        compute = self.compute_seconds
         return self.edges_relaxed / compute if compute > 0 else 0.0
 
     def as_dict(self) -> dict:
@@ -139,6 +195,9 @@ class SolverStats:
             "ckpt_wait_s": self.ckpt_wait_s,
             "overlap_saved_s": self.overlap_saved_s,
             "final_pipeline_depth": self.final_pipeline_depth,
+            "analytic_cost": self.analytic_cost,
+            "roofline": self.roofline,
+            "predicted_s": self.predicted_s,
             "total_seconds": self.total_seconds,
             "edges_relaxed_per_sec": self.edges_relaxed_per_second(),
         }

@@ -21,12 +21,23 @@ import time
 
 
 @contextlib.contextmanager
-def device_trace(log_dir: str | None):
+def device_trace(log_dir: str | None, telemetry=None):
     """Profile the enclosed region with ``jax.profiler.trace`` when
-    ``log_dir`` is set; no-op otherwise (so call sites need no branching)."""
+    ``log_dir`` is set; no-op otherwise (so call sites need no branching).
+
+    When a flight-recorder ``telemetry`` is also active, the trace dir
+    is recorded as a ``device_trace`` event on it — the Chrome trace
+    (host story) and the XLA device trace (kernel story) of one run can
+    then be correlated offline without guessing which directories
+    belong together."""
     if not log_dir:
         yield
         return
+    if telemetry is not None:
+        try:
+            telemetry.event("device_trace", dir=str(log_dir))
+        except Exception:  # noqa: BLE001 — telemetry must never block a trace
+            pass
     import jax
 
     with jax.profiler.trace(log_dir):
@@ -52,6 +63,12 @@ def log_stats(stats, *, label: str = "solve", stream=None, extra=None) -> dict:
         "ts": time.time(),
         **stats.as_dict(),
     }
+    # Quick-read cost-observatory field: the full roofline/analytic_cost
+    # dicts ride in via as_dict; the bound alone is the line a human
+    # greps a log stream for.
+    roof = getattr(stats, "roofline", None)
+    if roof and roof.get("bound"):
+        payload.setdefault("roofline_bound", roof["bound"])
     if extra:
         payload.update(extra)
     out = stream if stream is not None else sys.stderr

@@ -490,6 +490,27 @@ def heartbeat_age_s(path: str | Path, now: float | None = None) -> float | None:
 
 # -- prometheus textfile export ----------------------------------------------
 
+
+def _measured_compute_s(s: Any) -> float:
+    compute = getattr(s, "compute_seconds", None)
+    if compute is not None:
+        return float(compute)
+    return float(getattr(s, "total_seconds", 0.0) or 0.0)
+
+
+def _roofline_kind_values(s: Any) -> dict:
+    """Per-kind samples of the labeled ``pjtpu_roofline_bound`` gauge:
+    1 on the solve's classified bound, 0 on the others; empty (no
+    samples emitted) when the solve was never attributed."""
+    roof = getattr(s, "roofline", None)
+    if not roof:
+        return {}
+    from paralleljohnson_tpu.observe.roofline import BOUND_KINDS
+
+    bound = roof.get("bound", "unknown")
+    return {kind: 1.0 if kind == bound else 0.0 for kind in BOUND_KINDS}
+
+
 _PROM_METRICS = (
     ("pjtpu_edges_relaxed_total", "counter",
      "Total edge relaxations performed by the solve",
@@ -506,6 +527,20 @@ _PROM_METRICS = (
     ("pjtpu_ckpt_wait_seconds", "gauge",
      "Seconds the solve thread spent blocked on the checkpoint pipeline",
      lambda s: s.ckpt_wait_s),
+    # Cost-observatory gauges (ISSUE 7): the calibrated prediction vs
+    # the measurement it is graded against, and the labeled roofline
+    # bound classification.
+    ("pjtpu_route_predicted_s", "gauge",
+     "Cost-model predicted compute seconds for this solve's route "
+     "(0 = no calibration available)",
+     lambda s: float(getattr(s, "predicted_s", None) or 0.0)),
+    ("pjtpu_route_measured_s", "gauge",
+     "Measured compute seconds (bellman_ford + fanout + batch_apsp)",
+     _measured_compute_s),
+    ("pjtpu_roofline_bound", "gauge",
+     "Roofline classification of the solve: 1 on the active bound's "
+     "kind label (hbm / mxu / host-io / unknown)",
+     _roofline_kind_values, "kind"),
 )
 
 
@@ -519,16 +554,38 @@ def write_prom_metrics(stats: Any, path: str | Path, *,
     default the solve-stats table above; the serving layer passes its own
     (``serve.engine.SERVE_PROM_METRICS``: pjtpu_queries_total,
     pjtpu_query_latency_*, ...) so every subsystem exports through this
-    one atomic writer.
+    one atomic writer. A 5-tuple entry ``(name, type, help, getter,
+    label_name)`` is a LABELED metric: its getter returns
+    ``{label_value: sample}`` and one line is emitted per label value
+    (e.g. ``pjtpu_roofline_bound{kind="hbm"} 1.0``); an empty dict
+    emits no samples (the metric has nothing to report).
     """
-    label_str = ""
-    if labels:
+
+    def fmt_labels(extra: dict | None = None) -> str:
+        merged = dict(labels or {})
+        if extra:
+            merged.update(extra)
+        if not merged:
+            return ""
         inner = ",".join(
-            f'{k}="{str(v)}"' for k, v in sorted(labels.items())
+            f'{k}="{str(v)}"' for k, v in sorted(merged.items())
         )
-        label_str = "{" + inner + "}"
+        return "{" + inner + "}"
+
+    label_str = fmt_labels()
     lines = []
-    for name, mtype, help_text, get in (metrics or _PROM_METRICS):
+    for entry in (metrics or _PROM_METRICS):
+        if len(entry) == 5:
+            name, mtype, help_text, get, label_name = entry
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {mtype}")
+            for value, sample in sorted((get(stats) or {}).items()):
+                lines.append(
+                    f"{name}{fmt_labels({label_name: value})} "
+                    f"{float(sample)}"
+                )
+            continue
+        name, mtype, help_text, get = entry
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {mtype}")
         lines.append(f"{name}{label_str} {float(get(stats))}")

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Bench-regression gate (ISSUE 7 tentpole part 4) — exits non-zero
+when a fresh measurement regresses against its history.
+
+The history store (``bench_history.jsonl``, next to the profile store)
+accumulates every measurement the repo produces: ``pjtpu bench`` rows,
+the driver ``bench.py`` metric (which also self-checks at emit time),
+the committed ``BENCH_r0*.json`` trajectory (``--ingest``), and the
+suite-budget guard's wall-clock. This script grades fresh rows against
+the per-(bench, backend, platform, preset) median with a noise band,
+and annotates every flagged row with its roofline classification so a
+slowdown arrives pre-attributed (HBM / MXU / host-IO / unknown).
+
+Usage:
+  # ingest the committed driver trajectory, then grade the newest row:
+  python scripts/bench_regress.py --history bench_artifacts/profiles \\
+      --ingest BENCH_r0*.json --last 1
+  # grade a fresh rows file against history, append it when it passes:
+  python scripts/bench_regress.py --fresh rows.jsonl --update
+
+Exit codes: 0 = no regression, 1 = regression(s) flagged, 2 = usage /
+unreadable input. Loaded standalone (no package import, no jax) so the
+TPU pass can run it in seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_module(rel: str, name: str):
+    """Import a repo module STANDALONE from its file path — skipping the
+    package __init__ (which pulls in jax) keeps this script runnable on
+    a log-analysis box in well under a second."""
+    spec = importlib.util.spec_from_file_location(name, _REPO / rel)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+regress = _load_module("paralleljohnson_tpu/observe/regress.py", "pj_regress")
+store_mod = _load_module("paralleljohnson_tpu/observe/store.py", "pj_store")
+
+
+def _default_history() -> str:
+    return os.environ.get("PJ_PROFILE_DIR") or str(
+        _REPO / "bench_artifacts" / "profiles"
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="grade fresh bench rows against their history; "
+        "non-zero exit on regression"
+    )
+    ap.add_argument("--history", default=_default_history(),
+                    help="history store: a directory (rows live in "
+                         "bench_history.jsonl) or a .jsonl path "
+                         "(default: $PJ_PROFILE_DIR or "
+                         "bench_artifacts/profiles)")
+    ap.add_argument("--ingest", nargs="*", default=[], metavar="FILE",
+                    help="measurement files to normalize + append first "
+                         "(BENCH_r0*.json driver jsons, pjtpu bench "
+                         "JSONL, normalized rows); idempotent — exact "
+                         "re-ingests dedup")
+    ap.add_argument("--fresh", nargs="*", default=[], metavar="FILE",
+                    help="rows to grade against the history (same "
+                         "formats); without --fresh, --last grades the "
+                         "newest history rows against the rest")
+    ap.add_argument("--last", type=int, default=1, metavar="N",
+                    help="without --fresh: grade the last N history "
+                         "rows against the older remainder (default 1)")
+    ap.add_argument("--band", type=float, default=regress.DEFAULT_BAND,
+                    help="noise band: flag fresh > median * (1 + band) "
+                         f"(default {regress.DEFAULT_BAND})")
+    ap.add_argument("--min-history", type=int,
+                    default=regress.DEFAULT_MIN_HISTORY,
+                    help="skip keys with fewer prior rows than this "
+                         f"(default {regress.DEFAULT_MIN_HISTORY})")
+    ap.add_argument("--profile-store", default=None, metavar="DIR",
+                    help="profile store for roofline annotation "
+                         "(default: the --history directory)")
+    ap.add_argument("--update", action="store_true",
+                    help="append --fresh rows to the history when the "
+                         "grade passes")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one machine-readable JSON line")
+    args = ap.parse_args(argv)
+
+    hist = regress.BenchHistory(args.history)
+    ingested = 0
+    for f in args.ingest:
+        try:
+            for row in regress.load_measurements(f):
+                ingested += int(hist.append(row))
+        except (OSError, ValueError) as e:
+            print(f"bench-regress: cannot ingest {f}: {e}",
+                  file=sys.stderr)
+            return 2
+    if ingested:
+        print(f"bench-regress: ingested {ingested} new row(s) into "
+              f"{hist.path}", file=sys.stderr)
+
+    history = hist.rows()
+    if args.fresh:
+        fresh = []
+        for f in args.fresh:
+            try:
+                fresh.extend(regress.load_measurements(f))
+            except (OSError, ValueError) as e:
+                print(f"bench-regress: cannot read {f}: {e}",
+                      file=sys.stderr)
+                return 2
+    else:
+        n = max(0, args.last)
+        fresh, history = history[len(history) - n:], history[: len(history) - n]
+    if not fresh:
+        print("bench-regress: nothing to grade (empty history and no "
+              "--fresh rows)", file=sys.stderr)
+        return 0
+
+    profile_records = []
+    try:
+        profile_records = store_mod.ProfileStore(
+            args.profile_store or args.history
+        ).records()
+    except (OSError, ValueError):
+        pass  # annotation is best-effort; the grade stands without it
+
+    flagged = regress.detect_regressions(
+        fresh, history, band=args.band, min_history=args.min_history,
+        profile_records=profile_records,
+    )
+    graded = sum(
+        1 for r in fresh if isinstance(r.get("wall_s"), (int, float))
+    )
+    if args.as_json:
+        print(json.dumps({
+            "graded": graded, "history_rows": len(history),
+            "flagged": flagged, "band": args.band,
+        }))
+    else:
+        print(f"bench-regress: graded {graded} row(s) against "
+              f"{len(history)} history row(s), band {args.band:.0%}")
+        for f in flagged:
+            print(
+                f"  REGRESSION {f['bench']} [{f['backend']}/"
+                f"{f['platform']}"
+                + (f"/{f['preset']}" if f.get("preset") else "")
+                + f"]: {f['wall_s']:.4f}s vs median "
+                f"{f['baseline_s']:.4f}s over {f['history_n']} runs "
+                f"({f['slowdown']:.2f}x) — roofline: "
+                f"{f['roofline_bound']}"
+            )
+        if not flagged:
+            print("  OK — every graded row is within its noise band")
+    if flagged:
+        return 1
+    if args.update and args.fresh:
+        added = sum(int(hist.append(r)) for r in fresh)
+        print(f"bench-regress: appended {added} passing row(s) to "
+              f"{hist.path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

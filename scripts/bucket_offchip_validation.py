@@ -42,6 +42,16 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 # axon plugin dials the (possibly wedged) tunnel at init.
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Cost observatory (ISSUE 7): validation solves persist their profile
+# records (analytic costs + measured walls) into the shared store, so
+# the calibration the dispatch registry will consume includes the
+# off-chip validation numbers too.
+os.environ.setdefault(
+    "PJ_PROFILE_DIR",
+    str(Path(__file__).resolve().parent.parent
+        / "bench_artifacts" / "profiles"),
+)
+
 from paralleljohnson_tpu.utils.platform import honor_cpu_platform_request
 
 honor_cpu_platform_request()

@@ -40,6 +40,43 @@ def suite_seconds(text: str) -> float | None:
     return secs
 
 
+def _append_history(secs: float, log_path: Path) -> None:
+    """Feed the suite wall-clock into the bench-regression history
+    (ISSUE 7 satellite): the SAME detector that gates kernel rows then
+    catches suite wall-clock creep. Destination: $PJ_PROFILE_DIR, else
+    bench_artifacts/profiles when a bench_artifacts dir already exists
+    in cwd (so ad-hoc runs in temp dirs never scatter stores). Loaded
+    standalone (no package import — this guard must stay jax-free and
+    instant); never fatal."""
+    try:
+        hist_dir = os.environ.get("PJ_PROFILE_DIR")
+        if hist_dir is None and Path("bench_artifacts").is_dir():
+            hist_dir = "bench_artifacts/profiles"
+        if not hist_dir:
+            return
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "pj_regress",
+            Path(__file__).resolve().parent.parent
+            / "paralleljohnson_tpu" / "observe" / "regress.py",
+        )
+        regress = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(regress)
+        regress.BenchHistory(hist_dir).append({
+            "bench": "suite_budget",
+            "backend": "pytest",
+            "platform": "cpu",
+            "preset": None,
+            "wall_s": float(secs),
+            "detail": {},
+            "source": str(log_path),
+        }, dedup=False)  # every run is a new sample of the same command
+    except Exception as e:  # noqa: BLE001 — the guard's verdict stands alone
+        print(f"suite-budget: history append failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("log", nargs="?", default="/tmp/_t1.log",
@@ -60,6 +97,7 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return 2
+    _append_history(secs, path)
     if secs > args.budget:
         print(
             f"suite-budget: FAIL — suite took {secs:.1f}s "

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Offline cost-observatory reader (ISSUE 7) — the "why does it cost
+that" twin of ``trace_summary.py``'s "what happened".
+
+Point it at a profile store (a directory containing ``profiles.jsonl``,
+e.g. ``bench_artifacts/profiles``) or directly at a flight-recorder
+JSONL, and it prints:
+
+  profile-store mode:
+    - per-(route, platform) record summary: runs, median compute,
+      analytic bytes/FLOPs, roofline-bound distribution;
+    - the fitted cost-model calibration table (seconds per analytic
+      byte / FLOP / edge-row) — the numbers ROADMAP item 7's dispatch
+      registry consumes;
+    - prediction accuracy: for records that carried a pre-run
+      prediction, the predicted-vs-measured ratio spread.
+  flight mode (a flight-*.jsonl or a directory of them):
+    - the per-route span aggregate (total/mean wall per route tag) —
+      the same table ``trace_summary.py --by-route`` prints, so flight
+      recordings and cost profiles share one route vocabulary.
+
+No jax, no package import: loads the observe modules standalone, safe
+on any log-analysis box.
+
+Usage:
+  python scripts/cost_report.py bench_artifacts/profiles
+  python scripts/cost_report.py bench_artifacts/telemetry/flight-solve.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import statistics
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_module(rel: str, name: str):
+    spec = importlib.util.spec_from_file_location(name, _REPO / rel)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+store_mod = _load_module("paralleljohnson_tpu/observe/store.py", "pj_store")
+
+
+def report_store(root: Path, out=sys.stdout) -> int:
+    store = store_mod.ProfileStore(root)
+    records = store.records()
+    if not records:
+        print(f"no records in {store.path}", file=sys.stderr)
+        return 1
+    print(f"profile store: {store.path} — {len(records)} record(s)",
+          file=out)
+
+    groups: dict = {}
+    for r in records:
+        key = (r.get("route"), r.get("platform"))
+        g = groups.setdefault(
+            key, {"n": 0, "compute": [], "bytes": [], "flops": [],
+                  "bounds": {}, "pred_ratio": []},
+        )
+        g["n"] += 1
+        measured = r.get("measured") or {}
+        compute = measured.get("compute_s") or measured.get("wall_s")
+        if compute:
+            g["compute"].append(compute)
+        cost = r.get("cost") or {}
+        if cost.get("bytes_accessed"):
+            g["bytes"].append(cost["bytes_accessed"])
+        if cost.get("flops"):
+            g["flops"].append(cost["flops"])
+        bound = (r.get("roofline") or {}).get("bound", "unknown")
+        g["bounds"][bound] = g["bounds"].get(bound, 0) + 1
+        if r.get("predicted_s") and compute:
+            g["pred_ratio"].append(r["predicted_s"] / compute)
+
+    print("\nper-route records:", file=out)
+    hdr = (f"  {'route':<22} {'platform':<9} {'n':>4} "
+           f"{'med compute':>12} {'med bytes':>12} {'med flops':>12}  "
+           "bounds")
+    print(hdr, file=out)
+    for (route, platform), g in sorted(
+        groups.items(), key=lambda kv: str(kv[0])
+    ):
+        med = lambda xs, fmt: (  # noqa: E731
+            fmt.format(statistics.median(xs)) if xs else "-"
+        )
+        bounds = ",".join(
+            f"{k}:{v}" for k, v in sorted(g["bounds"].items())
+        )
+        print(
+            f"  {str(route):<22} {str(platform):<9} {g['n']:>4} "
+            f"{med(g['compute'], '{:>11.4f}s')} "
+            f"{med(g['bytes'], '{:>12.3e}')} "
+            f"{med(g['flops'], '{:>12.3e}')}  {bounds}",
+            file=out,
+        )
+
+    model = store_mod.CostModel.fit(records)
+    print("\ncalibration (CostModel.fit — what dispatch will consume):",
+          file=out)
+    for e in model.table():
+        parts = [f"s/edge-row {e['s_per_edge_row']:.3e}"]
+        if e.get("s_per_byte"):
+            parts.append(f"s/byte {e['s_per_byte']:.3e}")
+        if e.get("s_per_flop"):
+            parts.append(f"s/flop {e['s_per_flop']:.3e}")
+        print(f"  {e['route']:<22} {e['platform']:<9} n={e['n']:<4} "
+              + "  ".join(parts), file=out)
+
+    ratios = [x for g in groups.values() for x in g["pred_ratio"]]
+    if ratios:
+        print(
+            f"\nprediction accuracy ({len(ratios)} predicted record(s)): "
+            f"predicted/measured median {statistics.median(ratios):.2f}, "
+            f"min {min(ratios):.2f}, max {max(ratios):.2f}",
+            file=out,
+        )
+    return 0
+
+
+def report_flight(path: Path, out=sys.stdout) -> int:
+    ts = _load_module("scripts/trace_summary.py", "pj_trace_summary")
+    flights = (
+        sorted(path.glob("flight-*.jsonl")) if path.is_dir() else [path]
+    )
+    if not flights:
+        print(f"no flight-*.jsonl under {path}", file=sys.stderr)
+        return 1
+    rc = 1
+    for f in flights:
+        records = ts.load_flight(f)
+        table = ts.route_table(records)
+        print(f"\n{f} — per-route span aggregate:", file=out)
+        if not table:
+            print("  (no route-tagged spans — pre-round-12 recording?)",
+                  file=out)
+            continue
+        rc = 0
+        for route, n, total, mean in table:
+            print(f"  {route:<24} {n:>5} span(s) "
+                  f"{total * 1e3:>12.2f} ms total "
+                  f"{mean * 1e3:>10.2f} ms mean", file=out)
+    return rc
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="offline reader over a profile store or flight dir"
+    )
+    ap.add_argument("path", help="profile-store dir (profiles.jsonl), a "
+                                 "flight-*.jsonl, or a telemetry dir")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="profile-store mode: dump the fitted "
+                         "calibration table as one JSON line")
+    args = ap.parse_args(argv)
+    p = Path(args.path)
+    if not p.exists():
+        print(f"cost-report: {p} does not exist", file=sys.stderr)
+        return 2
+    is_store = (
+        p.is_dir() and (p / store_mod.PROFILE_FILENAME).exists()
+    ) or p.name == store_mod.PROFILE_FILENAME
+    if is_store:
+        root = p.parent if p.name == store_mod.PROFILE_FILENAME else p
+        if args.as_json:
+            model = store_mod.CostModel.fit(
+                store_mod.ProfileStore(root)
+            )
+            print(json.dumps({"calibration": model.table()}))
+            return 0
+        return report_store(root)
+    return report_flight(p)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

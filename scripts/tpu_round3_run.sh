@@ -25,6 +25,14 @@ export PJ_TRACE_DIR=${PJ_TRACE_DIR:-/tmp/pj_telemetry}
 export PJ_HEARTBEAT_FILE=${PJ_HEARTBEAT_FILE:-$PJ_TRACE_DIR/heartbeat.json}
 export PJ_HEARTBEAT_INTERVAL=${PJ_HEARTBEAT_INTERVAL:-5}
 export PJ_METRICS_FILE=${PJ_METRICS_FILE:-$PJ_TRACE_DIR/pjtpu.prom}
+# Cost observatory (ISSUE 7): every stage's solves capture XLA compiled
+# costs + append profile records and bench-history rows straight into
+# the repo's artifact dir — the roofline attribution of THIS pass is
+# what finally answers "bandwidth or compute" for the s22 gap
+# (ROADMAP item 1), and the persisted calibration is what the dispatch
+# registry (item 7) will consume.
+export PJ_PROFILE_DIR=${PJ_PROFILE_DIR:-$PWD/bench_artifacts/profiles}
+mkdir -p "$PJ_PROFILE_DIR"
 # A heartbeat older than this is "hung" (watchdog abandons + tunnel
 # wedges stop updating it); fresh-but-slow stages get their deadline
 # extended up to 3x the configured stage budget.
@@ -105,6 +113,12 @@ run() {  # run <seconds> <label> <cmd...>
 # 0) probe
 run 120 probe python -c "import jax,numpy as np; print('probe', int(jax.jit(lambda x:x+1)(np.int32(1))))" || exit 1
 
+# 0a) seed the bench-regression history with the committed BENCH_r0*.json
+#     trajectory (idempotent: exact re-ingests dedup) BEFORE any fresh
+#     measurement lands, so --last grading below sees the fresh row as
+#     newest. --last 0 = ingest only, grade nothing.
+run 120 bench-history-ingest python scripts/bench_regress.py --history "$PJ_PROFILE_DIR" --ingest BENCH_r0*.json --last 0
+
 # 0b) driver metric FIRST: bench.py is the artifact the round is scored
 # on (round-3 verdict missing #2 — three rounds, zero driver-captured
 # on-chip numbers because the tunnel wedged before stage 5 could run).
@@ -112,6 +126,11 @@ run 120 probe python -c "import jax,numpy as np; print('probe', int(jax.jit(lamb
 # it again at the end (stage 5) so the freshest kernels get the final
 # recorded number.
 run 1200 bench.py-early python bench.py
+
+# 0b') bench-regression gate on the row bench.py just appended: a
+#      slowdown vs the ingested trajectory fails THIS stage with the
+#      flagged row already roofline-attributed (HBM/MXU/host-IO).
+run 120 bench-regress-early python scripts/bench_regress.py --history "$PJ_PROFILE_DIR" --last 1
 
 # 0c) round-5 quick win: DIA vs the committed 17.4 s dimacs row —
 #     minutes, and the largest projected single-kernel gain; early so a
@@ -171,6 +190,11 @@ run 900 jax-serve-bench python -m paralleljohnson_tpu.cli bench serve_queries --
 
 # 5) driver metric (should reflect the blocked kernel now)
 run 1200 bench.py python bench.py
+
+# 5a) final regression grade + the priced-route/cost report over the
+#     whole pass's profile store (the round's attribution artifact)
+run 120 bench-regress python scripts/bench_regress.py --history "$PJ_PROFILE_DIR" --last 1
+run 120 cost-report python scripts/cost_report.py "$PJ_PROFILE_DIR"
 
 # 6) memory-guard probe (VERDICT #10): rmat-20 x 128 fan-out, default
 #    config, assert no OOM + record suggested_source_batch

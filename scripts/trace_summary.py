@@ -12,7 +12,11 @@ the TPU pass under ``bench_artifacts/telemetry/``) and prints:
   - every resilience event (retry / abandon / oom_degrade /
     window_collapse / batch_resumed) in order;
   - ``--chrome OUT.json``: a Perfetto-loadable Chrome-trace export of
-    the same records (validated before writing).
+    the same records (validated before writing);
+  - ``--by-route``: the per-route span aggregate (total/mean wall per
+    kernel-route tag) — the same route vocabulary the cost profiles
+    (``bench_artifacts/profiles``) key on, so a flight recording and a
+    profile store cross-reference directly.
 
 No dependency on the package being importable beyond ``utils.telemetry``
 (pure python — safe to run on a machine with no jax).
@@ -91,6 +95,55 @@ def build_spans(records: list[dict]) -> list[dict]:
     return [spans[i] for i in order]
 
 
+def route_table(records: list[dict]) -> list[tuple]:
+    """Per-route span aggregate: ``(route, n_spans, total_s, mean_s)``
+    sorted by total time, descending.
+
+    Stage spans are opened BEFORE dispatch resolves a kernel route, so
+    the solver emits a ``route`` event (attrs: stage, batch, route)
+    after each stage completes; this join attributes every closed span
+    of that (stage name, batch) — all its attempts — to the route tag.
+    Spans carrying ``attrs.route`` directly are aggregated as-is. The
+    tags are the SAME vocabulary the cost profiles use (KernelResult
+    .route), so a flight recording and a profile store cross-reference."""
+    spans = build_spans(records)
+    route_of: dict[tuple, str] = {}
+    for r in records:
+        if r.get("type") == "event" and r.get("name") == "route":
+            a = r.get("attrs") or {}
+            if a.get("route"):
+                route_of[(a.get("stage"), a.get("batch"))] = a["route"]
+    agg: dict[str, list] = {}
+    for s in spans:
+        if s["open"] or s["dur"] is None:
+            continue
+        route = s["attrs"].get("route") or route_of.get(
+            (s["name"], s["attrs"].get("batch"))
+        )
+        if route is None:
+            continue
+        entry = agg.setdefault(route, [0, 0.0])
+        entry[0] += 1
+        entry[1] += s["dur"]
+    return sorted(
+        ((route, n, total, total / n) for route, (n, total) in agg.items()),
+        key=lambda row: row[2],
+        reverse=True,
+    )
+
+
+def print_route_table(records: list[dict], out=sys.stdout) -> None:
+    table = route_table(records)
+    print("\nper-route span aggregate:", file=out)
+    if not table:
+        print("  (no route-tagged spans in this recording)", file=out)
+        return
+    for route, n, total, mean in table:
+        print(f"  {route:<24} {n:>5} span(s) "
+              f"{total * 1e3:>12.2f} ms total {mean * 1e3:>10.2f} ms mean",
+              file=out)
+
+
 def _fmt_dur(s: dict) -> str:
     if s["open"]:
         return "   OPEN at death"
@@ -152,10 +205,16 @@ def main(argv: list[str] | None = None) -> int:
                     help="how many slowest spans to list")
     ap.add_argument("--chrome", default=None, metavar="OUT.json",
                     help="also export a Perfetto-loadable Chrome trace")
+    ap.add_argument("--by-route", action="store_true",
+                    help="also print the per-route span aggregate "
+                         "(total/mean wall per kernel-route tag — the "
+                         "same vocabulary the cost profiles use)")
     args = ap.parse_args(argv)
 
     records = load_flight(args.flight)
     print_summary(records, top=args.top)
+    if args.by_route:
+        print_route_table(records)
     if args.chrome:
         trace = chrome_trace_from_records(records)
         validate_chrome_trace(trace)

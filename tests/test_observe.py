@@ -1,0 +1,506 @@
+"""Cost observatory (ISSUE 7): compiled-cost capture, profile-store
+round-trip + calibration, roofline attribution, prom gauges, and
+bench-regression detection — all CPU-testable."""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu.observe import (
+    BenchHistory,
+    CostCapture,
+    CostModel,
+    ProfileStore,
+    classify,
+    detect_regressions,
+    normalize_record,
+)
+from paralleljohnson_tpu.observe.roofline import attribute_stats
+from paralleljohnson_tpu.utils.metrics import SolverStats
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_script(name: str):
+    spec = importlib.util.spec_from_file_location(
+        f"pj_{name}", REPO / "scripts" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _synthetic_records(n=4, route="vm", platform="cpu", s_per_er=1e-6,
+                       edges=1000):
+    """Linear-cost records: compute_s = s_per_er * batch * edges."""
+    out = []
+    for i in range(n):
+        batch = 8 << i
+        out.append({
+            "kind": "solve", "route": route, "platform": platform,
+            "nodes": 64, "edges": edges, "batch": batch,
+            "measured": {"compute_s": s_per_er * batch * edges,
+                         "wall_s": s_per_er * batch * edges},
+            "edges_relaxed": batch * edges,
+            "cost": {"flops": 2.0 * batch * edges,
+                     "bytes_accessed": 16.0 * batch * edges,
+                     "transcendentals": 0.0},
+            "roofline": {"bound": "hbm"},
+        })
+    return out
+
+
+# -- profile store + cost model ----------------------------------------------
+
+
+def test_profile_store_roundtrip_and_torn_trailing_line(tmp_path):
+    store = ProfileStore(tmp_path / "prof")
+    for r in _synthetic_records(3):
+        store.append(r)
+    recs = store.records()
+    assert len(recs) == 3
+    assert recs[0]["route"] == "vm"
+    assert recs[0]["cost"]["bytes_accessed"] > 0
+    # A torn TRAILING line (kill mid-append) is tolerated...
+    with open(store.path, "a", encoding="utf-8") as f:
+        f.write('{"kind": "solve", "trunc')
+    assert len(store.records()) == 3
+    # ...but corruption in the middle is loud.
+    lines = store.path.read_text().splitlines()
+    lines[1] = '{"broken'
+    store.path.write_text("\n".join(lines) + "\n")
+    with pytest.raises(ValueError, match="corrupt record"):
+        store.records()
+
+
+def test_cost_model_calibrates_and_predicts_within_tolerance(tmp_path):
+    store = ProfileStore(tmp_path)
+    for r in _synthetic_records(5, s_per_er=2e-6):
+        store.append(r)
+    model = CostModel.fit(store)
+    pred = model.predict("vm", num_edges=3000, batch=64, platform="cpu")
+    expect = 2e-6 * 64 * 3000
+    assert pred is not None
+    assert pred["predicted_s"] == pytest.approx(expect, rel=0.05)
+    # The analytic breakdown extrapolates by density.
+    assert pred["bytes_accessed"] == pytest.approx(16.0 * 64 * 3000, rel=0.05)
+    # Platform defaulting works when the route is unambiguous.
+    assert model.predict("vm", num_edges=3000, batch=64) is not None
+
+
+def test_cost_model_unpriced_route_is_none():
+    model = CostModel.fit(_synthetic_records(3))
+    assert model.predict("gs", num_edges=100, batch=1) is None
+    assert model.predict("vm", num_edges=0, batch=4) is None
+
+
+def test_cost_model_table_lists_calibration():
+    table = CostModel.fit(_synthetic_records(3)).table()
+    assert len(table) == 1
+    entry = table[0]
+    assert entry["route"] == "vm" and entry["n"] == 3
+    assert entry["s_per_edge_row"] == pytest.approx(1e-6, rel=0.01)
+    assert entry["s_per_byte"] is not None
+
+
+# -- compiled-cost capture ----------------------------------------------------
+
+
+def test_capture_real_jitted_kernel_and_key_caching():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return (x @ x.T).sum()
+
+    cap = CostCapture(enabled=True)
+    x = jnp.ones((16, 16), jnp.float32)
+    rec = cap.capture("toy", f, (x,), num_nodes=16, num_edges=256, batch=1)
+    assert rec["flops"] > 0 and rec["bytes_accessed"] > 0
+    assert "cost_analysis_unavailable" not in rec
+    assert rec["memory"]["argument_bytes"] > 0
+    assert rec["shape_bucket"] == [16, 256, 1]
+    # Same key -> the cached record object, no re-lowering.
+    assert cap.capture(
+        "toy", f, (x,), num_nodes=16, num_edges=256, batch=1
+    ) is rec
+
+
+def test_capture_noop_path_when_cost_analysis_unavailable():
+    """The graceful no-op contract: a kernel handle that cannot be
+    AOT-lowered (stand-in for a backend/JAX version without
+    cost_analysis) yields the explicit marker, never an exception."""
+
+    class NoLower:
+        def lower(self, *a, **k):
+            raise AttributeError("this backend has no AOT lowering")
+
+    cap = CostCapture(enabled=True)
+    rec = cap.capture(
+        "vm", NoLower(), (), num_nodes=8, num_edges=9, batch=2
+    )
+    assert "lower/compile failed" in rec["cost_analysis_unavailable"]
+    assert "flops" not in rec
+    # Disabled capture returns None without touching the kernel.
+    off = CostCapture(enabled=False)
+    assert off.capture("vm", None, (), num_nodes=1, num_edges=1) is None
+    assert off.unavailable("vm", "x", num_nodes=1, num_edges=1) is None
+
+
+def test_solve_appends_profile_record_and_calibrated_prediction(tmp_path):
+    """End-to-end tentpole check: a jax solve with a profile store
+    captures analytic costs, roofline-classifies, appends one record
+    per solve, and the SECOND solve carries a prediction from the
+    first's calibration."""
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.graphs import erdos_renyi
+    from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+    g = erdos_renyi(48, 0.1, seed=3)
+    cfg = SolverConfig(profile_store=str(tmp_path), mesh_shape=(1,))
+    solver = ParallelJohnsonSolver(cfg)
+    res = solver.solve(g, sources=np.arange(8))
+    assert res.stats.analytic_cost is not None
+    assert res.stats.analytic_cost["captures"] >= 1
+    assert res.stats.analytic_cost["flops"] > 0
+    assert res.stats.roofline is not None
+    assert res.stats.roofline["bound"] in ("hbm", "mxu")
+    recs = ProfileStore(tmp_path).records()
+    assert len(recs) == 1
+    assert recs[0]["cost"]["bytes_accessed"] > 0
+    assert recs[0]["roofline"]["bound"] == res.stats.roofline["bound"]
+    res2 = solver.solve(g, sources=np.arange(8))
+    assert res2.stats.predicted_s is not None and res2.stats.predicted_s > 0
+    assert len(ProfileStore(tmp_path).records()) == 2
+
+
+def test_sharded_route_records_unavailable_marker(tmp_path):
+    """The 8-device mesh fan-out has no single lowerable executable —
+    its record must say 'unmeasured' explicitly, not claim zero cost."""
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.graphs import erdos_renyi
+    from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+    g = erdos_renyi(64, 0.05, seed=5)
+    cfg = SolverConfig(profile_store=str(tmp_path))
+    res = ParallelJohnsonSolver(cfg).multi_source(g, np.arange(16))
+    assert "sharded" in res.stats.routes_by_phase["fanout"]
+    acc = res.stats.analytic_cost
+    assert acc is not None and acc["captures"] == 0
+    assert any("not cost-instrumented" in u for u in acc["unavailable"])
+    rec = ProfileStore(tmp_path).records()[-1]
+    assert rec["cost"]["captures"] == 0
+
+
+# -- roofline ----------------------------------------------------------------
+
+
+def test_roofline_classify_rules():
+    # Bandwidth-heavy: intensity below the ridge -> hbm.
+    low = classify(flops=1e6, bytes_accessed=1e9, platform="tpu")
+    assert low["bound"] == "hbm"
+    assert low["intensity_flop_per_byte"] < low["ridge_flop_per_byte"]
+    # Math-heavy: intensity above the ridge -> mxu.
+    high = classify(flops=1e12, bytes_accessed=1e6, platform="tpu")
+    assert high["bound"] == "mxu"
+    # Dominant host IO wins regardless of analytics.
+    io = classify(flops=1e12, bytes_accessed=1e6, host_io_s=3.0,
+                  wall_s=4.0, platform="tpu")
+    assert io["bound"] == "host-io"
+    # No analytics, no dominant IO -> honest unknown.
+    unk = classify(platform="tpu")
+    assert unk["bound"] == "unknown" and "why" in unk
+
+
+def test_attribute_stats_host_io_net_of_overlap():
+    stats = SolverStats()
+    stats.phase_seconds["fanout"] = 1.0
+    stats.download_s = 0.9
+    stats.ckpt_wait_s = 0.2
+    stats.overlap_saved_s = 0.0
+    assert attribute_stats(stats, platform="cpu")["bound"] == "host-io"
+    # The overlap the pipeline hid does not count against the solve.
+    stats.overlap_saved_s = 1.0
+    assert attribute_stats(stats, platform="cpu")["bound"] == "unknown"
+
+
+# -- prom gauges --------------------------------------------------------------
+
+
+def test_prom_metrics_cost_gauges(tmp_path):
+    from paralleljohnson_tpu.utils.telemetry import write_prom_metrics
+
+    stats = SolverStats()
+    stats.phase_seconds["fanout"] = 0.5
+    stats.predicted_s = 0.4
+    stats.roofline = {"bound": "hbm"}
+    write_prom_metrics(stats, tmp_path / "m.prom",
+                       labels={"config": "x"})
+    lines = (tmp_path / "m.prom").read_text().splitlines()
+    assert 'pjtpu_route_predicted_s{config="x"} 0.4' in lines
+    assert 'pjtpu_route_measured_s{config="x"} 0.5' in lines
+    assert 'pjtpu_roofline_bound{config="x",kind="hbm"} 1.0' in lines
+    assert 'pjtpu_roofline_bound{config="x",kind="mxu"} 0.0' in lines
+    # Unattributed stats emit NO roofline samples (nothing to report),
+    # while the scalar gauges still write.
+    plain = SolverStats()
+    write_prom_metrics(plain, tmp_path / "p.prom")
+    text = (tmp_path / "p.prom").read_text()
+    assert "pjtpu_roofline_bound{kind=" not in text
+    assert "pjtpu_route_measured_s 0.0" in text
+
+
+# -- bench regression ---------------------------------------------------------
+
+
+def test_normalize_record_formats():
+    # pjtpu bench row line
+    rows = normalize_record({
+        "config": "er1k_apsp", "backend": "jax", "preset": "mini",
+        "wall_s": 1.5, "detail": {"platform": "cpu"},
+    })
+    assert rows[0]["bench"] == "er1k_apsp" and rows[0]["wall_s"] == 1.5
+    # a failed row is not a measurement
+    assert normalize_record({
+        "config": "x", "backend": "jax", "preset": "mini", "wall_s": 0.1,
+        "detail": {"failed": "boom"},
+    }) == []
+    # the driver wrapper format (BENCH_r0*.json): keyed off the tag,
+    # platform split out, dt as the wall
+    rows = normalize_record({
+        "parsed": {
+            "metric": "edges_relaxed_per_sec_per_chip"
+                      "[rmat13x128src,cpu-fallback]",
+            "value": 1e9,
+            "detail": {"platform": "cpu", "dt": 0.125},
+        }
+    })
+    assert rows[0]["bench"] == "driver:rmat13x128src"
+    assert rows[0]["platform"] == "cpu"
+    assert rows[0]["wall_s"] == 0.125
+    # driver rows without a dt (the r01/r02 format) are skipped
+    assert normalize_record(
+        {"metric": "m[x]", "value": 1.0, "detail": {}}
+    ) == []
+    assert normalize_record("not a dict") == []
+
+
+def test_history_append_dedups_reingestion(tmp_path):
+    hist = BenchHistory(tmp_path)
+    row = {"bench": "b", "backend": "jax", "platform": "cpu",
+           "preset": None, "wall_s": 1.0, "detail": {}}
+    assert hist.append(row) is True
+    assert hist.append(dict(row)) is False  # ts-ignored duplicate
+    assert hist.append({**row, "wall_s": 1.1}) is True
+    assert len(hist.rows()) == 2
+    assert all("ts" in r for r in hist.rows())
+
+
+def test_detect_regressions_flags_2x_and_passes_noise():
+    history = [
+        {"bench": "b", "backend": "jax", "platform": "cpu",
+         "preset": None, "wall_s": w} for w in (1.0, 1.05, 0.95)
+    ]
+    base = {"bench": "b", "backend": "jax", "platform": "cpu",
+            "preset": None, "detail": {"route": "fanout:vm"}}
+    profile_records = [{
+        "route": "vm", "platform": "cpu", "ts": 1.0,
+        "roofline": {"bound": "hbm"},
+    }]
+    flagged = detect_regressions(
+        [{**base, "wall_s": 2.0}], history,
+        profile_records=profile_records,
+    )
+    assert len(flagged) == 1
+    assert flagged[0]["slowdown"] == pytest.approx(2.0)
+    assert flagged[0]["roofline_bound"] == "hbm"  # pre-attributed
+    # Within the noise band: clean.
+    assert detect_regressions([{**base, "wall_s": 1.1}], history) == []
+    # A lone prior point is not a trend.
+    assert detect_regressions([{**base, "wall_s": 2.0}], history[:1]) == []
+
+
+def test_bench_regress_script_gates(tmp_path):
+    script = _load_script("bench_regress")
+    hist_dir = tmp_path / "prof"
+    seed = tmp_path / "seed.jsonl"
+    seed.write_text("\n".join(json.dumps({
+        "bench": "b", "backend": "jax", "platform": "cpu",
+        "preset": None, "wall_s": w, "detail": {},
+    }) for w in (1.0, 1.05, 0.95)) + "\n")
+    assert script.main(["--history", str(hist_dir), "--ingest",
+                        str(seed), "--last", "0"]) == 0
+    slow = tmp_path / "slow.jsonl"
+    slow.write_text(json.dumps({
+        "bench": "b", "backend": "jax", "platform": "cpu",
+        "preset": None, "wall_s": 2.0, "detail": {},
+    }) + "\n")
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text(json.dumps({
+        "bench": "b", "backend": "jax", "platform": "cpu",
+        "preset": None, "wall_s": 1.02, "detail": {},
+    }) + "\n")
+    assert script.main(["--history", str(hist_dir), "--fresh",
+                        str(slow)]) == 1
+    assert script.main(["--history", str(hist_dir), "--fresh",
+                        str(ok)]) == 0
+    # --last N self-grading: append a slowed row, grade it vs the rest.
+    script.regress.BenchHistory(hist_dir).append({
+        "bench": "b", "backend": "jax", "platform": "cpu",
+        "preset": None, "wall_s": 3.0, "detail": {},
+    })
+    assert script.main(["--history", str(hist_dir), "--last", "1"]) == 1
+
+
+def test_suite_budget_feeds_history(tmp_path, monkeypatch, capsys):
+    script = _load_script("check_suite_budget")
+    log = tmp_path / "t1.log"
+    log.write_text("427 passed, 4 skipped in 129.87s (0:02:09)\n")
+    monkeypatch.setenv("PJ_PROFILE_DIR", str(tmp_path / "prof"))
+    assert script.main([str(log), "--budget", "150"]) == 0
+    rows = BenchHistory(tmp_path / "prof").rows()
+    assert len(rows) == 1
+    assert rows[0]["bench"] == "suite_budget"
+    assert rows[0]["wall_s"] == pytest.approx(129.87)
+    # Re-runs are new samples of the same command, never deduped away.
+    assert script.main([str(log), "--budget", "150"]) == 0
+    assert len(BenchHistory(tmp_path / "prof").rows()) == 2
+
+
+# -- route vocabulary: flight recorder <-> cost profiles ----------------------
+
+
+def test_trace_summary_by_route_joins_route_events():
+    from paralleljohnson_tpu.utils.telemetry import Tracer
+
+    ts = _load_script("trace_summary")
+    tracer = Tracer()
+    with tracer.span("fanout", batch=0, attempt=1):
+        pass
+    with tracer.span("fanout", batch=0, attempt=2):
+        pass
+    tracer.event("route", stage="fanout", batch=0, route="vm-blocked")
+    with tracer.span("bellman_ford", batch=None, attempt=1):
+        pass
+    tracer.event("route", stage="bellman_ford", route="gs")
+    with tracer.span("untagged"):
+        pass
+    table = ts.route_table(tracer.records())
+    by_route = {row[0]: row for row in table}
+    assert by_route["vm-blocked"][1] == 2  # both attempts attributed
+    assert by_route["gs"][1] == 1
+    assert "untagged" not in by_route
+
+
+def test_solver_emits_route_events(tmp_path):
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.graphs import erdos_renyi
+    from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+    from paralleljohnson_tpu.utils.telemetry import Telemetry
+
+    ts = _load_script("trace_summary")
+    tel = Telemetry()
+    cfg = SolverConfig(mesh_shape=(1,), telemetry=tel)
+    ParallelJohnsonSolver(cfg).multi_source(
+        erdos_renyi(32, 0.2, seed=1), np.arange(4)
+    )
+    table = ts.route_table(tel.tracer.records())
+    assert table, "fan-out stage spans must be route-attributable"
+    heartbeat_routes = {row[0] for row in table}
+    assert any(r for r in heartbeat_routes)
+
+
+# -- surfacing ----------------------------------------------------------------
+
+
+def test_log_stats_and_bench_detail_carry_roofline(capsys):
+    from paralleljohnson_tpu.benchmarks import _routes
+    from paralleljohnson_tpu.utils.profiling import log_stats
+
+    stats = SolverStats()
+    stats.phase_seconds["fanout"] = 0.2
+    stats.roofline = {"bound": "mxu", "why": "test"}
+    stats.analytic_cost = {"flops": 10.0, "bytes_accessed": 20.0,
+                           "transcendentals": 0.0, "captures": 1,
+                           "unavailable": []}
+    stats.predicted_s = 0.19
+    payload = log_stats(stats, label="t", stream=sys.stdout)
+    assert payload["roofline_bound"] == "mxu"
+    assert payload["analytic_cost"]["flops"] == 10.0
+
+    class Res:
+        pass
+
+    res = Res()
+    res.stats = stats
+    detail = _routes(res)
+    assert detail["roofline_bound"] == "mxu"
+    assert detail["analytic_flops"] == 10.0
+    assert detail["predicted_s"] == pytest.approx(0.19)
+
+
+def test_cli_info_prints_priced_route_table(tmp_path, capsys, monkeypatch):
+    from paralleljohnson_tpu import cli
+
+    store = ProfileStore(tmp_path)
+    for r in _synthetic_records(3):
+        store.append(r)
+    monkeypatch.delenv("PJ_PROFILE_DIR", raising=False)
+    rc = cli.main(["info", "--profile-store", str(tmp_path), "--json"])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    obs = info["cost_observatory"]
+    assert obs["records"] == 3
+    assert obs["priced_routes"][0]["route"] == "vm"
+    assert obs["priced_routes"][0]["s_per_edge_row"] > 0
+
+
+def test_device_trace_records_event_on_telemetry(tmp_path, monkeypatch):
+    import contextlib
+
+    import jax
+
+    from paralleljohnson_tpu.utils.profiling import device_trace
+    from paralleljohnson_tpu.utils.telemetry import Telemetry
+
+    monkeypatch.setattr(
+        jax.profiler, "trace",
+        lambda d: contextlib.nullcontext(),
+    )
+    tel = Telemetry()
+    with device_trace(str(tmp_path / "tr"), tel):
+        pass
+    events = [r for r in tel.tracer.records()
+              if r.get("type") == "event" and r["name"] == "device_trace"]
+    assert len(events) == 1
+    assert events[0]["attrs"]["dir"].endswith("tr")
+    # No telemetry / no dir stays a silent no-op.
+    with device_trace(None, None):
+        pass
+
+
+def test_heartbeat_carries_roofline_bound(tmp_path):
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.graphs import erdos_renyi
+    from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+    from paralleljohnson_tpu.utils.telemetry import (
+        HeartbeatReporter,
+        Telemetry,
+    )
+
+    hb = HeartbeatReporter(tmp_path / "hb.json", interval_s=3600)
+    tel = Telemetry(heartbeat=hb)
+    cfg = SolverConfig(mesh_shape=(1,),
+                       profile_store=str(tmp_path / "prof"),
+                       telemetry=tel)
+    ParallelJohnsonSolver(cfg).multi_source(
+        erdos_renyi(32, 0.2, seed=2), np.arange(4)
+    )
+    hb.write_now()
+    payload = json.loads((tmp_path / "hb.json").read_text())
+    assert payload["roofline_bound"] in ("hbm", "mxu", "host-io")
