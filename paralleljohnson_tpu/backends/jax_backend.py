@@ -560,6 +560,26 @@ def _extract_pred_kernel(dist, sources, src, dst, w, *, edge_chunk: int):
     return extract_pred(dist, sources, src, dst, w, edge_chunk=edge_chunk)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("num_nodes", "tile", "k_block")
+)
+def _fw_apsp_kernel(sources, src, dst, w, *, num_nodes: int, tile: int,
+                    k_block: int):
+    """Blocked min-plus Floyd-Warshall APSP (ops.fw, ROADMAP item 3):
+    dense adjacency padded to a tile multiple, R-Kleene closure
+    (diagonal-tile Kleene, row/column panels, min-plus trailing
+    "matmul"), then a row gather of the requested sources. O(V^3)
+    tropical MACs — the log2(V)-factor win over min-plus squaring.
+    Returns (dist[B, V], negative_cycle)."""
+    from paralleljohnson_tpu.ops import fw
+
+    a = relax.dense_adjacency(src, dst, w, num_nodes, dtype=w.dtype)
+    closed, neg = fw.fw_apsp_blocked(
+        fw.pad_dense(a, tile), tile=tile, k_block=k_block
+    )
+    return closed[sources, :num_nodes], neg
+
+
 def _minplus_impl(use_pallas: bool, interpret: bool):
     """The min-plus product impl for dense kernels: the Pallas/Mosaic tile
     kernel (SURVEY.md §7 step 6) or None (the XLA blocked fallback)."""
@@ -676,6 +696,19 @@ class JaxBackend(Backend):
             return None
         return cap.capture(
             route, jitfn, args, kwargs,
+            num_nodes=dgraph.num_nodes,
+            num_edges=dgraph.num_real_edges, batch=batch,
+        )
+
+    def _observe_analytic(self, route, cost, dgraph, batch=1):
+        """Model-priced cost record (``observe.costs.CostCapture
+        .analytic``) for the semiring routes XLA's per-op cost table
+        misprices (the blocked-FW tile model — see ``ops.fw``)."""
+        cap = self.cost_capture
+        if not cap.enabled:
+            return None
+        return cap.analytic(
+            route, cost,
             num_nodes=dgraph.num_nodes,
             num_edges=dgraph.num_real_edges, batch=batch,
         )
@@ -809,6 +842,41 @@ class JaxBackend(Backend):
         if v > self.config.dense_threshold or v == 0:
             return False
         return dgraph.num_real_edges >= self.config.dense_min_density * v * v
+
+    def _use_fw(self, dgraph: JaxDeviceGraph, batch: int) -> bool:
+        """Blocked min-plus Floyd-Warshall (ops.fw) for the squaring
+        regime of the dense family — APSP over the tropical semiring as
+        a blocked matrix multiply (ROADMAP item 3). "auto" engages when
+        (a) most rows are wanted anyway (the same 2B >= V test that
+        picks the squaring regime), (b) the graph is actually dense
+        (the ``dense_min_density`` gate the dense path uses — FW does
+        V^2-shaped work regardless of E), (c) V is within
+        ``fw_threshold``, and (d) the exact analytic MAC counters say
+        the blocked closure beats squaring — both counts are host ints
+        from the same padded scale (``relax.dense_fanout_regime`` /
+        ``ops.fw.fw_mac_count``), so the regime pick and its work
+        accounting can never drift apart. True forces (negative edges
+        are handled natively); False disables."""
+        flag = self.config.fw
+        if flag is False or getattr(self, "_fw_disabled", False):
+            return False
+        v = dgraph.num_nodes
+        if v == 0:
+            return False
+        if flag is True:
+            return True
+        if v > self.config.fw_threshold:
+            return False
+        regime, per_iter = relax.dense_fanout_regime(v, batch)
+        if regime != "squaring":
+            return False
+        if dgraph.num_real_edges < self.config.dense_min_density * v * v:
+            return False
+        from paralleljohnson_tpu.ops import fw as fw_ops
+
+        tile = fw_ops.effective_tile(v, self.config.fw_tile)
+        fw_macs = fw_ops.fw_mac_count(fw_ops.pad_tiles(v, tile), tile)
+        return fw_macs < relax.squaring_steps(v) * per_iter
 
     @staticmethod
     def _low_degree_family(dgraph: JaxDeviceGraph) -> bool:
@@ -1651,6 +1719,16 @@ class JaxBackend(Backend):
                 "mesh_shape=(n,) (or leave dia='auto' to use the 2-D "
                 "sharded sweep path on this mesh)"
             )
+        if self.config.fw is True and (
+            "edges" in mesh.axis_names or mesh.devices.size > 1
+        ):
+            # Same contract as the dense path's single-chip note, made
+            # loud for a forced flag: the FW closure holds the whole
+            # [Vp, Vp] matrix on one chip; "True forces" must fail
+            # rather than silently route a sharded sweep.
+            raise NotImplementedError(
+                "fw=True is a single-chip dense route; use mesh_shape=(1,)"
+            )
         if "edges" not in mesh.axis_names and self._use_dia(dgraph):
             # DIA stencil fan-out, tried ahead of every gather route:
             # on a lattice labeling each sweep is K contiguous [B, V]
@@ -1788,6 +1866,52 @@ class JaxBackend(Backend):
                 )
             except Exception:
                 self._gs_auto_failed(dgraph)  # re-raises when forced
+        if (
+            "edges" not in mesh.axis_names
+            and mesh.devices.size == 1
+            and self._use_fw(dgraph, int(sources.shape[0]))
+        ):
+            # Blocked min-plus Floyd-Warshall (ops.fw, ROADMAP item 3):
+            # the B=V dense route — replaces min-plus squaring wherever
+            # the exact MAC counters say the O(V^3) closure beats the
+            # O(V^3 log V) squaring. Single-chip (like the dense path);
+            # degrade-don't-crash on auto, propagate when forced.
+            try:
+                from paralleljohnson_tpu.ops import fw as fw_ops
+
+                tile = fw_ops.effective_tile(v, self.config.fw_tile)
+                vp = fw_ops.pad_tiles(v, tile)
+                dist, neg = _fw_apsp_kernel(
+                    sources, dgraph.src, dgraph.dst, dgraph.weights,
+                    num_nodes=v, tile=tile, k_block=fw_ops.FW_KBLOCK,
+                )
+                neg = bool(neg)
+                fw_route = "fw" if vp == tile else "fw-tile"
+                return KernelResult(
+                    dist=dist,
+                    negative_cycle=neg,
+                    converged=not neg,
+                    iterations=vp // tile,
+                    # Exact tropical MACs of the closure (host int) —
+                    # ~squaring/log2(V) on the same padded scale.
+                    edges_relaxed=fw_ops.fw_mac_count(vp, tile),
+                    route=fw_route,
+                    cost=self._observe_analytic(
+                        fw_route,
+                        fw_ops.fw_analytic_cost(
+                            vp, tile, jnp.dtype(self._dtype).itemsize
+                        ),
+                        dgraph, batch=int(sources.shape[0]),
+                    ),
+                )
+            except Exception:
+                self._auto_route_failed(
+                    "_fw_disabled",
+                    "blocked Floyd-Warshall route failed on this "
+                    "platform; falling back to the dense/sparse routes "
+                    "for this backend instance",
+                    forced=self.config.fw is True,
+                )
         if "edges" in mesh.axis_names:
             # 2-D ("sources", "edges") mesh: rows AND edge slices sharded.
             from paralleljohnson_tpu.parallel import sharded_fanout_2d

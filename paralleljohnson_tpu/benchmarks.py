@@ -85,6 +85,7 @@ _SIZES = {
     "rmat_apsp_pipelined": dict(scale=8, mini_scale=12,  full_scale=20,
                           sources=32,  mini_sources=64,  full_sources=128),
     "batch_small":   dict(count=32,    mini_count=512,   full_count=10000),
+    "dense_apsp_fw": dict(n=96,        mini_n=384,       full_n=2048),
     "serve_queries": dict(n=256,       mini_n=1024,      full_n=4096,
                           queries=200, mini_queries=2000, full_queries=20000),
 }
@@ -444,6 +445,57 @@ def bench_batch_small(backend: str, preset: str) -> BenchRecord:
     )
 
 
+def bench_dense_apsp_fw(backend: str, preset: str) -> BenchRecord:
+    """Config 7 (round-13 tentpole): dense full APSP via the blocked
+    min-plus Floyd-Warshall route (``ops.fw``, route ``fw``/``fw-tile``)
+    vs the min-plus squaring route on the SAME graph — the B=V workload
+    the repo is named for, exercised end to end on the MXU shape. The
+    graph's weights are small integers so every f32 path sum is exact:
+    the two routes are checked BITWISE, not allclose — a blocked
+    schedule that dropped a k-phase would be caught, not tolerated. The
+    timed row is the FW run; detail records the squaring wall, the
+    speedup, and the exact tropical-MAC ratio (~log2 V by construction,
+    both counters on the same padded scale), plus the roofline bound
+    and analytic FLOPs via the shared ``_routes`` folding — this is the
+    first bench row whose roofline must read ``mxu``."""
+    from paralleljohnson_tpu.graphs import erdos_renyi
+
+    n = _sz("dense_apsp_fw", "n", preset)
+    g = erdos_renyi(n, 0.1, seed=21)
+    rng = np.random.default_rng(22)
+    g = g.with_weights(
+        rng.integers(1, 10, g.num_real_edges).astype(np.float32)
+    )
+    fw_solver = _solver(backend, fw=True, mesh_shape=(1,))
+    fw_solver.solve(g)  # warm compile caches
+    t0 = time.perf_counter()
+    res = fw_solver.solve(g)
+    wall = time.perf_counter() - t0
+    sq_solver = _solver(backend, fw=False, dense_threshold=n,
+                        dense_min_density=0, mesh_shape=(1,))
+    sq_solver.solve(g)  # warm
+    t0 = time.perf_counter()
+    sres = sq_solver.solve(g)
+    sq_wall = time.perf_counter() - t0
+    detail = {
+        "nodes": g.num_nodes, "edges": g.num_real_edges,
+        "squaring_wall_s": round(sq_wall, 6),
+        "fw_speedup": round(sq_wall / max(wall, 1e-9), 3),
+        "squaring_edges_relaxed": sres.stats.edges_relaxed,
+        "work_ratio_sq_over_fw": round(
+            sres.stats.edges_relaxed / max(res.stats.edges_relaxed, 1), 3
+        ),
+        **_routes(res),
+    }
+    if not np.array_equal(np.asarray(res.matrix), np.asarray(sres.matrix)):
+        detail["failed"] = "blocked-FW rows != squaring rows (bitwise)"
+    return BenchRecord(
+        "dense_apsp_fw", backend, preset, wall,
+        res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
+        detail,
+    )
+
+
 def bench_serve_queries(backend: str, preset: str) -> BenchRecord:
     """Config 6 (round-11 tentpole): the query-serving layer, measured
     the way kernels are — ``queries/sec`` with p50/p99 latency in the
@@ -526,6 +578,7 @@ CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "rmat_apsp": bench_rmat_apsp,
     "rmat_apsp_pipelined": bench_rmat_apsp_pipelined,
     "batch_small": bench_batch_small,
+    "dense_apsp_fw": bench_dense_apsp_fw,
     "serve_queries": bench_serve_queries,
 }
 

@@ -74,6 +74,28 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--delta", type=float, default=None,
                    help="bucket width of the bucket route (default: "
                         "auto-tune from mean edge weight x degree)")
+    p.add_argument("--fw", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="blocked min-plus Floyd-Warshall dense-APSP route "
+                        "(R-Kleene tiles on the MXU; auto: squaring-regime "
+                        "dense graphs where the exact MAC counters beat "
+                        "min-plus squaring — ~log2(V) less work)")
+    p.add_argument("--fw-threshold", type=int, default=1 << 14,
+                   help="max V the blocked-FW dense route accepts "
+                        "(a [V, V] f32 closure is 1 GB at 2^14)")
+    p.add_argument("--fw-tile", type=int, default=512,
+                   help="FW tile edge (multiple of 128; 512 default — the "
+                        "first 128-multiple whose t/8 flop/byte trailing "
+                        "intensity clears the TPU roofline ridge)")
+    p.add_argument("--partitioned", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="condense-solve-expand partitioned APSP (exact: "
+                        "pivot partition, blocked-FW dense core, min-plus "
+                        "expansion per partition; auto: TPU full-APSP on "
+                        "sparse graphs in the FW size range)")
+    p.add_argument("--partition-parts", type=int, default=None,
+                   help="partition count of the condensed route "
+                        "(default: auto-size from V)")
     p.add_argument("--gs-block-size", type=int, default=8192,
                    help="vertices per Gauss-Seidel block")
     p.add_argument("--gs-inner-cap", type=int, default=64,
@@ -204,6 +226,11 @@ def _config(args) -> "SolverConfig":
         dia_max_offsets=args.dia_max_offsets,
         bucket=tristate[args.bucket],
         delta=args.delta,
+        fw=tristate[args.fw],
+        fw_threshold=args.fw_threshold,
+        fw_tile=args.fw_tile,
+        partitioned=tristate[args.partitioned],
+        partition_parts=args.partition_parts,
         gs_block_size=args.gs_block_size,
         gs_inner_cap=args.gs_inner_cap,
         pred_extraction=tristate[args.pred_extraction],
@@ -668,6 +695,10 @@ def main(argv: list[str] | None = None) -> int:
                 "negative_weights": bool(g.has_negative_weights),
                 "routes": {
                     "dense": bool(be._use_dense(dg)),
+                    # The B=V dense closure (blocked min-plus FW) and
+                    # the condensed partitioned route, both at the
+                    # full-APSP batch width their auto gates consider.
+                    "fw": bool(be._use_fw(dg, g.num_nodes)),
                     "dia": bool(be._use_dia(dg)),
                     "bucket": bool(be._use_bucket(dg)),
                     "gauss_seidel": bool(be._use_gs(dg)),
@@ -686,6 +717,13 @@ def main(argv: list[str] | None = None) -> int:
                 ),
                 "low_degree_family": bool(be._low_degree_family(dg)),
             }
+            from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+            info["graph"]["routes"]["partitioned"] = bool(
+                ParallelJohnsonSolver(
+                    SolverConfig(), backend=be
+                )._use_partitioned(g, np.arange(g.num_nodes))
+            )
             if _model is not None and _model.entries:
                 # Price THIS graph on every calibrated route: predicted
                 # seconds at B=1 (the SSSP shape) and at the full

@@ -116,6 +116,47 @@ class SolverConfig:
         per-visit propagation, never correctness; lower caps cut
         candidate work (CPU evidence: cap=64 examines ~2.3x Jacobi's
         candidates at road scale) at the price of more outer rounds.
+      fw: blocked min-plus Floyd-Warshall dense-APSP route (``ops.fw``,
+        route tags ``fw`` single-tile / ``fw-tile`` blocked): R-Kleene
+        tile schedule — diagonal-block Kleene closure, row/column panel
+        updates, min-plus "matmul" trailing update — serving the
+        squaring regime of the dense family (most rows wanted, 2B >= V)
+        in O(V^3) tropical MACs instead of squaring's O(V^3 log V).
+        ``"auto"``: engages when the graph is dense enough (the same
+        ``dense_min_density`` gate as the dense path), V is within
+        ``fw_threshold``, and the exact analytic MAC counters say FW
+        beats squaring (both are host ints — the regime pick and its
+        accounting share one source of truth). Single-chip like the
+        dense path (a >1-device mesh routes to the sharded sweeps;
+        ``fw=True`` on such a mesh fails loud). True forces; False
+        disables. Handles negative edges natively where forced.
+      fw_threshold: max V the FW route accepts (default 2^14 — a
+        [V, V] f32 closure is 1 GB there; beyond it the partitioned
+        condensed route is the dense-core escape hatch).
+      fw_tile: FW tile edge, a multiple of 128 (default 512: the first
+        128-multiple whose trailing-update arithmetic intensity, t/8
+        flop/byte, clears the v4-class roofline ridge — see ``ops.fw``).
+        Graphs smaller than the tile shrink it to their own 128-padded
+        size instead of padding up.
+      partitioned: condense-solve-expand partitioned APSP route
+        (``solver.partitioned``, route tag ``condensed+fw``): partition
+        the vertices around seeded pivots (the ``serve.landmarks`` pivot
+        draw), close each part's dense submatrix with blocked FW,
+        condense boundary vertices + cross edges into a dense core,
+        close the core with blocked FW on-chip, and expand back to full
+        distances with one batched min-plus fan-out per partition —
+        EXACT end to end (every shortest path decomposes into
+        within-part runs joined at boundary vertices), so large sparse
+        graphs get a dense MXU core instead of a pure gather-bound
+        sweep. ``"auto"``: on TPU only, for full-APSP-scale source sets
+        (2B >= V) on sparse graphs (below ``dense_min_density``) with
+        1024 <= V <= ``fw_threshold``; True forces (any backend — the
+        route's math is its own); False disables. Negative edges are
+        handled natively (no Johnson phases); negative cycles are
+        detected exactly (local and core closures jointly cover every
+        cycle).
+      partition_parts: partition count of the ``partitioned`` route;
+        None auto-sizes from V (~sqrt(V)/8, clamped to [2, 32]).
       pred_extraction: post-fixpoint tight-edge predecessor extraction
         (``ops.pred``): ``--predecessors`` solves run the SAME auto route
         as plain solves (vm-blocked / gs / dia / bucket / dense /
@@ -211,6 +252,11 @@ class SolverConfig:
     gauss_seidel: bool | str = "auto"
     gs_block_size: int = 8192
     gs_inner_cap: int = 64
+    fw: bool | str = "auto"
+    fw_threshold: int = 1 << 14
+    fw_tile: int = 512
+    partitioned: bool | str = "auto"
+    partition_parts: int | None = None
     pred_extraction: bool | str = "auto"
     edge_shard: bool | str = "auto"
     checkpoint_dir: str | None = None
@@ -262,12 +308,37 @@ class SolverConfig:
             raise ValueError(
                 f"delta must be > 0 (or None = auto), got {self.delta!r}"
             )
-        # The B=1 relaxation routes are mutually exclusive; forcing two
+        if self.fw not in (True, False, "auto"):
+            raise ValueError(
+                f"fw must be True/False/'auto', got {self.fw!r}"
+            )
+        if self.fw_threshold < 0:
+            raise ValueError(
+                f"fw_threshold must be >= 0, got {self.fw_threshold}"
+            )
+        if self.fw_tile < 128 or self.fw_tile % 128:
+            raise ValueError(
+                "fw_tile must be a multiple of 128 (the TPU lane width), "
+                f"got {self.fw_tile}"
+            )
+        if self.partitioned not in (True, False, "auto"):
+            raise ValueError(
+                f"partitioned must be True/False/'auto', got "
+                f"{self.partitioned!r}"
+            )
+        if self.partition_parts is not None and self.partition_parts < 1:
+            raise ValueError(
+                "partition_parts must be >= 1 (or None = auto), got "
+                f"{self.partition_parts}"
+            )
+        # The forced kernel routes are mutually exclusive; forcing two
         # at once used to resolve silently by dispatch order (ADVICE
         # round 5) — reject it here so "True forces" can never lie.
+        # fw joins the list: a forced dia/gs fan-out and a forced FW
+        # closure claim the same dispatch slot.
         forced = [
             name
-            for name in ("frontier", "gauss_seidel", "dia", "bucket")
+            for name in ("frontier", "gauss_seidel", "dia", "bucket", "fw")
             if getattr(self, name) is True
         ]
         if len(forced) > 1:

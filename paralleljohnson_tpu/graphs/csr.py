@@ -169,11 +169,30 @@ class CSRGraph:
             shape=(self.num_nodes, self.num_nodes),
         )
 
-    def to_dense(self, fill: float = np.inf) -> np.ndarray:
+    def to_dense(
+        self, fill: float = np.inf, *, pad_to: int | None = None
+    ) -> np.ndarray:
         """Dense adjacency with ``fill`` for absent edges and 0 diagonal kept
-        only if a self-loop exists (absent self-edges stay ``fill``)."""
-        out = np.full((self.num_nodes, self.num_nodes), fill, dtype=self.dtype)
-        out[self.src, self.indices] = self.weights
+        only if a self-loop exists (absent self-edges stay ``fill``).
+
+        ``pad_to``: pad V up to a multiple of ``pad_to`` (the FW tile
+        bucketing — one static shape bucket per tile multiple instead of
+        a recompile per odd V): padded rows/columns are ``fill`` except
+        the padded diagonal entries, which are 0 (a pad vertex is an
+        isolated no-op at distance 0 from itself, so min-plus kernels
+        need no masks); every real entry — including the real diagonal —
+        is preserved exactly, so ``out[:V, :V]`` round-trips to the
+        unpadded matrix. Only real edges are written: a ``pad_edges``
+        tail (+inf no-op COO slots at (0, 0)) must not clobber a real
+        (0, 0) edge."""
+        v = self.num_nodes
+        vp = v if not pad_to else pad_to * max(1, -(-v // pad_to))
+        out = np.full((vp, vp), fill, dtype=self.dtype)
+        e = self.num_real_edges
+        out[self.src[:e], self.indices[:e]] = self.weights[:e]
+        if vp > v:
+            pad_idx = np.arange(v, vp)
+            out[pad_idx, pad_idx] = 0.0
         return out
 
     def with_weights(self, weights: np.ndarray) -> "CSRGraph":

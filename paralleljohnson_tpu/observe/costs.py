@@ -184,6 +184,43 @@ class CostCapture:
             self._cache[key] = rec
         return rec
 
+    def analytic(
+        self,
+        route: str,
+        cost: dict,
+        *,
+        num_nodes: int,
+        num_edges: int,
+        batch: int = 1,
+    ) -> dict | None:
+        """Model-priced cost record for a route whose semiring math XLA
+        cannot price representatively (the blocked min-plus FW routes:
+        XLA's per-op table charges a tropical product's broadcast
+        intermediate as if every candidate hit HBM, which misstates the
+        fused kernel's actual tile traffic — ``ops.fw.fw_analytic_cost``
+        is the honest price). ``cost`` supplies ``flops`` /
+        ``bytes_accessed`` (+ optional ``transcendentals``); the record
+        carries ``cost_source: "analytic-model"`` so consumers can
+        always tell XLA-priced from model-priced numbers, while the
+        values land in the same keys the roofline reads."""
+        if not self.enabled:
+            return None
+        platform = self._platform()
+        bucket = shape_bucket(num_nodes, num_edges, batch)
+        key = (route, platform, bucket)
+        with self._lock:
+            cached = self._cache.get(key)
+            if cached is not None:
+                return cached
+        rec = self._base(route, platform, bucket, num_nodes, num_edges, batch)
+        rec["flops"] = float(cost.get("flops", 0.0))
+        rec["bytes_accessed"] = float(cost.get("bytes_accessed", 0.0))
+        rec["transcendentals"] = float(cost.get("transcendentals", 0.0))
+        rec["cost_source"] = "analytic-model"
+        with self._lock:
+            self._cache[key] = rec
+        return rec
+
     def unavailable(
         self,
         route: str,

@@ -1,0 +1,194 @@
+"""Tiled blocked Floyd-Warshall over the min-plus semiring (ROADMAP
+item 3 tentpole; PAPERS.md arXiv:2310.03983 "Floyd-Warshall
+Re-implemented Using 3D-Tensors and Hardware Acceleration" +
+arXiv:2601.19907 "RAPID-Graph: Recursive All-Pairs Shortest Paths").
+
+APSP over the tropical semiring IS a blocked matrix multiply — the one
+workload shape the MXU was built for, and the O(V^3) escape from the
+O(V^3 log V) min-plus squaring the dense route has paid so far. The
+kernel runs the R-Kleene block schedule: for each diagonal block k,
+
+  1. Kleene closure of the diagonal tile  D[k,k] <- D[k,k]*
+     (in-tile Floyd-Warshall: ``tile`` rank-1 min-plus steps),
+  2. row/column panel updates through the closed diagonal
+     D[k,:] <- min(D[k,:], D[k,k] (x) D[k,:]),
+     D[:,k] <- min(D[:,k], D[:,k] (x) D[k,k]),
+  3. trailing min-plus "matmul"
+     D[i,:] <- min(D[i,:], D[i,k] (x) D[k,:])  for every row block i
+
+(phase-3 over ALL i/j including k is idempotent because the closed
+diagonal satisfies D[k,k] (x) D[k,k] = D[k,k] — no masking needed).
+After block k the standard invariant holds: every entry reflects the
+shortest path whose intermediates lie in blocks 0..k, so nb steps give
+the exact closure. Negative edges are handled natively (no Johnson
+reweighting needed); a negative diagonal entry after closure certifies
+a negative cycle.
+
+Tiles are 128-aligned for the TPU lane width; the default ``FW_TILE``
+of 512 is chosen by the roofline, not the lane: each trailing tile op
+does 2.t^3 tropical flops against 4 [t, t] tile transfers (read A, B,
+C; write C) = 16.t^2 bytes -> arithmetic intensity t/8 flop/byte. At
+t = 128 that is 16 (below the v4-class ridge of ~58 flop/byte ->
+HBM-bound); at t = 512 it is 64 — the first 128-multiple landing the
+kernel compute-bound on the MXU (``fw_analytic_cost`` prices exactly
+this model; ``observe.roofline`` classifies it). Graphs smaller than
+the tile shrink it to their own 128-padded size (``effective_tile``)
+so tiny solves do not pay a 512-wide pad.
+
+Work accounting: the tropical-MAC count is STATIC — diag nb.t^3 + row
+and column panels 2.nb.t^2.Vp + trailing nb.t.Vp^2 = Vp.(Vp + t)^2
+exactly (``fw_mac_count``, an overflow-free host Python int — the same
+exactness standard as ``_gs_examined_exact``). The squaring route it
+replaces pays ``squaring_steps(V)`` ~ log2 V products of the same V^3
+scale, so FW work ~ squaring / log2 V (asserted in tests).
+
+All functions are pure and jit-safe; ``fw_closure`` is the shared
+jitted entry used by the jax backend's ``fw``/``fw-tile`` routes and
+the condensed partitioned solver (``solver.partitioned``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import jit, lax
+
+from paralleljohnson_tpu.ops import relax
+
+# Default tile edge: the smallest 128-multiple whose trailing-update
+# arithmetic intensity (t/8 flop/byte) clears the v4-class roofline
+# ridge (~58 flop/byte) — see module docstring.
+FW_TILE = 512
+
+# k-blocking of the panel/trailing min-plus products (relax.minplus):
+# bounds the broadcast intermediate to [.., t, FW_KBLOCK, Vp].
+FW_KBLOCK = 32
+
+
+def pad_tiles(v: int, tile: int) -> int:
+    """V padded up to a whole number of tiles (>= one tile)."""
+    return tile * max(1, -(-int(v) // tile))
+
+
+def effective_tile(v: int, tile: int = FW_TILE) -> int:
+    """The 128-aligned tile actually used for a V-vertex solve: graphs
+    smaller than ``tile`` shrink it to their own 128-padded size (one
+    tile, no 512-wide pad for a 200-vertex graph); larger graphs use
+    ``tile`` and pad V up to a tile multiple — one static shape bucket
+    per tile multiple instead of a recompile per odd V."""
+    vp128 = 128 * max(1, -(-int(v) // 128))
+    return min(int(tile), vp128)
+
+
+def pad_dense(a, tile: int):
+    """Pad a dense adjacency [V, V] (0 diagonal, +inf non-edges) to
+    [Vp, Vp], Vp a ``tile`` multiple: +inf fill, 0 on the padded
+    diagonal — pad vertices are isolated no-ops, so the closure of the
+    padded matrix restricted to [:V, :V] is the closure of the input."""
+    v = a.shape[0]
+    vp = pad_tiles(v, tile)
+    if vp == v:
+        return a
+    a = jnp.pad(a, ((0, vp - v), (0, vp - v)), constant_values=jnp.inf)
+    idx = jnp.arange(v, vp)
+    return a.at[idx, idx].set(0.0)
+
+
+def tile_kleene(d):
+    """Kleene closure of one [t, t] tile: t rank-1 min-plus steps
+    (in-tile Floyd-Warshall). Negative edges allowed; a negative
+    diagonal after closure means a negative cycle inside the tile."""
+    t = d.shape[0]
+
+    def body(i, m):
+        row = lax.dynamic_slice(m, (i, 0), (1, t))   # [1, t]
+        col = lax.dynamic_slice(m, (0, i), (t, 1))   # [t, 1]
+        return jnp.minimum(m, col + row)
+
+    return lax.fori_loop(0, t, body, d)
+
+
+def fw_apsp_blocked(a, *, tile: int = FW_TILE, k_block: int = FW_KBLOCK):
+    """Blocked Floyd-Warshall closure of ``a`` [Vp, Vp] (Vp a ``tile``
+    multiple; 0 diagonal, +inf non-edges, negative edges allowed).
+
+    Returns ``(closed [Vp, Vp], negative_cycle bool scalar)`` — the
+    exact min-plus closure, or (when the flag is set) distances that
+    are undefined because a negative cycle exists.
+    """
+    vp = a.shape[0]
+    if vp % tile:
+        raise ValueError(
+            f"fw_apsp_blocked: V={vp} is not a multiple of tile={tile}; "
+            "pad with pad_dense/pad_tiles first"
+        )
+    nb = vp // tile
+
+    if nb == 1:
+        d = tile_kleene(a)
+        return d, jnp.any(jnp.diagonal(d) < 0)
+
+    def kstep(k, d):
+        k0 = k * tile
+        diag = tile_kleene(lax.dynamic_slice(d, (k0, k0), (tile, tile)))
+        # Row panel through the closed diagonal. The panel's own diag
+        # columns come out as min(unclosed, diag (x) diag) = diag — the
+        # closure only ever lowers entries, so no separate diag write.
+        row = lax.dynamic_slice(d, (k0, 0), (tile, vp))
+        row = jnp.minimum(row, relax.minplus(diag, row, k_block=k_block))
+        d = lax.dynamic_update_slice(d, row, (k0, 0))
+        col = lax.dynamic_slice(d, (0, k0), (vp, tile))
+        col = jnp.minimum(col, relax.minplus(col, diag, k_block=k_block))
+        d = lax.dynamic_update_slice(d, col, (0, k0))
+
+        # Trailing update, one row block at a time: the [t, kb, Vp]
+        # broadcast intermediate of the min-plus product stays bounded
+        # while every (i, j, k) tile triple still runs — including
+        # i == k / j == k, where it is idempotent (closed diagonal).
+        def trail(i, d):
+            i0 = i * tile
+            ci = lax.dynamic_slice(col, (i0, 0), (tile, tile))
+            di = lax.dynamic_slice(d, (i0, 0), (tile, vp))
+            di = jnp.minimum(di, relax.minplus(ci, row, k_block=k_block))
+            return lax.dynamic_update_slice(d, di, (i0, 0))
+
+        return lax.fori_loop(0, nb, trail, d)
+
+    d = lax.fori_loop(0, nb, kstep, a)
+    return d, jnp.any(jnp.diagonal(d) < 0)
+
+
+@functools.partial(jit, static_argnames=("tile", "k_block"))
+def fw_closure(a, *, tile: int, k_block: int = FW_KBLOCK):
+    """Jitted :func:`fw_apsp_blocked` — the shared entry of the jax
+    backend's ``fw``/``fw-tile`` routes and ``solver.partitioned``."""
+    return fw_apsp_blocked(a, tile=tile, k_block=k_block)
+
+
+def fw_mac_count(v_pad: int, tile: int) -> int:
+    """Exact tropical MACs of one blocked closure at padded size
+    ``v_pad`` (host Python int, overflow-free): diag nb.t^3 + panels
+    2.nb.t^2.Vp + trailing nb.t.Vp^2 = Vp.(Vp + t)^2."""
+    vp, t = int(v_pad), int(tile)
+    if vp % t:
+        raise ValueError(f"v_pad={vp} not a multiple of tile={t}")
+    return vp * (vp + t) * (vp + t)
+
+
+def fw_analytic_cost(v_pad: int, tile: int, itemsize: int = 4) -> dict:
+    """Analytic roofline pricing of one blocked closure — the
+    tile-triple model of the module docstring: 2 flops per tropical MAC
+    (one add + one min), 4 [t, t] tile transfers per t^3-MAC tile op
+    (read A/B/C, write C) -> bytes = 4.itemsize.MACs / t, intensity =
+    tile/(2.itemsize) flop/byte. Used for the route's cost record
+    (``observe.costs.CostCapture.analytic``): XLA's per-op cost table
+    prices the broadcast intermediates of a semiring product as if
+    every candidate hit HBM, which misstates the fused kernel's actual
+    traffic — the tile model is the honest price of the algorithm."""
+    macs = fw_mac_count(v_pad, tile)
+    return {
+        "flops": 2.0 * macs,
+        "bytes_accessed": 4.0 * itemsize * macs / tile,
+        "transcendentals": 0.0,
+    }

@@ -687,6 +687,29 @@ def minplus(d, a, *, k_block: int = 128):
     return out
 
 
+def minplus_padded_k(k: int, k_block: int = 128) -> int:
+    """The K dimension :func:`minplus` actually iterates after its
+    internal padding (K rounded up to a ``min(k_block, K)`` multiple) —
+    the exact per-product tropical-MAC scale. Shared by the dense work
+    accounting (:func:`dense_fanout_regime`) and the blocked-FW
+    counters (``ops.fw.fw_mac_count``) so the two report candidate
+    min-plus operations on the same padded scale and the FW-vs-squaring
+    work ratio is an honest counter comparison, not apples-to-oranges
+    (padded vs unpadded)."""
+    kb = min(k_block, max(int(k), 1))
+    return kb * -(-int(k) // kb)
+
+
+def squaring_steps(v: int) -> int:
+    """Squarings :func:`apsp_minplus_squaring` performs for a V-vertex
+    closure — ceil(log2 V), floored at 1. Single source of truth for
+    the kernel's scan length AND the work accounting (steps x the
+    per-product MACs from :func:`dense_fanout_regime`)."""
+    import math
+
+    return max(1, math.ceil(math.log2(max(int(v), 2))))
+
+
 def apsp_minplus_squaring(a, *, k_block: int = 128, mp=None):
     """Full APSP of a dense adjacency by repeated min-plus squaring:
     D <- D (x) D doubles the path length covered, so ceil(log2 V) squarings
@@ -694,13 +717,15 @@ def apsp_minplus_squaring(a, *, k_block: int = 128, mp=None):
 
     ``mp``: the min-plus product impl — defaults to the XLA ``minplus``;
     the jax backend passes the Pallas kernel here on TPU.
-    Returns (dist[V, V], squarings).
+    Returns (dist[V, V], squarings). Exact work accounting is
+    ``squaring_steps(v) x dense_fanout_regime(v, v)[1]`` tropical MACs;
+    the blocked Floyd-Warshall route (``ops.fw``) does the same closure
+    in ~1/log2(V) of that work and replaces this kernel wherever its
+    counters win (``JaxBackend._use_fw``).
     """
-    import math
-
     mp = mp or functools.partial(minplus, k_block=k_block)
     v = a.shape[0]
-    steps = max(1, math.ceil(math.log2(max(v, 2))))
+    steps = squaring_steps(v)
 
     def body(d, _):
         return mp(d, d), None
@@ -747,12 +772,17 @@ def dense_fanout(a, sources, *, max_iter: int, k_block: int = 128, mp=None):
     return lax.while_loop(cond, body, (d0, jnp.int32(0), jnp.bool_(True)))
 
 
-def dense_fanout_regime(v: int, b: int) -> tuple[str, int]:
+def dense_fanout_regime(v: int, b: int, *, k_block: int = 128) -> tuple[str, int]:
     """(regime, work_per_iter) for :func:`dense_fanout` at static shapes
-    (V, B): ``("squaring", V^3)`` when most rows are wanted anyway
-    (2B >= V), else ``("iterate", B*V^2)`` — candidate min-plus ops per
-    reported iteration. Single source of truth for the regime pick AND
-    its work accounting (they must never drift apart)."""
+    (V, B): ``("squaring", V*Kp*V)`` when most rows are wanted anyway
+    (2B >= V), else ``("iterate", B*Kp*V)`` — candidate min-plus ops per
+    reported iteration, with Kp the K dimension AFTER ``minplus``'s
+    internal padding (:func:`minplus_padded_k`): the padded no-op
+    candidates are performed, so they are counted — the same padded
+    scale the blocked-FW counters (``ops.fw.fw_mac_count``) report.
+    Single source of truth for the regime pick AND its work accounting
+    (they must never drift apart)."""
+    kp = minplus_padded_k(v, k_block)
     if 2 * b >= v:
-        return "squaring", v * v * v
-    return "iterate", b * v * v
+        return "squaring", v * kp * v
+    return "iterate", b * kp * v

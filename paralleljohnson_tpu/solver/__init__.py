@@ -17,3 +17,6 @@ __all__ = [
     "SolveResult",
     "ValidationError",
 ]
+
+# solver.partitioned (condense-solve-expand condensed+fw route) is
+# imported lazily at its dispatch site — it builds device arrays.

@@ -212,6 +212,12 @@ class ParallelJohnsonSolver:
         tel.progress(op="solve", sources_total=len(sources))
         with tel.span("solve", op="solve", n_sources=len(sources),
                       predecessors=predecessors):
+            if self._use_partitioned(graph, sources):
+                res = self._try_condensed(
+                    graph, sources, stats, predecessors, tel
+                )
+                if res is not None:
+                    return res
             with phase_timer(stats, "upload", tel):
                 dgraph = self.backend.upload(graph)
 
@@ -442,6 +448,130 @@ class ParallelJohnsonSolver:
         return out
 
     # -- internals ----------------------------------------------------------
+
+    def _use_partitioned(self, graph: CSRGraph, sources: np.ndarray) -> bool:
+        """Condense-solve-expand route qualification
+        (``solver.partitioned``, route tag ``condensed+fw``). True
+        forces (the route's math is backend-independent jnp + numpy);
+        "auto" mirrors the TPU-gated auto routes: full-APSP-scale source
+        sets (2B >= V) on sparse graphs (below the dense density gate —
+        dense graphs take the plain fw route) in the blocked-FW size
+        range, on TPU only — that is where the dense core replaces a
+        gather-bound sweep with MXU work."""
+        flag = getattr(self.config, "partitioned", False)
+        if flag is False or getattr(self, "_partitioned_disabled", False):
+            return False
+        if flag is True:
+            return True
+        if self.config.backend != "jax":
+            return False
+        import jax
+
+        v = graph.num_nodes
+        return (
+            jax.default_backend() == "tpu"
+            and 1024 <= v <= self.config.fw_threshold
+            and 2 * len(sources) >= v
+            and graph.num_real_edges
+            < self.config.dense_min_density * v * v
+        )
+
+    def _try_condensed(
+        self, graph: CSRGraph, sources: np.ndarray, stats: SolverStats,
+        predecessors: bool, tel,
+    ) -> SolveResult | None:
+        """One condensed solve attempt. Returns None to hand the solve
+        back to the standard route (auto-route failure, or the pred tree
+        check rejected the one-pass extraction) — degrade-don't-crash,
+        exactly like the backend's auto kernel routes; a forced
+        ``partitioned=True`` propagates errors instead."""
+        from paralleljohnson_tpu.backends.base import KernelResult
+        from paralleljohnson_tpu.solver.partitioned import solve_condensed
+
+        forced = self.config.partitioned is True
+        try:
+            with phase_timer(stats, "fanout", tel):
+                dist, pred, info = solve_condensed(
+                    graph, sources, config=self.config,
+                    predecessors=predecessors,
+                )
+        except NegativeCycleError:
+            raise
+        except Exception:
+            if forced:
+                raise
+            if not getattr(self, "_partitioned_disabled", False):
+                self._partitioned_disabled = True
+                import sys
+                import traceback
+                import warnings
+
+                warnings.warn(
+                    "condensed partitioned route failed; falling back to "
+                    "the standard solve path for this solver instance",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                traceback.print_exc(file=sys.stderr)
+            return None
+        if predecessors and pred is None:
+            # Zero-weight tight cycle defeated the one-pass extraction:
+            # the standard route owns the legacy-sweep fallback chain.
+            import warnings
+
+            warnings.warn(
+                "condensed route could not extract predecessor trees "
+                "(tree check rejected the one-pass rule); re-solving "
+                "through the standard route",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return None
+        cost = None
+        capture = getattr(self.backend, "cost_capture", None)
+        if capture is not None and capture.enabled:
+            from paralleljohnson_tpu.ops import fw as fw_ops
+
+            # Analytic pricing of the dominant dense closures (the same
+            # tile-triple model the fw route records — ops.fw): flops
+            # from the exact MAC total, bytes from the model's
+            # bytes-per-MAC at the configured tile.
+            tile = fw_ops.effective_tile(
+                max(info["core_size"], 1), self.config.fw_tile
+            )
+            per_mac_bytes = 4.0 * np.dtype(graph.dtype).itemsize / tile
+            cost = capture.analytic(
+                info["route"],
+                {"flops": 2.0 * info["macs"],
+                 "bytes_accessed": per_mac_bytes * info["macs"]},
+                num_nodes=graph.num_nodes,
+                num_edges=graph.num_real_edges, batch=len(sources),
+            )
+        stats.accumulate(
+            KernelResult(
+                dist=dist,
+                converged=True,
+                iterations=info["k_steps"],
+                edges_relaxed=info["macs"],
+                route=info["route"],
+                cost=cost,
+            ),
+            phase="fanout",
+        )
+        tel.event("route", stage="fanout", route=info["route"])
+        result = SolveResult(
+            dist=dist,
+            sources=sources,
+            potentials=np.zeros(graph.num_nodes, graph.dtype),
+            stats=stats,
+            predecessors=pred,
+        )
+        if self.config.validate:
+            self._validate(graph, result)
+        self._finish_observability(
+            stats, graph, len(sources), label="solve"
+        )
+        return result
 
     def _finish_observability(
         self, stats: SolverStats, graph: CSRGraph, batch: int, *,

@@ -1,0 +1,353 @@
+"""Condense-solve-expand partitioned APSP (ROADMAP item 3, second half;
+PAPERS.md arXiv:2601.19907 "RAPID-Graph: Recursive All-Pairs Shortest
+Paths" — the blocked Floyd-Warshall as the *combine* stage of a
+partitioned solver).
+
+Large sparse graphs have paid for APSP as B independent gather-bound
+relaxation sweeps. This route buys them a dense MXU core instead:
+
+  1. **Partition** the vertices around k seeded pivots (the same
+     deterministic draw ``serve.landmarks`` uses), assigning each vertex
+     to its hop-nearest pivot over the undirected structure (partition
+     quality only moves work between stages — correctness never depends
+     on it; stranded vertices are assigned round-robin).
+  2. **Close each part locally**: blocked FW (``ops.fw``) on the part's
+     dense submatrix — exact all-pairs distances USING ONLY that part's
+     vertices.
+  3. **Condense**: boundary vertices (endpoints of cross-part edges)
+     form the core. Core seed entries = each part's local
+     boundary-to-boundary closures, min'd with the raw cross edges.
+     Blocked FW on the dense core then yields EXACT boundary-to-boundary
+     distances in the full graph.
+  4. **Expand**, one batched min-plus fan-out per partition: for sources
+     S in part P, ``s2core = local_P[S, dP] (x) core[dP, :]`` gives the
+     exact distance from every source to every core vertex, and the rows
+     for targets in part Q are ``min(local_P[S, Q] if Q == P,
+     s2core[:, dQ] (x) local_Q[dQ, Q])``.
+
+**Why this is exact, not an approximation**: any shortest path
+decomposes into maximal within-part runs joined by cross edges. Each
+run's endpoints are boundary vertices (or the path's own endpoints),
+and each run stays inside one part — so step 2 prices every run, step 3
+prices every boundary-to-boundary middle section (its FW considers all
+alternations of local runs and cross edges), and step 4's two min-plus
+hops enumerate every (first exit, last entry) pair. Distances are
+bitwise-reproducible against a plain solve whenever the weight set is
+exactly representable (integer weights in tests); with general f32
+weights the route agrees to ULP-level reassociation like any two dense
+kernels.
+
+Negative edges need no Johnson phases here (FW is sign-agnostic), and
+negative-cycle detection is complete: a cycle inside one part turns a
+local closure's diagonal negative; a cycle crossing parts turns the
+core closure's diagonal negative.
+
+Work accounting: exact tropical MACs, host ints — the sum of each
+closure's ``fw_mac_count`` plus the expansion products' padded MAC
+counts (``relax.minplus_padded_k``), on the same scale as every dense
+counter.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from paralleljohnson_tpu.graphs import CSRGraph
+from paralleljohnson_tpu.ops import relax
+
+ROUTE_TAG = "condensed+fw"
+
+# Expansion min-plus k-blocking (relax.minplus): bounds the broadcast
+# intermediate of the per-part products.
+_EXPAND_KBLOCK = 128
+
+
+def auto_num_parts(v: int) -> int:
+    """Default partition count: ~sqrt(V)/8 clamped to [2, 32] — parts of
+    ~8.sqrt(V) vertices keep the local dense closures comfortably under
+    the core's cost while the boundary core stays dense enough to be an
+    MXU workload. Any value is correct; this only shapes the work
+    split."""
+    return max(2, min(32, int(math.isqrt(max(v, 4))) // 8 or 2))
+
+
+def partition_by_pivots(
+    graph: CSRGraph, num_parts: int, *, seed: int = 0
+) -> np.ndarray:
+    """int64[V] part label per vertex: k pivots drawn with the
+    ``serve.landmarks`` seeded-uniform idiom, then hop-layered BFS over
+    the UNDIRECTED structure (direction matters for distances, not for
+    "which part should own this vertex"). Ties break to the smallest
+    pivot label (deterministic). Vertices unreachable from every pivot
+    are assigned round-robin — correctness is label-independent."""
+    v = graph.num_nodes
+    k = max(1, min(int(num_parts), max(v, 1)))
+    rng = np.random.default_rng(seed)
+    pivots = np.sort(rng.choice(v, size=k, replace=False))
+    labels = np.full(v, -1, np.int64)
+    labels[pivots] = np.arange(k)
+    e = graph.num_real_edges
+    # Both directions once: the frontier relaxes over undirected hops.
+    us = np.concatenate([graph.src[:e], graph.indices[:e]])
+    vs = np.concatenate([graph.indices[:e], graph.src[:e]])
+    while True:
+        cand = np.full(v, np.iinfo(np.int64).max, np.int64)
+        live = labels[us] >= 0
+        np.minimum.at(cand, vs[live], labels[us[live]])
+        fresh = (labels < 0) & (cand < np.iinfo(np.int64).max)
+        if not fresh.any():
+            break
+        labels[fresh] = cand[fresh]
+    left = np.flatnonzero(labels < 0)
+    if left.size:
+        labels[left] = np.arange(left.size) % k
+    return labels
+
+
+def _fw_closed(a_np: np.ndarray, tile_cfg: int):
+    """Blocked-FW closure of one dense block (host in, host out).
+    Returns (closed float32/64 [n, n], negative_cycle bool, macs int,
+    k_steps int). Zero-sized blocks short-circuit."""
+    import jax.numpy as jnp
+
+    from paralleljohnson_tpu.ops import fw
+
+    n = a_np.shape[0]
+    if n == 0:
+        return a_np, False, 0, 0
+    tile = fw.effective_tile(n, tile_cfg)
+    vp = fw.pad_tiles(n, tile)
+    closed, neg = fw.fw_closure(
+        fw.pad_dense(jnp.asarray(a_np), tile), tile=tile
+    )
+    return (
+        np.asarray(closed[:n, :n]),
+        bool(neg),
+        fw.fw_mac_count(vp, tile),
+        vp // tile,
+    )
+
+
+def _mp_jit():
+    import functools
+
+    import jax
+
+    fn = getattr(_mp_jit, "_fn", None)
+    if fn is None:
+        fn = jax.jit(
+            functools.partial(relax.minplus, k_block=_EXPAND_KBLOCK)
+        )
+        _mp_jit._fn = fn
+    return fn
+
+
+def _pad128(n: int) -> int:
+    return 128 * max(1, -(-n // 128))
+
+
+def _mp(d, a):
+    """One expansion min-plus product ([B, K] (x) [K, N]) on device
+    (jitted relax.minplus, k-blocked broadcast), materialized host-side
+    — expansion blocks are assembled into the [B, V] numpy result. All
+    three dims are padded to 128 multiples with +inf no-ops before the
+    jitted call, so arbitrary part sizes share a handful of compiled
+    shape buckets instead of recompiling per (part, part) pair."""
+    import jax.numpy as jnp
+
+    b, k = d.shape
+    n = a.shape[1]
+    bp, kp, np_ = _pad128(b), _pad128(k), _pad128(n)
+    dp = np.full((bp, kp), np.inf, d.dtype)
+    dp[:b, :k] = d
+    ap = np.full((kp, np_), np.inf, a.dtype)
+    ap[:k, :n] = a
+    out = _mp_jit()(jnp.asarray(dp), jnp.asarray(ap))
+    return np.asarray(out[:b, :n])
+
+
+def _mp_macs(b: int, k: int, n: int) -> int:
+    """Exact candidate ops of one padded expansion product — all three
+    dims ride the 128 bucketing of :func:`_mp`, and the pad no-ops are
+    performed, so they are counted (the dense accounting convention)."""
+    return _pad128(b) * _pad128(k) * _pad128(n)
+
+
+def _dense_block(graph, verts, lid, part_mask_src, src, dst, w):
+    """Dense [n, n] submatrix of ``verts`` (0 diagonal, +inf non-edges,
+    parallel edges resolved to the min) from the within-part edges."""
+    n = verts.size
+    a = np.full((n, n), np.inf, dtype=graph.dtype)
+    np.fill_diagonal(a, 0.0)
+    sel = np.flatnonzero(part_mask_src)
+    if sel.size:
+        np.minimum.at(a, (lid[src[sel]], lid[dst[sel]]), w[sel])
+    return a
+
+
+def solve_condensed(
+    graph: CSRGraph,
+    sources: np.ndarray | None = None,
+    *,
+    config=None,
+    predecessors: bool = False,
+    num_parts: int | None = None,
+    seed: int = 0,
+):
+    """Exact partitioned APSP (see module docstring).
+
+    Returns ``(dist [B, V] float, pred [B, V] int32 or None, info)`` —
+    ``info`` carries route tag, exact MAC totals, k-step count, part and
+    core sizes, and ``pred_ok`` (None when predecessors were not
+    requested; False when the tight-edge tree check rejected the
+    one-pass extraction — the caller falls back to the standard route).
+    Raises ``NegativeCycleError`` on any reachable negative cycle.
+    """
+    from paralleljohnson_tpu.solver.johnson import NegativeCycleError
+
+    v = graph.num_nodes
+    sources = (
+        np.arange(v, dtype=np.int64)
+        if sources is None
+        else np.asarray(sources, np.int64)
+    )
+    tile_cfg = int(getattr(config, "fw_tile", 512) or 512)
+    k = int(num_parts or getattr(config, "partition_parts", None)
+            or auto_num_parts(v))
+
+    labels = partition_by_pivots(graph, k, seed=seed)
+    part_ids = np.unique(labels)
+    parts = [np.flatnonzero(labels == p) for p in part_ids]
+
+    e = graph.num_real_edges
+    src, dst, w = graph.src[:e], graph.indices[:e], graph.weights[:e]
+    cross = labels[src] != labels[dst]
+    boundary_mask = np.zeros(v, bool)
+    boundary_mask[src[cross]] = True
+    boundary_mask[dst[cross]] = True
+    boundary = np.flatnonzero(boundary_mask)
+    core_idx = np.full(v, -1, np.int64)
+    core_idx[boundary] = np.arange(boundary.size)
+    nc = boundary.size
+
+    macs = 0
+    k_steps = 0
+    lids = np.full(v, -1, np.int64)  # local id within own part
+    locals_closed: list[np.ndarray] = []
+    blocal: list[np.ndarray] = []  # per part: local ids of boundary verts
+    bcore: list[np.ndarray] = []   # per part: core ids of those verts
+    for p, verts in zip(part_ids, parts):
+        lids[verts] = np.arange(verts.size)
+        closed, neg, m, ks = _fw_closed(
+            _dense_block(
+                graph, verts, lids,
+                (labels[src] == p) & ~cross, src, dst, w,
+            ),
+            tile_cfg,
+        )
+        if neg:
+            raise NegativeCycleError(
+                "negative-weight cycle inside a partition (condensed route)"
+            )
+        macs += m
+        k_steps += ks
+        locals_closed.append(closed)
+        bv = verts[boundary_mask[verts]]
+        blocal.append(lids[bv])
+        bcore.append(core_idx[bv])
+
+    # Condensed dense core: each part's local boundary-to-boundary
+    # closure min'd with the raw cross edges, then closed with FW —
+    # exact boundary-to-boundary distances in the FULL graph.
+    core = np.full((nc, nc), np.inf, dtype=graph.dtype)
+    if nc:
+        np.fill_diagonal(core, 0.0)
+        for closed, bl, bc in zip(locals_closed, blocal, bcore):
+            if bl.size:
+                core[np.ix_(bc, bc)] = np.minimum(
+                    core[np.ix_(bc, bc)], closed[np.ix_(bl, bl)]
+                )
+        np.minimum.at(
+            core, (core_idx[src[cross]], core_idx[dst[cross]]), w[cross]
+        )
+    core_closed, neg, m, ks = _fw_closed(core, tile_cfg)
+    if neg:
+        raise NegativeCycleError(
+            "negative-weight cycle across partitions (condensed route)"
+        )
+    macs += m
+    k_steps += ks
+
+    # Expansion: one batched min-plus fan-out per source partition.
+    dist = np.full((sources.size, v), np.inf, dtype=graph.dtype)
+    src_rows_seen: dict[int, list[int]] = {}
+    for i, s in enumerate(sources):
+        src_rows_seen.setdefault(int(s), []).append(i)
+    for pi, (p, verts) in enumerate(zip(part_ids, parts)):
+        rows = [r for s in verts for r in src_rows_seen.get(int(s), [])]
+        if not rows:
+            continue
+        rows = np.asarray(rows, np.int64)
+        ls = lids[sources[rows]]
+        local_p = locals_closed[pi]
+        dist[np.ix_(rows, verts)] = local_p[ls]
+        if nc == 0 or blocal[pi].size == 0:
+            continue  # no way out of this part: local rows are final
+        # d(s, c) for EVERY core vertex c: local to own boundary, then
+        # through the closed core. MACs counted on the padded scale.
+        s2core = _mp(local_p[np.ix_(ls, blocal[pi])], core_closed[bcore[pi]])
+        macs += _mp_macs(rows.size, blocal[pi].size, nc)
+        for qi, (q, verts_q) in enumerate(zip(part_ids, parts)):
+            if blocal[qi].size == 0:
+                continue  # no way into q from outside
+            upd = _mp(
+                s2core[:, bcore[qi]], locals_closed[qi][blocal[qi]]
+            )
+            macs += _mp_macs(rows.size, blocal[qi].size, verts_q.size)
+            dist[np.ix_(rows, verts_q)] = np.minimum(
+                dist[np.ix_(rows, verts_q)], upd
+            )
+
+    route = ROUTE_TAG
+    pred = None
+    pred_ok = None
+    if predecessors:
+        pred, pred_ok = _extract_pred(graph, dist, sources)
+        if pred_ok:
+            route = ROUTE_TAG + "+pred"
+        else:
+            pred = None
+
+    info = {
+        "route": route,
+        "macs": int(macs),
+        "k_steps": int(k_steps),
+        "num_parts": len(parts),
+        "core_size": int(nc),
+        "part_sizes": [int(p.size) for p in parts],
+        "pred_ok": pred_ok,
+    }
+    return dist, pred, info
+
+
+def _extract_pred(graph: CSRGraph, dist: np.ndarray, sources: np.ndarray):
+    """One tight-edge extraction pass (ops.pred) over the converged
+    expanded distances — the condensed route dispatches predecessors
+    exactly like every other route: same pass, same pointer-doubling
+    tree certificate, same fallback signal (ok=False) on the zero-weight
+    tight cycles no single-pass rule can resolve."""
+    import jax.numpy as jnp
+
+    from paralleljohnson_tpu.ops.pred import extract_pred
+
+    e = graph.num_real_edges
+    pred, ok = extract_pred(
+        jnp.asarray(dist),
+        jnp.asarray(sources, jnp.int32),
+        jnp.asarray(graph.src[:e], jnp.int32),
+        jnp.asarray(graph.indices[:e], jnp.int32),
+        jnp.asarray(graph.weights[:e]),
+    )
+    return np.asarray(pred), bool(ok)

@@ -154,6 +154,15 @@ class SolverStats:
             for k in ("flops", "bytes_accessed", "transcendentals"):
                 acc[k] += float(cost.get(k, 0.0))
             acc["captures"] += 1
+            # Distinct pricing sources ("analytic-model" for the
+            # semiring routes XLA misprices — observe.costs.analytic)
+            # ride along so a profile record always says HOW it was
+            # priced, not just what the numbers are.
+            src_tag = cost.get("cost_source")
+            if src_tag and src_tag not in acc.setdefault(
+                "cost_sources", []
+            ):
+                acc["cost_sources"].append(src_tag)
         mem = cost.get("memory")
         if mem and mem.get("peak_bytes"):
             acc["peak_memory_bytes"] = max(

@@ -188,6 +188,13 @@ run 900 serve-smoke python scripts/serve_smoke.py
 #     the detail column — serving performance tracked like kernels)
 run 900 jax-serve-bench python -m paralleljohnson_tpu.cli bench serve_queries --backend jax --preset full --update-baseline BASELINE.md
 
+# 4h) dense-APSP blocked-FW bench row (round-13 tentpole): blocked
+#     min-plus Floyd-Warshall vs min-plus squaring on the same graph,
+#     BITWISE-checked (integer weights); the detail column must carry
+#     roofline_bound=mxu — the first genuinely MXU-bound kernel the
+#     cost observatory records on-chip
+run 1500 jax-fw-apsp python -m paralleljohnson_tpu.cli bench dense_apsp_fw --backend jax --preset full --update-baseline BASELINE.md
+
 # 5) driver metric (should reflect the blocked kernel now)
 run 1200 bench.py python bench.py
 

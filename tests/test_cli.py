@@ -22,9 +22,13 @@ def test_info_graph_route_diagnosis(capsys):
     assert gi["nodes"] == 81 and gi["dia_qualifies"]
     assert gi["dia_offsets"] == [-9, -1, 1, 9]
     assert set(gi["routes"]) == {
-        "dense", "dia", "bucket", "gauss_seidel", "frontier", "edge_shard",
-        "pred",
+        "dense", "fw", "dia", "bucket", "gauss_seidel", "frontier",
+        "edge_shard", "pred", "partitioned",
     }
+    # The 81-vertex lattice is neither dense enough for the FW closure
+    # nor TPU-resident for the condensed auto gate.
+    assert gi["routes"]["fw"] is False
+    assert gi["routes"]["partitioned"] is False
     # --predecessors rides the same route plus one extraction pass.
     assert gi["routes"]["pred"] == "extract"
 

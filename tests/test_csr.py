@@ -78,3 +78,20 @@ def test_stack_graphs():
     assert batch["v_max"] == 3
     np.testing.assert_array_equal(batch["num_nodes"], [3, 2])
     assert batch["weights"][1, 1] == PAD_WEIGHT
+
+
+def test_to_dense_pad_to_roundtrip(tiny_graph):
+    """pad_to (the FW tile bucketing, round-13 satellite): padded
+    rows/cols are fill with a 0 diagonal on the pad block; the real
+    block round-trips exactly, including real diagonal entries."""
+    v = tiny_graph.num_nodes
+    padded = tiny_graph.to_dense(pad_to=8)
+    assert padded.shape == (8, 8)
+    np.testing.assert_array_equal(padded[:v, :v], tiny_graph.to_dense())
+    assert np.all(np.isinf(padded[v:, :v])) and np.all(np.isinf(padded[:v, v:]))
+    np.testing.assert_array_equal(np.diag(padded)[v:], 0.0)
+    # Already a multiple: no padding, same shape.
+    assert tiny_graph.to_dense(pad_to=5).shape == (5, 5)
+    # A pad_edges tail must not clobber the real (0, 0) slot.
+    g = CSRGraph.from_edges([0, 0], [0, 1], [2.0, 3.0], 2).pad_edges(8)
+    assert g.to_dense(pad_to=4)[0, 0] == 2.0
