@@ -56,6 +56,17 @@ class KernelResult:
       marker), keyed per (route, platform, shape-bucket). None when
       capture is disabled (no profile store configured) or the backend
       is not cost-instrumented; folds into ``SolverStats.analytic_cost``.
+    trajectory: decoded per-iteration convergence trajectory (ISSUE 9,
+      ``observe.convergence``): float64 ``[n, 3]`` host array with
+      columns (frontier_size, relaxations_applied, residual_mass), one
+      row per while_loop iteration. None when the convergence
+      observatory is off or the resolved route is not instrumented
+      (frontier / dense / fw / sharded / pallas routes keep their own
+      exact counters instead). Folds into ``SolverStats.trajectories``.
+    convergence: the trajectory's summary
+      (``observe.convergence.summarize_trajectory``) — iterations,
+      frontier half-life, tail fraction, JFR-skippable estimate; folds
+      into ``SolverStats.convergence``.
     """
 
     dist: Any  # np.ndarray or a device array (see docstring)
@@ -66,6 +77,8 @@ class KernelResult:
     pred: np.ndarray | None = None  # predecessor vertices, -1 = none
     route: str | None = None  # resolved kernel route (see docstring)
     cost: dict | None = None  # compiled-cost capture (see docstring)
+    trajectory: Any | None = None  # [n, 3] convergence curve (docstring)
+    convergence: dict | None = None  # trajectory summary (docstring)
 
 
 class Backend(abc.ABC):

@@ -338,6 +338,110 @@ def _bf_kernel(dist0, src, dst, w, *, max_iter: int, edge_chunk: int):
     )
 
 
+# -- convergence-observatory kernel twins (ISSUE 9, observe.convergence) -----
+#
+# Each instrumented route gets a SEPARATE jitted twin of its fixpoint
+# that carries the [traj_cap, 2] int32 + [traj_cap] f32 trajectory
+# buffers through the while_loop (zero per-iteration host syncs; one
+# D2H after convergence). Twins — not flags inside the original
+# kernels — so the disabled path dispatches the exact pre-observatory
+# executables and its jaxpr cannot drift (tests/test_trajectory.py
+# asserts this). Dispatch picks the twin via JaxBackend._traj_cap().
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_iter", "edge_chunk", "traj_cap")
+)
+def _bf_kernel_traj(
+    dist0, src, dst, w, *, max_iter: int, edge_chunk: int, traj_cap: int
+):
+    from paralleljohnson_tpu.observe.convergence import instrumented_fixpoint
+
+    return instrumented_fixpoint(
+        lambda d: relax.relax_sweep(d, src, dst, w, edge_chunk=edge_chunk),
+        dist0, max_iter=max_iter, cap=traj_cap,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_nodes", "max_iter", "edge_chunk", "traj_cap"),
+)
+def _fanout_kernel_traj(
+    sources, src, dst, w, *, num_nodes: int, max_iter: int,
+    edge_chunk: int, traj_cap: int,
+):
+    """Trajectory twin of ``_fanout_kernel`` (sweep-sm, dist [B, V])."""
+    from paralleljohnson_tpu.observe.convergence import instrumented_fixpoint
+
+    dist0 = relax.multi_source_init(sources, num_nodes, dtype=w.dtype)
+    return instrumented_fixpoint(
+        lambda d: relax.relax_sweep(d, src, dst, w, edge_chunk=edge_chunk),
+        dist0, max_iter=max_iter, cap=traj_cap, batch_axis=0,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_nodes", "max_iter", "edge_chunk", "traj_cap"),
+)
+def _fanout_vm_kernel_traj(
+    sources, src_bd, dst_bd, w_bd, *, num_nodes: int, max_iter: int,
+    edge_chunk: int, traj_cap: int,
+):
+    """Trajectory twin of ``_fanout_vm_kernel`` (dist [V, B])."""
+    from paralleljohnson_tpu.observe.convergence import instrumented_fixpoint
+
+    dist0 = relax.multi_source_init(sources, num_nodes, dtype=w_bd.dtype).T
+    dist, iters, improving, counts, resid = instrumented_fixpoint(
+        lambda d: relax.relax_sweep_vm(
+            d, src_bd, dst_bd, w_bd, edge_chunk=edge_chunk
+        ),
+        dist0, max_iter=max_iter, cap=traj_cap, batch_axis=1,
+    )
+    return dist.T, iters, improving, counts, resid
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_nodes", "v_pad", "vb", "max_iter", "traj_cap"),
+)
+def _fanout_vm_blocked_kernel_traj(
+    sources, src_ck, dstl_ck, w_ck, base_ck, *,
+    num_nodes: int, v_pad: int, vb: int, max_iter: int, traj_cap: int,
+):
+    """Trajectory twin of ``_fanout_vm_blocked_kernel`` (pad rows are
+    +inf and never improve, so the frontier counts stay exact)."""
+    from paralleljohnson_tpu.observe.convergence import instrumented_fixpoint
+
+    b = sources.shape[0]
+    dist0 = jnp.full((v_pad, b), jnp.inf, w_ck.dtype)
+    dist0 = dist0.at[sources, jnp.arange(b)].set(0.0)
+    dist, iters, improving, counts, resid = instrumented_fixpoint(
+        lambda d: relax.relax_sweep_vm_blocked(
+            d, src_ck, dstl_ck, w_ck, base_ck, vb=vb
+        ),
+        dist0, max_iter=max_iter, cap=traj_cap, batch_axis=1,
+    )
+    return dist[:num_nodes].T, iters, improving, counts, resid
+
+
+@functools.partial(
+    jax.jit, static_argnames=("offsets", "max_iter", "traj_cap")
+)
+def _dia_fixpoint_traj(dist0, w_diag, *, offsets: tuple, max_iter: int,
+                       traj_cap: int):
+    """Trajectory twin of ``ops.dia.dia_fixpoint`` ([V] or [B, V])."""
+    from paralleljohnson_tpu.observe.convergence import instrumented_fixpoint
+    from paralleljohnson_tpu.ops.dia import dia_sweep
+
+    return instrumented_fixpoint(
+        lambda d: dia_sweep(d, w_diag, offsets=offsets),
+        dist0, max_iter=max_iter, cap=traj_cap,
+        batch_axis=0 if dist0.ndim == 2 else None,
+    )
+
+
 
 @functools.partial(
     jax.jit,
@@ -359,62 +463,76 @@ def _bf_frontier_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "max_steps", "capacity", "max_degree", "num_real_edges", "edge_chunk"
+        "max_steps", "capacity", "max_degree", "num_real_edges",
+        "edge_chunk", "traj_cap",
     ),
 )
 def _bucket_kernel(
     dist0, src, dst, w, indptr, delta, *, max_steps: int, capacity: int,
     max_degree: int, num_real_edges: int, edge_chunk: int,
+    traj_cap: int | None = None,
 ):
     """Bucketed (delta-stepping-style) B=1 relaxation (ops.bucket):
     settles the lowest distance bucket with light-edge steps before its
     heavy edges relax once, so irregular high-diameter graphs whose
     labeling disqualifies DIA stop paying GS's ~340M re-examined
     candidates against the XLA row-gather floor. ``delta`` is traced
-    (one compile per graph shape, any width)."""
+    (one compile per graph shape, any width). ``traj_cap`` appends the
+    convergence-trajectory buffers (None = the uninstrumented loop —
+    the kernel python-branches, so the disabled jaxpr is unchanged)."""
     from paralleljohnson_tpu.ops.bucket import bellman_ford_bucketed
 
     return bellman_ford_bucketed(
         dist0, src, dst, w, indptr, delta, max_steps=max_steps,
         capacity=capacity, max_degree=max_degree,
         num_real_edges=num_real_edges, edge_chunk=edge_chunk,
+        traj_cap=traj_cap,
     )
-
-
-@functools.partial(
-    jax.jit, static_argnames=("vb", "halo", "max_outer", "inner_cap")
-)
-def _gs_kernel(
-    dist0, src_blk, dstl_blk, w_blk, rank, *,
-    vb: int, halo: int, max_outer: int, inner_cap: int,
-):
-    """Blocked Gauss-Seidel SSSP in relabeled ids; returns dist already
-    mapped back to ORIGINAL vertex labels."""
-    from paralleljohnson_tpu.ops.gauss_seidel import sssp_gs_blocks
-
-    dist, rounds, improving, iters_blk = sssp_gs_blocks(
-        dist0, src_blk, dstl_blk, w_blk,
-        vb=vb, halo=halo, max_outer=max_outer, inner_cap=inner_cap,
-    )
-    return dist[rank], rounds, improving, iters_blk
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("v_pad", "vb", "halo", "max_outer", "inner_cap"),
+    static_argnames=("vb", "halo", "max_outer", "inner_cap", "traj_cap"),
+)
+def _gs_kernel(
+    dist0, src_blk, dstl_blk, w_blk, rank, *,
+    vb: int, halo: int, max_outer: int, inner_cap: int,
+    traj_cap: int | None = None,
+):
+    """Blocked Gauss-Seidel SSSP in relabeled ids; returns dist already
+    mapped back to ORIGINAL vertex labels. ``traj_cap`` appends the
+    outer-round convergence-trajectory buffers (ops.gauss_seidel)."""
+    from paralleljohnson_tpu.ops.gauss_seidel import sssp_gs_blocks
+
+    out = sssp_gs_blocks(
+        dist0, src_blk, dstl_blk, w_blk,
+        vb=vb, halo=halo, max_outer=max_outer, inner_cap=inner_cap,
+        traj_cap=traj_cap,
+    )
+    dist, rounds, improving, iters_blk = out[:4]
+    return (dist[rank], rounds, improving, iters_blk, *out[4:])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "v_pad", "vb", "halo", "max_outer", "inner_cap", "traj_cap"
+    ),
 )
 def _gs_fanout_kernel(
     sources, src_blk, dstl_blk, w_blk, rank, *,
     v_pad: int, vb: int, halo: int, max_outer: int, inner_cap: int,
+    traj_cap: int | None = None,
 ):
     """Blocked Gauss-Seidel fan-out (vertex-major, relabeled ids);
-    returns dist [B, V-original-labels]."""
+    returns dist [B, V-original-labels] (+ trajectory buffers when
+    ``traj_cap`` is set)."""
     from paralleljohnson_tpu.ops.gauss_seidel import fanout_gs_body
 
     return fanout_gs_body(
         sources, src_blk, dstl_blk, w_blk, rank,
         v_pad=v_pad, vb=vb, halo=halo, max_outer=max_outer,
-        inner_cap=inner_cap,
+        inner_cap=inner_cap, traj_cap=traj_cap,
     )
 
 
@@ -724,6 +842,59 @@ class JaxBackend(Backend):
             num_nodes=dgraph.num_nodes,
             num_edges=dgraph.num_real_edges, batch=batch,
         )
+
+    def _traj_cap(self) -> int | None:
+        """Static trajectory-buffer length for this solve, or None when
+        the convergence observatory is off (ISSUE 9). ``"auto"`` enables
+        it exactly when something can consume the trajectory — a
+        telemetry sink or a profile store — so a plain solve compiles
+        the original, uninstrumented kernels (disabled-path purity).
+        True forces (tests / ad-hoc introspection); False disables."""
+        flag = getattr(self.config, "convergence", "auto")
+        if flag is False:
+            return None
+        if flag is not True and not (
+            getattr(self.config, "telemetry", None) is not None
+            or self.cost_capture.enabled
+        ):
+            return None
+        from paralleljohnson_tpu.observe.convergence import DEFAULT_TRAJ_CAP
+
+        return DEFAULT_TRAJ_CAP
+
+    def _attach_trajectory(
+        self, res: KernelResult, counts, resid, dgraph, batch: int = 1,
+        iterations: int | None = None,
+    ) -> KernelResult:
+        """Decode one kernel call's device trajectory buffers onto the
+        KernelResult (the single post-convergence D2H) and summarize.
+        Runs the shared int32 addend wrap guard first — shapes whose
+        per-iteration relaxations bound (batch x V) reaches 2^31 get a
+        warned lower bound, never a silent lie (the ops/bucket split-
+        counter standard). Never fatal: a decode failure drops the
+        trajectory, not the solve."""
+        try:
+            from paralleljohnson_tpu.observe import convergence as conv
+            from paralleljohnson_tpu.utils.metrics import (
+                warn_if_traj_counter_wrapped,
+            )
+
+            warn_if_traj_counter_wrapped(
+                batch, dgraph.num_nodes, where=res.route or "trajectory"
+            )
+            iters = res.iterations if iterations is None else iterations
+            traj = conv.decode_trajectory(counts, resid, iters)
+            res.trajectory = traj
+            res.convergence = conv.summarize_trajectory(
+                traj,
+                num_nodes=dgraph.num_nodes,
+                batch=batch,
+                num_edges=dgraph.num_real_edges,
+                iterations=iters,
+            )
+        except Exception:  # noqa: BLE001 — observability is never fatal
+            pass
+        return res
 
     @property
     def _dtype(self):
@@ -1197,13 +1368,29 @@ class JaxBackend(Backend):
                 lay = self.dia_bundle(dgraph)
                 from paralleljohnson_tpu.ops.dia import dia_fixpoint
 
-                dist, iters, improving = dia_fixpoint(
-                    dist0, lay["w_diag"],
-                    offsets=lay["offsets"], max_iter=max_iter,
-                )
+                cap = self._traj_cap()
+                traj_bufs = None
+                if cap is not None:
+                    dist, iters, improving, *traj_bufs = _dia_fixpoint_traj(
+                        dist0, lay["w_diag"],
+                        offsets=lay["offsets"], max_iter=max_iter,
+                        traj_cap=cap,
+                    )
+                    dia_fn, dia_kwargs = _dia_fixpoint_traj, dict(
+                        offsets=lay["offsets"], max_iter=max_iter,
+                        traj_cap=cap,
+                    )
+                else:
+                    dist, iters, improving = dia_fixpoint(
+                        dist0, lay["w_diag"],
+                        offsets=lay["offsets"], max_iter=max_iter,
+                    )
+                    dia_fn, dia_kwargs = dia_fixpoint, dict(
+                        offsets=lay["offsets"], max_iter=max_iter,
+                    )
                 iters = int(iters)
                 improving = bool(improving)
-                return KernelResult(
+                res = KernelResult(
                     dist=dist,
                     negative_cycle=improving and max_iter >= v,
                     converged=not improving,
@@ -1213,11 +1400,13 @@ class JaxBackend(Backend):
                     edges_relaxed=iters * lay["num_entries"],
                     route="dia",
                     cost=self._observe_cost(
-                        "dia", dia_fixpoint, (dist0, lay["w_diag"]),
-                        dict(offsets=lay["offsets"], max_iter=max_iter),
-                        dgraph,
+                        "dia", dia_fn, (dist0, lay["w_diag"]),
+                        dia_kwargs, dgraph,
                     ),
                 )
+                if traj_bufs is not None:
+                    self._attach_trajectory(res, *traj_bufs, dgraph)
+                return res
             except Exception:
                 self._auto_route_failed(
                     "_dia_disabled",
@@ -1247,15 +1436,24 @@ class JaxBackend(Backend):
                 # (valid upper bound) distances AND owns the negative-
                 # cycle certificate.
                 max_steps = 2 * max_iter + 64
-                dist_b, steps, still, ex_hi, ex_lo = _bucket_kernel(
-                    dist0, dgraph.src, dgraph.dst, dgraph.weights,
-                    dgraph.indptr_dev(),
-                    jnp.asarray(delta, self._dtype),
+                cap = self._traj_cap()
+                bucket_kwargs = dict(
                     max_steps=max_steps,
                     capacity=auto_capacity(v, dgraph.max_degree),
                     max_degree=dgraph.max_degree,
                     num_real_edges=dgraph.num_real_edges,
                     edge_chunk=chunk,
+                    traj_cap=cap,
+                )
+                # traj_cap=None compiles the exact uninstrumented loop
+                # (ops.bucket python-branches); the splat is empty then.
+                dist_b, steps, still, ex_hi, ex_lo, *traj_bufs = (
+                    _bucket_kernel(
+                        dist0, dgraph.src, dgraph.dst, dgraph.weights,
+                        dgraph.indptr_dev(),
+                        jnp.asarray(delta, self._dtype),
+                        **bucket_kwargs,
+                    )
                 )
                 steps = int(steps)
                 examined = relax.examined_exact(ex_hi, ex_lo)
@@ -1264,11 +1462,7 @@ class JaxBackend(Backend):
                     (dist0, dgraph.src, dgraph.dst, dgraph.weights,
                      dgraph.indptr_dev(),
                      jnp.asarray(delta, self._dtype)),
-                    dict(max_steps=max_steps,
-                         capacity=auto_capacity(v, dgraph.max_degree),
-                         max_degree=dgraph.max_degree,
-                         num_real_edges=dgraph.num_real_edges,
-                         edge_chunk=chunk),
+                    bucket_kwargs,
                     dgraph,
                 )
                 if bool(still):
@@ -1278,7 +1472,7 @@ class JaxBackend(Backend):
                     )
                     it2 = int(it2)
                     improving = bool(improving)
-                    return KernelResult(
+                    res = KernelResult(
                         dist=dist_b,
                         negative_cycle=improving and max_iter >= v,
                         converged=not improving,
@@ -1288,7 +1482,15 @@ class JaxBackend(Backend):
                         route="bucket+sweep",
                         cost=bucket_cost,
                     )
-                return KernelResult(
+                    if traj_bufs:
+                        # The trajectory covers the bucketed steps only
+                        # (the finishing sweep is the uninstrumented
+                        # certifier) — decode at the bucket step count.
+                        self._attach_trajectory(
+                            res, *traj_bufs, dgraph, iterations=steps
+                        )
+                    return res
+                res = KernelResult(
                     dist=dist_b,
                     # Empty active+pending masks certify the global
                     # fixpoint (ops.bucket invariant), so a reachable
@@ -1300,6 +1502,9 @@ class JaxBackend(Backend):
                     route="bucket",
                     cost=bucket_cost,
                 )
+                if traj_bufs:
+                    self._attach_trajectory(res, *traj_bufs, dgraph)
+                return res
             except Exception:
                 self._auto_route_failed(
                     "_bucket_disabled",
@@ -1319,15 +1524,21 @@ class JaxBackend(Backend):
                     dist0_gs = dist0_gs.at[
                         int(bundle["rank_host"][source])
                     ].set(0.0)
-                dist, rounds, improving, iters_blk = _gs_kernel(
-                    dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
-                    bundle["w_blk"], bundle["rank"],
+                gs_kwargs = dict(
                     vb=bundle["vb"], halo=bundle["halo"],
-                    max_outer=max_iter, inner_cap=self.config.gs_inner_cap,
+                    max_outer=max_iter,
+                    inner_cap=self.config.gs_inner_cap,
+                    traj_cap=self._traj_cap(),
+                )
+                dist, rounds, improving, iters_blk, *traj_bufs = (
+                    _gs_kernel(
+                        dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
+                        bundle["w_blk"], bundle["rank"], **gs_kwargs,
+                    )
                 )
                 iters = int(rounds)
                 improving = bool(improving)
-                return KernelResult(
+                res = KernelResult(
                     dist=dist,
                     negative_cycle=improving and max_iter >= v,
                     converged=not improving,
@@ -1341,14 +1552,16 @@ class JaxBackend(Backend):
                         "gs", _gs_kernel,
                         (dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
                          bundle["w_blk"], bundle["rank"]),
-                        dict(vb=bundle["vb"], halo=bundle["halo"],
-                             max_outer=max_iter,
-                             inner_cap=self.config.gs_inner_cap),
+                        gs_kwargs,
                         dgraph,
                     ),
                 )
+                if traj_bufs:
+                    self._attach_trajectory(res, *traj_bufs, dgraph)
+                return res
             except Exception:
                 self._gs_auto_failed(dgraph)  # re-raises when forced
+        traj_bufs = None
         if self._use_frontier(dgraph):
             dist, iters, improving, ex_hi, ex_lo = _bf_frontier_kernel(
                 dist0, dgraph.src, dgraph.dst, dgraph.weights,
@@ -1378,21 +1591,34 @@ class JaxBackend(Backend):
             # reduction and measures 2-3x SLOWER than the scatter sweep
             # (CPU, rmat16: 57 ms vm vs 20 ms sm) — the vm layout needs a
             # wide batch dimension to pay off.
-            dist, iters, improving = _bf_kernel(
-                dist0, dgraph.src, dgraph.dst, dgraph.weights,
-                max_iter=max_iter, edge_chunk=chunk,
-            )
+            cap = self._traj_cap()
+            if cap is not None:
+                dist, iters, improving, *traj_bufs = _bf_kernel_traj(
+                    dist0, dgraph.src, dgraph.dst, dgraph.weights,
+                    max_iter=max_iter, edge_chunk=chunk, traj_cap=cap,
+                )
+                sweep_fn, sweep_kwargs = _bf_kernel_traj, dict(
+                    max_iter=max_iter, edge_chunk=chunk, traj_cap=cap
+                )
+            else:
+                dist, iters, improving = _bf_kernel(
+                    dist0, dgraph.src, dgraph.dst, dgraph.weights,
+                    max_iter=max_iter, edge_chunk=chunk,
+                )
+                sweep_fn, sweep_kwargs = _bf_kernel, dict(
+                    max_iter=max_iter, edge_chunk=chunk
+                )
             edges_relaxed = int(iters) * dgraph.num_real_edges
             route = "sweep"
             cost = self._observe_cost(
-                "sweep", _bf_kernel,
+                "sweep", sweep_fn,
                 (dist0, dgraph.src, dgraph.dst, dgraph.weights),
-                dict(max_iter=max_iter, edge_chunk=chunk),
+                sweep_kwargs,
                 dgraph,
             )
         iters = int(iters)
         improving = bool(improving)
-        return KernelResult(
+        res = KernelResult(
             dist=dist,
             negative_cycle=improving and max_iter >= v,
             converged=not improving,
@@ -1401,6 +1627,9 @@ class JaxBackend(Backend):
             route=route,
             cost=cost,
         )
+        if traj_bufs:
+            self._attach_trajectory(res, *traj_bufs, dgraph)
+        return res
 
     def _use_pred_extraction(self) -> bool:
         """Post-fixpoint tight-edge extraction (ops.pred) serves pred
@@ -1742,6 +1971,7 @@ class JaxBackend(Backend):
             # auto route.
             try:
                 lay = self.dia_bundle(dgraph)
+                traj_bufs = None
                 if mesh.devices.size > 1:
                     from paralleljohnson_tpu.parallel import (
                         sharded_dia_fanout,
@@ -1769,21 +1999,38 @@ class JaxBackend(Backend):
                     dist0_bv = dist0_bv.at[
                         jnp.arange(sources.shape[0]), sources
                     ].set(0.0)
-                    dist, iters, improving = dia_fixpoint(
-                        dist0_bv, lay["w_diag"],
-                        offsets=lay["offsets"], max_iter=max_iter,
-                    )
+                    cap = self._traj_cap()
+                    if cap is not None:
+                        dist, iters, improving, *traj_bufs = (
+                            _dia_fixpoint_traj(
+                                dist0_bv, lay["w_diag"],
+                                offsets=lay["offsets"], max_iter=max_iter,
+                                traj_cap=cap,
+                            )
+                        )
+                        dia_fn, dia_kwargs = _dia_fixpoint_traj, dict(
+                            offsets=lay["offsets"], max_iter=max_iter,
+                            traj_cap=cap,
+                        )
+                    else:
+                        dist, iters, improving = dia_fixpoint(
+                            dist0_bv, lay["w_diag"],
+                            offsets=lay["offsets"], max_iter=max_iter,
+                        )
+                        dia_fn, dia_kwargs = dia_fixpoint, dict(
+                            offsets=lay["offsets"], max_iter=max_iter,
+                        )
                     examined = (
                         int(iters) * lay["num_entries"]
                         * int(sources.shape[0])
                     )
                     dia_route = "dia"
                     dia_cost = self._observe_cost(
-                        "dia", dia_fixpoint, (dist0_bv, lay["w_diag"]),
-                        dict(offsets=lay["offsets"], max_iter=max_iter),
+                        "dia", dia_fn, (dist0_bv, lay["w_diag"]),
+                        dia_kwargs,
                         dgraph, batch=int(sources.shape[0]),
                     )
-                return KernelResult(
+                res = KernelResult(
                     dist=dist,
                     converged=not bool(improving),
                     iterations=int(iters),
@@ -1791,6 +2038,12 @@ class JaxBackend(Backend):
                     route=dia_route,
                     cost=dia_cost,
                 )
+                if traj_bufs:
+                    self._attach_trajectory(
+                        res, *traj_bufs, dgraph,
+                        batch=int(sources.shape[0]),
+                    )
+                return res
             except Exception:
                 self._auto_route_failed(
                     "_dia_disabled",
@@ -1810,6 +2063,7 @@ class JaxBackend(Backend):
             # cover); a forced flag propagates the error.
             try:
                 bundle = dgraph.gs_layout(self.config.gs_block_size)
+                traj_bufs = None
                 if mesh.devices.size > 1:
                     from paralleljohnson_tpu.parallel import (
                         sharded_gs_fanout,
@@ -1833,12 +2087,18 @@ class JaxBackend(Backend):
                         batch=int(sources.shape[0]),
                     )
                 else:
-                    dist, rounds, improving, iters_blk = _gs_fanout_kernel(
-                        sources, bundle["src_blk"], bundle["dstl_blk"],
-                        bundle["w_blk"], bundle["rank"],
+                    gs_kwargs = dict(
                         v_pad=bundle["v_pad"], vb=bundle["vb"],
                         halo=bundle["halo"], max_outer=max_iter,
                         inner_cap=self.config.gs_inner_cap,
+                        traj_cap=self._traj_cap(),
+                    )
+                    dist, rounds, improving, iters_blk, *traj_bufs = (
+                        _gs_fanout_kernel(
+                            sources, bundle["src_blk"],
+                            bundle["dstl_blk"], bundle["w_blk"],
+                            bundle["rank"], **gs_kwargs,
+                        )
                     )
                     examined = _gs_examined_exact(
                         iters_blk, bundle["real_edges_host"],
@@ -1851,12 +2111,10 @@ class JaxBackend(Backend):
                         "gs", _gs_fanout_kernel,
                         (sources, bundle["src_blk"], bundle["dstl_blk"],
                          bundle["w_blk"], bundle["rank"]),
-                        dict(v_pad=bundle["v_pad"], vb=bundle["vb"],
-                             halo=bundle["halo"], max_outer=max_iter,
-                             inner_cap=self.config.gs_inner_cap),
+                        gs_kwargs,
                         dgraph, batch=int(sources.shape[0]),
                     )
-                return KernelResult(
+                res = KernelResult(
                     dist=dist,
                     converged=not bool(improving),
                     iterations=int(rounds),
@@ -1864,6 +2122,12 @@ class JaxBackend(Backend):
                     route=gs_route,
                     cost=gs_cost,
                 )
+                if traj_bufs:
+                    self._attach_trajectory(
+                        res, *traj_bufs, dgraph,
+                        batch=int(sources.shape[0]),
+                    )
+                return res
             except Exception:
                 self._gs_auto_failed(dgraph)  # re-raises when forced
         if (
@@ -1912,6 +2176,7 @@ class JaxBackend(Backend):
                     "for this backend instance",
                     forced=self.config.fw is True,
                 )
+        traj_bufs = None
         if "edges" in mesh.axis_names:
             # 2-D ("sources", "edges") mesh: rows AND edge slices sharded.
             from paralleljohnson_tpu.parallel import sharded_fanout_2d
@@ -2070,26 +2335,50 @@ class JaxBackend(Backend):
                     try:
                         lay = dgraph.vm_blocked_layout(VM_BLOCK, lay_chunk)
                         if lay is not None:
-                            dist, iters, improving = (
-                                _fanout_vm_blocked_kernel(
-                                    sources, lay["src_ck"],
-                                    lay["dstl_ck"], lay["w_ck"],
-                                    lay["base_ck"], num_nodes=v,
-                                    v_pad=lay["v_pad"], vb=lay["vb"],
-                                    max_iter=max_iter,
+                            cap = self._traj_cap()
+                            if cap is not None:
+                                dist, iters, improving, *traj_bufs = (
+                                    _fanout_vm_blocked_kernel_traj(
+                                        sources, lay["src_ck"],
+                                        lay["dstl_ck"], lay["w_ck"],
+                                        lay["base_ck"], num_nodes=v,
+                                        v_pad=lay["v_pad"], vb=lay["vb"],
+                                        max_iter=max_iter, traj_cap=cap,
+                                    )
                                 )
-                            )
+                                vmb_fn = _fanout_vm_blocked_kernel_traj
+                                vmb_kwargs = dict(
+                                    num_nodes=v, v_pad=lay["v_pad"],
+                                    vb=lay["vb"], max_iter=max_iter,
+                                    traj_cap=cap,
+                                )
+                            else:
+                                dist, iters, improving = (
+                                    _fanout_vm_blocked_kernel(
+                                        sources, lay["src_ck"],
+                                        lay["dstl_ck"], lay["w_ck"],
+                                        lay["base_ck"], num_nodes=v,
+                                        v_pad=lay["v_pad"], vb=lay["vb"],
+                                        max_iter=max_iter,
+                                    )
+                                )
+                                vmb_fn = _fanout_vm_blocked_kernel
+                                vmb_kwargs = dict(
+                                    num_nodes=v, v_pad=lay["v_pad"],
+                                    vb=lay["vb"], max_iter=max_iter,
+                                )
                             iters = int(iters)
                             route = "vm-blocked"
                             cost = self._observe_cost(
-                                "vm-blocked", _fanout_vm_blocked_kernel,
+                                "vm-blocked", vmb_fn,
                                 (sources, lay["src_ck"], lay["dstl_ck"],
                                  lay["w_ck"], lay["base_ck"]),
-                                dict(num_nodes=v, v_pad=lay["v_pad"],
-                                     vb=lay["vb"], max_iter=max_iter),
+                                vmb_kwargs,
                                 dgraph, batch=int(sources.shape[0]),
                             )
                     except Exception:
+                        traj_bufs = None  # a dead route's buffers must
+                        # never attach to the fallback's result
                         self._auto_route_failed(
                             "_vmb_disabled",
                             "dst-blocked vm fan-out failed on this "
@@ -2099,37 +2388,70 @@ class JaxBackend(Backend):
                         )
                 if route is None:
                     src_bd, dst_bd, w_bd = dgraph.by_dst()
-                    dist, iters, improving = _fanout_vm_kernel(
-                        sources, src_bd, dst_bd, w_bd,
-                        num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
-                    )
+                    cap = self._traj_cap()
+                    if cap is not None:
+                        dist, iters, improving, *traj_bufs = (
+                            _fanout_vm_kernel_traj(
+                                sources, src_bd, dst_bd, w_bd,
+                                num_nodes=v, max_iter=max_iter,
+                                edge_chunk=chunk, traj_cap=cap,
+                            )
+                        )
+                        vm_fn, vm_kwargs = _fanout_vm_kernel_traj, dict(
+                            num_nodes=v, max_iter=max_iter,
+                            edge_chunk=chunk, traj_cap=cap,
+                        )
+                    else:
+                        dist, iters, improving = _fanout_vm_kernel(
+                            sources, src_bd, dst_bd, w_bd,
+                            num_nodes=v, max_iter=max_iter,
+                            edge_chunk=chunk,
+                        )
+                        vm_fn, vm_kwargs = _fanout_vm_kernel, dict(
+                            num_nodes=v, max_iter=max_iter,
+                            edge_chunk=chunk,
+                        )
                     route = "vm"
                     cost = self._observe_cost(
-                        "vm", _fanout_vm_kernel,
+                        "vm", vm_fn,
                         (sources, src_bd, dst_bd, w_bd),
-                        dict(num_nodes=v, max_iter=max_iter,
-                             edge_chunk=chunk),
+                        vm_kwargs,
                         dgraph, batch=int(sources.shape[0]),
                     )
                 row_sweeps = int(iters) * int(sources.shape[0])
         else:
             chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
-            dist, iters, improving = _fanout_kernel(
-                sources, dgraph.src, dgraph.dst, dgraph.weights,
-                num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
-            )
+            cap = self._traj_cap()
+            if cap is not None:
+                dist, iters, improving, *traj_bufs = _fanout_kernel_traj(
+                    sources, dgraph.src, dgraph.dst, dgraph.weights,
+                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                    traj_cap=cap,
+                )
+                sm_fn, sm_kwargs = _fanout_kernel_traj, dict(
+                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                    traj_cap=cap,
+                )
+            else:
+                dist, iters, improving = _fanout_kernel(
+                    sources, dgraph.src, dgraph.dst, dgraph.weights,
+                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                )
+                sm_fn, sm_kwargs = _fanout_kernel, dict(
+                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                )
             row_sweeps = int(iters) * int(sources.shape[0])
             route = "sweep-sm"
             cost = self._observe_cost(
-                "sweep-sm", _fanout_kernel,
+                "sweep-sm", sm_fn,
                 (sources, dgraph.src, dgraph.dst, dgraph.weights),
-                dict(num_nodes=v, max_iter=max_iter, edge_chunk=chunk),
+                sm_kwargs,
                 dgraph, batch=int(sources.shape[0]),
             )
         iters = int(iters)
         # Single-chip kernels iterate every row together, so iters x B is
         # exact; the sharded path reports the psum'd per-shard total.
-        return KernelResult(
+        res = KernelResult(
             dist=dist,
             converged=not bool(improving),
             iterations=iters,
@@ -2137,6 +2459,11 @@ class JaxBackend(Backend):
             route=route,
             cost=cost,
         )
+        if traj_bufs:
+            self._attach_trajectory(
+                res, *traj_bufs, dgraph, batch=int(sources.shape[0])
+            )
+        return res
 
     def reweight(self, dgraph: JaxDeviceGraph, potentials) -> JaxDeviceGraph:
         h = jnp.asarray(potentials, self._dtype)

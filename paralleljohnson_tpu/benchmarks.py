@@ -161,6 +161,28 @@ def _routes(res) -> dict:
         out["analytic_bytes"] = round(float(cost["bytes_accessed"]), 1)
     if getattr(s, "predicted_s", None) is not None:
         out["predicted_s"] = round(float(s.predicted_s), 6)
+    # Convergence-observatory summary (ISSUE 9): total iterations ride
+    # at top level — bench_regress grades them like walls (a route
+    # silently converging slower is a perf bug even when wall noise
+    # hides it) — with the trajectory shape numbers beside them.
+    conv = getattr(s, "convergence", None)
+    if conv:
+        out["iterations"] = sum(
+            int(c.get("iterations", 0)) for c in conv.values()
+        )
+        out["convergence"] = {
+            phase: {
+                "iterations": c.get("iterations"),
+                "frontier_half_life": c.get("frontier_half_life"),
+                "tail_fraction": round(
+                    float(c.get("tail_fraction", 0.0)), 4
+                ),
+                "jfr_skippable_edge_frac": round(
+                    float(c.get("jfr_skippable_edge_frac", 0.0)), 4
+                ),
+            }
+            for phase, c in conv.items()
+        }
     return out
 
 

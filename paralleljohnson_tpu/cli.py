@@ -101,6 +101,19 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--gs-inner-cap", type=int, default=64,
                    help="max Gauss-Seidel inner iterations per block "
                         "visit (bounds extra propagation, not correctness)")
+    p.add_argument("--convergence", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="per-iteration convergence trajectory recording "
+                        "(README 'Convergence observatory'): frontier "
+                        "size / relaxations / residual mass per "
+                        "while_loop iteration, carried on device, one "
+                        "D2H after convergence — surfaces SolverStats"
+                        ".convergence, heartbeat iter/frontier_size/"
+                        "eta_s, 'trajectory' flight events, and profile-"
+                        "store records. auto = on exactly when a "
+                        "telemetry sink or profile store is configured "
+                        "(otherwise the original uninstrumented kernels "
+                        "compile — identical jaxpr)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--pipeline-depth", type=int, default=2,
                    help="max fan-out batches in flight (double-buffered "
@@ -242,6 +255,7 @@ def _config(args) -> "SolverConfig":
         stage_deadline_s=args.stage_deadline,
         min_source_batch=args.min_source_batch,
         profile_store=args.profile_store,
+        convergence=tristate[args.convergence],
         telemetry=_telemetry(args, args.command),
     )
 
@@ -317,6 +331,20 @@ def _report(res, args) -> None:
                 print(
                     f"  cost model: predicted {s.predicted_s * 1e3:.2f} ms"
                     f" vs measured {s.compute_seconds * 1e3:.2f} ms compute"
+                )
+        # Convergence-observatory summary (ISSUE 9) — one line per
+        # instrumented phase when the trajectory was recorded (off by
+        # default; a plain solve stays quiet).
+        conv = getattr(s, "convergence", None)
+        if conv:
+            for phase, c in conv.items():
+                print(
+                    f"  convergence[{phase}]: {c.get('iterations', 0)} "
+                    f"iter (half-life {c.get('frontier_half_life', 0)}), "
+                    f"tail {c.get('tail_fraction', 0.0):.0%}, "
+                    "JFR-skippable "
+                    f"{c.get('jfr_skippable_edge_frac', 0.0):.0%} of "
+                    "examined edges"
                 )
         # Pipeline summary — only when the fan-out actually staged work
         # off the critical path (a serial solve stays quiet).
@@ -611,6 +639,41 @@ def main(argv: list[str] | None = None) -> int:
                                "pipeline overlap) dominate the wall",
                     "unknown": "no capture for this solve",
                 },
+            },
+            # The convergence observatory (README "Convergence
+            # observatory"): per-iteration introspection of the
+            # iterative kernel routes — the measured substrate of
+            # ROADMAP item 4 (JFR frontier compaction).
+            "convergence_observatory": {
+                "flags": {
+                    "--convergence": (
+                        "auto (on when telemetry or a profile store is "
+                        "configured; otherwise the original "
+                        "uninstrumented kernels compile) / true / false"
+                    ),
+                },
+                "instrumented_routes": [
+                    "sweep", "sweep-sm", "vm", "vm-blocked", "gs",
+                    "dia", "bucket",
+                ],
+                "per_iteration": [
+                    "frontier_size (vertices whose distance improved)",
+                    "relaxations_applied (labels improved)",
+                    "residual_mass (sum of finite distance decreases)",
+                ],
+                "summary_fields": [
+                    "iterations", "frontier_half_life",
+                    "tail_fraction (frontier < 1% of V)",
+                    "jfr_skippable_edge_frac",
+                ],
+                "heartbeat_fields": ["iter", "frontier_size", "eta_s"],
+                "offline_readers": [
+                    "python scripts/convergence_report.py "
+                    "<profile dir | flight.jsonl>",
+                    "python scripts/trace_summary.py <flight.jsonl> "
+                    "--convergence",
+                ],
+                "evidence": "bench_artifacts/convergence_evidence.md",
             },
         }
         # Priced route table from the persisted calibration — the

@@ -223,6 +223,24 @@ class SolverConfig:
         capture entirely; roofline attribution of measured phases still
         runs (it is free). Capture pays one extra AOT lower+compile per
         key. CLI: ``--profile-store``.
+      convergence: per-iteration convergence trajectory recording
+        (ISSUE 9, ``paralleljohnson_tpu/observe/convergence``): the
+        iterative kernel routes (sweep / sweep-sm / vm / vm-blocked /
+        gs / dia / bucket — incl. the BF-potentials pass) carry
+        on-device ``[cap, 3]``-shaped counters of per-iteration
+        frontier size, relaxations applied, and residual mass through
+        their while_loops — zero extra host syncs per iteration, one
+        D2H after convergence — surfacing ``SolverStats.convergence``
+        (iterations, frontier half-life, tail fraction, JFR-skippable
+        estimate), per-stage ``trajectory`` flight events, heartbeat
+        ``iter``/``frontier_size``/``eta_s``, and per-iteration
+        profile-store records. ``"auto"``: enabled exactly when a
+        consumer exists (telemetry configured or a profile store set);
+        with neither, dispatch compiles the ORIGINAL uninstrumented
+        kernels — identical jaxpr, asserted in tests. True forces
+        recording (tests / ad-hoc introspection); False disables even
+        with sinks. Distances are bitwise-identical either way — the
+        counters ride the carry, never the arithmetic.
       telemetry: a ``utils.telemetry.Telemetry`` (or None, the default)
         — the flight-recorder subsystem: nested spans + events appended
         to a JSONL that survives a killed worker, a heartbeat JSON
@@ -269,6 +287,7 @@ class SolverConfig:
     min_source_batch: int = 8
     fault_plan: object | None = None
     profile_store: str | None = None
+    convergence: bool | str = "auto"
     telemetry: object | None = None
 
     @property
@@ -388,6 +407,11 @@ class SolverConfig:
         if self.pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth must be >= 1, got {self.pipeline_depth}"
+            )
+        if self.convergence not in (True, False, "auto"):
+            raise ValueError(
+                f"convergence must be True/False/'auto', "
+                f"got {self.convergence!r}"
             )
 
     def retry_policy(self):

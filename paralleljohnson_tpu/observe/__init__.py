@@ -38,6 +38,13 @@ from __future__ import annotations
 
 import sys
 
+from paralleljohnson_tpu.observe.convergence import (  # noqa: F401
+    DEFAULT_TRAJ_CAP,
+    estimate_eta,
+    frontier_curve,
+    summarize_trajectory,
+    trajectory_record,
+)
 from paralleljohnson_tpu.observe.costs import (  # noqa: F401
     CostCapture,
     resolve_profile_dir,
@@ -130,4 +137,25 @@ def finalize_solve(
             batch=batch,
         )
     )
+    # Convergence-observatory records (ISSUE 9): one ``kind:
+    # "trajectory"`` record per instrumented kernel call (a multi-batch
+    # fan-out lands one per batch), keyed by the phase's resolved route
+    # so convergence_report.py and the cost model's per-iteration
+    # calibration join on (route, platform) like every other record.
+    routes = getattr(stats, "routes_by_phase", None) or {}
+    for phase, trajs in (getattr(stats, "trajectories", None) or {}).items():
+        for idx, traj in enumerate(trajs):
+            store.append(
+                trajectory_record(
+                    traj,
+                    label=label,
+                    phase=phase,
+                    index=idx,
+                    route=routes.get(phase) or route,
+                    platform=platform,
+                    num_nodes=num_nodes,
+                    num_edges=num_edges,
+                    batch=batch,
+                )
+            )
     return roof

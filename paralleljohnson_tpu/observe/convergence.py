@@ -1,0 +1,343 @@
+"""Convergence observatory (ISSUE 9 tentpole) — see *inside* the
+iterative relaxation loops.
+
+Every sweep/GS/DIA/bucket solve has been a black box between "stage
+started" and "stage converged": the flight recorder (round 10) and the
+cost observatory (round 12) see *between* stages, never the
+per-iteration trajectory. ROADMAP item 4 (JFR frontier compaction) is
+premised on the active frontier collapsing in late iterations, and the
+cost model's iterative routes need iterations-to-converge as a
+predictable input — both need the trajectory measured, not assumed.
+
+Mechanism: each instrumented ``lax.while_loop`` iteration accumulates
+three numbers into device-resident buffers carried through the loop —
+
+  frontier_size        vertices whose distance label strictly decreased
+                       this iteration (any batch row counts the vertex
+                       once) — the JFR opportunity metric;
+  relaxations_applied  distance LABELS improved this iteration (rows x
+                       vertices; equals frontier_size at B=1);
+  residual_mass        sum of finite distance decreases (an inf -> finite
+                       first-reach contributes 0 — its decrease is not a
+                       finite number; the mass decays to 0 at fixpoint).
+
+Zero extra host syncs per iteration: the buffers ride the while_loop
+carry and cross to the host ONCE after convergence (the same
+``np.asarray`` moment the iteration count already pays). Iterations
+past the static buffer cap accumulate into the last row (totals stay
+exact; per-iteration resolution truncates — ``summarize_trajectory``
+flags it).
+
+Exactness contract (the split-int32 idiom of ``ops/bucket.py``): counts
+are int32. A single iteration's addend is bounded by batch x V
+(relaxations) — callers on shapes where that bound reaches 2^31 must
+run the shared :func:`~paralleljohnson_tpu.utils.metrics.
+warn_if_traj_counter_wrapped` guard so a wrapped counter is a warned
+lower bound, never a silent lie. ``residual_mass`` is f32 and
+advisory (a decay shape, not an exact counter).
+
+Disabled path (no telemetry and no profile store configured): the
+backend dispatches the ORIGINAL kernels — the instrumented while_loops
+are separate compilations, so the disabled jaxpr is bit-for-bit the
+pre-observatory one (asserted in tests/test_trajectory.py).
+
+Host-side consumers: :func:`summarize_trajectory` (iterations, frontier
+half-life, tail fraction — ``SolverStats.convergence``),
+:func:`trajectory_record` (the per-iteration profile-store record),
+:func:`frontier_curve` (downsampled curve for flight-recorder events),
+and :func:`estimate_eta` (the trajectory-aware completion estimate the
+heartbeat publishes for the TPU watchdog).
+
+Top-level imports are stdlib-only (the offline report script loads this
+module without jax); the device-side builders import jax lazily.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+# Rows of the device trajectory buffer. Iterations beyond the cap
+# accumulate into the last row — totals stay exact, per-iteration
+# resolution truncates (summarize_trajectory sets "truncated"). 2048
+# rows x (2 x int32 + 1 x f32) = 24 KB of HBM — noise next to one
+# [B, V] distance block.
+DEFAULT_TRAJ_CAP = 2048
+
+# Frontier below this fraction of V marks a "tail" iteration — the
+# iterations JFR-style frontier compaction would collapse (ROADMAP
+# item 4's opportunity definition).
+TAIL_FRONTIER_FRAC = 0.01
+
+
+# -- device side (lazy jax imports: tracing-time only) -----------------------
+
+
+def traj_init(cap: int):
+    """Fresh trajectory carries: (counts int32 [cap, 2], resid f32 [cap])
+    — columns of ``counts`` are (frontier_size, relaxations_applied)."""
+    import jax.numpy as jnp
+
+    return (
+        jnp.zeros((int(cap), 2), jnp.int32),
+        jnp.zeros((int(cap),), jnp.float32),
+    )
+
+
+def traj_record(counts, resid, i, d, nd, *, batch_axis: int | None = None):
+    """Accumulate one iteration's (frontier, relaxations, residual mass)
+    into row ``min(i, cap-1)`` of the carried buffers.
+
+    ``d``/``nd`` are the distances before/after the iteration's sweep;
+    ``batch_axis`` is the batch dimension of ``d`` (None for B=1 [V]
+    vectors, 0 for [B, V], 1 for vertex-major [V, B]) — a vertex counts
+    toward the frontier once no matter how many batch rows improved it.
+    Pure accumulate-into-carry: XLA aliases the while_loop buffers, so
+    the per-iteration cost is one O(size(d)) compare + two O(1) row
+    writes, no host transfer."""
+    import jax.numpy as jnp
+
+    improved = nd < d
+    if batch_axis is None:
+        vert_changed = improved
+    else:
+        vert_changed = jnp.any(improved, axis=batch_axis)
+    frontier = jnp.sum(vert_changed, dtype=jnp.int32)
+    relaxed = jnp.sum(improved, dtype=jnp.int32)
+    # First-reach improvements come from d = +inf: their decrease is not
+    # a finite number, so they contribute 0 mass (documented above).
+    gain = jnp.where(improved & jnp.isfinite(d), d - nd, 0.0)
+    mass = jnp.sum(gain).astype(resid.dtype)
+    row = jnp.minimum(i, counts.shape[0] - 1)
+    counts = counts.at[row].add(jnp.stack([frontier, relaxed]))
+    resid = resid.at[row].add(mass)
+    return counts, resid
+
+
+def instrumented_fixpoint(
+    step_fn: Callable,
+    dist0,
+    *,
+    max_iter: int,
+    cap: int,
+    batch_axis: int | None = None,
+):
+    """Iterate ``step_fn(d) -> nd`` to fixpoint under ``lax.while_loop``
+    with trajectory recording — the instrumented twin of the plain
+    ``(dist, i, improving)`` fixpoints in ``ops.relax`` / ``ops.dia``
+    (same cond/body contract, two extra carries).
+
+    Returns ``(dist, iterations, still_improving, counts, resid)``;
+    decode host-side with :func:`decode_trajectory`."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    counts0, resid0 = traj_init(cap)
+
+    def cond(state):
+        _, i, improving, _, _ = state
+        return improving & (i < max_iter)
+
+    def body(state):
+        d, i, _, counts, resid = state
+        nd = step_fn(d)
+        counts, resid = traj_record(
+            counts, resid, i, d, nd, batch_axis=batch_axis
+        )
+        return nd, i + 1, jnp.any(nd < d), counts, resid
+
+    improving0 = jnp.any(jnp.isfinite(dist0))
+    return lax.while_loop(
+        cond, body, (dist0, jnp.int32(0), improving0, counts0, resid0)
+    )
+
+
+# -- host side (stdlib + numpy only) -----------------------------------------
+
+
+def decode_trajectory(counts, resid, iterations: int):
+    """Device buffers -> the ``[n, 3]`` float64 host trajectory
+    (columns: frontier_size, relaxations_applied, residual_mass), where
+    ``n = min(iterations, cap)`` — THE one D2H of the whole mechanism.
+    Counts decode through int64 so the exact int32 device values never
+    round through f32."""
+    import numpy as np
+
+    counts = np.asarray(counts)
+    resid = np.asarray(resid)
+    n = max(0, min(int(iterations), counts.shape[0]))
+    out = np.empty((n, 3), np.float64)
+    out[:, :2] = counts[:n].astype(np.int64)
+    out[:, 2] = resid[:n]
+    return out
+
+
+def summarize_trajectory(
+    traj,
+    *,
+    num_nodes: int,
+    batch: int = 1,
+    num_edges: int | None = None,
+    iterations: int | None = None,
+) -> dict:
+    """The ``SolverStats.convergence`` summary of one decoded trajectory.
+
+    iterations           total loop iterations (>= rows when truncated)
+    frontier_peak/last   max / final frontier size
+    frontier_half_life   first iteration index whose frontier is <= half
+                         the peak and never recovers above it — the
+                         collapse speed the JFR evidence quantifies
+    tail_iterations /    iterations (count / fraction) whose frontier is
+      tail_fraction      below ``TAIL_FRONTIER_FRAC`` of V — full sweeps
+                         there relax E edges to improve < 1% of vertices
+    jfr_skippable_edge_frac
+                         estimated fraction of full-sweep examined edges
+                         a frontier-compacted schedule would skip:
+                         1 - sum(frontier_i) / (iterations x V), i.e.
+                         out-edges of non-frontier vertices under a
+                         uniform-degree estimate (exact counters from the
+                         real frontier/bucket kernels are the ground
+                         truth this estimate is validated against —
+                         scripts/convergence_report.py --evidence)
+    relaxations_total /  exact totals (Python ints / float)
+      residual_mass_total
+    truncated            True when iterations > buffer rows (the last
+                         row then holds the whole tail's accumulation
+                         and per-iteration resolution stops there)
+    """
+    import numpy as np
+
+    traj = np.asarray(traj, np.float64)
+    rows = int(traj.shape[0])
+    iters = int(iterations) if iterations is not None else rows
+    out: dict = {
+        "iterations": iters,
+        "rows": rows,
+        "batch": int(batch),
+        "num_nodes": int(num_nodes),
+        "truncated": iters > rows,
+    }
+    if rows == 0:
+        out.update(
+            frontier_peak=0, frontier_last=0, frontier_half_life=0,
+            tail_iterations=0, tail_fraction=0.0,
+            jfr_skippable_edge_frac=0.0, relaxations_total=0,
+            residual_mass_total=0.0,
+        )
+        return out
+    frontier = traj[:, 0]
+    peak = float(frontier.max())
+    out["frontier_peak"] = int(peak)
+    out["frontier_last"] = int(frontier[-1])
+    # Half-life: first index from which the frontier STAYS at or below
+    # half the peak (a one-iteration dip that recovers is not collapse).
+    half = peak / 2.0
+    above = np.flatnonzero(frontier > half)
+    out["frontier_half_life"] = int(above[-1]) + 1 if above.size else 0
+    tail_mask = frontier < TAIL_FRONTIER_FRAC * max(int(num_nodes), 1)
+    out["tail_iterations"] = int(tail_mask.sum())
+    out["tail_fraction"] = float(tail_mask.sum() / rows)
+    # Uniform-degree estimate of the JFR win over full sweeps. The
+    # truncated tail accumulates into the last row, so sum(frontier)
+    # stays the exact total frontier-visit count even past the cap.
+    denom = float(iters) * max(int(num_nodes), 1)
+    out["jfr_skippable_edge_frac"] = float(
+        max(0.0, 1.0 - frontier.sum() / denom)
+    )
+    if num_edges:
+        out["num_edges"] = int(num_edges)
+    out["relaxations_total"] = int(traj[:, 1].sum())
+    out["residual_mass_total"] = float(traj[:, 2].sum())
+    return out
+
+
+def merge_summaries(prev: dict | None, summ: dict) -> dict:
+    """Fold one more kernel call's summary into a phase entry
+    (multi-batch fan-outs land one trajectory per batch): the entry
+    keeps the LATEST batch's shape fields and accumulates ``batches`` /
+    ``iterations_total`` / ``relaxations_total`` across calls."""
+    entry = dict(summ)
+    if prev is None:
+        entry["batches"] = 1
+        entry["iterations_total"] = summ.get("iterations", 0)
+    else:
+        entry["batches"] = int(prev.get("batches", 1)) + 1
+        entry["iterations_total"] = int(
+            prev.get("iterations_total", 0)
+        ) + int(summ.get("iterations", 0))
+        entry["relaxations_total"] = int(
+            prev.get("relaxations_total", 0)
+        ) + int(summ.get("relaxations_total", 0))
+    return entry
+
+
+def frontier_curve(traj, max_points: int = 64) -> list:
+    """Downsampled frontier-size curve (head-biased stride) for flight-
+    recorder event attrs — enough shape to render a collapse curve from
+    a dead run's JSONL without dragging the full buffer through every
+    event line."""
+    import numpy as np
+
+    traj = np.asarray(traj)
+    if traj.shape[0] <= max_points:
+        return [int(x) for x in traj[:, 0]]
+    idx = np.unique(
+        np.linspace(0, traj.shape[0] - 1, max_points).astype(np.int64)
+    )
+    return [int(traj[i, 0]) for i in idx]
+
+
+def estimate_eta(
+    elapsed_s: float, done: int, remaining: int
+) -> float | None:
+    """Remaining-wall estimate from completed work units (batches):
+    ``remaining x (elapsed / done)``. None until one unit completes —
+    an ETA with no evidence is noise, not telemetry. The heartbeat
+    publishes this as ``eta_s`` so the TPU watchdog
+    (``tpu_round3_run.sh``) can extend a fresh stage's soft deadline by
+    a real completion estimate instead of a blind half-budget step."""
+    if done <= 0 or elapsed_s < 0:
+        return None
+    return float(remaining) * (float(elapsed_s) / float(done))
+
+
+def trajectory_record(
+    traj,
+    *,
+    label: str,
+    phase: str,
+    index: int,
+    route: str | None,
+    platform: str,
+    num_nodes: int,
+    num_edges: int,
+    batch: int,
+    summary: dict | None = None,
+) -> dict:
+    """The per-solve-stage profile-store record (``kind:
+    "trajectory"``): the full per-iteration curve plus its summary,
+    keyed like solve records so ``scripts/convergence_report.py`` and
+    the cost model join on (route, platform)."""
+    import time
+
+    import numpy as np
+
+    traj = np.asarray(traj, np.float64)
+    return {
+        "ts": time.time(),
+        "kind": "trajectory",
+        "label": label,
+        "phase": phase,
+        "batch_index": int(index),
+        "route": route,
+        "platform": platform,
+        "nodes": int(num_nodes),
+        "edges": int(num_edges),
+        "batch": int(batch),
+        "summary": summary or summarize_trajectory(
+            traj, num_nodes=num_nodes, batch=batch, num_edges=num_edges
+        ),
+        # Columns: frontier_size, relaxations_applied, residual_mass.
+        "trajectory": [
+            [int(r[0]), int(r[1]), float(r[2])] for r in traj
+        ],
+    }

@@ -37,6 +37,16 @@ DEFAULT_BAND = 0.35
 DEFAULT_ABS_FLOOR_S = 0.05
 DEFAULT_MIN_HISTORY = 2
 
+# Iteration-count band (ISSUE 9): iterations-to-converge is a property
+# of the graph + route, not of scheduler noise, so it gets a TIGHTER
+# band than walls — a fresh row iterating >25% (and >2 iterations) over
+# its history median converged slower, which is a perf bug even when
+# the wall stays inside its noise band (the sweeps just got cheaper or
+# the machine faster). Rows ingest iterations from detail.iterations —
+# written by bench rows whenever the convergence observatory was on.
+DEFAULT_ITER_BAND = 0.25
+DEFAULT_ITER_ABS_FLOOR = 2
+
 
 def history_key(row: dict) -> tuple:
     return (
@@ -215,6 +225,13 @@ def _roofline_of(row: dict, profile_records: list[dict] | None) -> str:
     return "unknown"
 
 
+def _iterations_of(row: dict):
+    """A row's iterations-to-converge, when its measurement carried the
+    convergence observatory's count (``detail.iterations``)."""
+    it = (row.get("detail") or {}).get("iterations")
+    return int(it) if isinstance(it, (int, float)) and it > 0 else None
+
+
 def detect_regressions(
     fresh: list[dict],
     history: list[dict],
@@ -222,6 +239,7 @@ def detect_regressions(
     band: float = DEFAULT_BAND,
     abs_floor_s: float = DEFAULT_ABS_FLOOR_S,
     min_history: int = DEFAULT_MIN_HISTORY,
+    iter_band: float = DEFAULT_ITER_BAND,
     profile_records: list[dict] | None = None,
 ) -> list[dict]:
     """Flag fresh rows slower than their history.
@@ -229,14 +247,25 @@ def detect_regressions(
     Per (bench, backend, platform, preset) key the baseline is the
     MEDIAN of the history walls (robust to the odd wedged run); a fresh
     wall above ``baseline * (1 + band)`` AND more than ``abs_floor_s``
-    over it is flagged. Keys with fewer than ``min_history`` rows are
-    skipped — one prior point is not a trend. Each flag carries the
-    baseline, the slowdown factor, and its roofline classification."""
+    over it is flagged (``kind: "wall"``). Keys with fewer than
+    ``min_history`` rows are skipped — one prior point is not a trend.
+    Each flag carries the baseline, the slowdown factor, and its
+    roofline classification.
+
+    Rows whose detail carries ``iterations`` (the convergence
+    observatory was on) are ALSO graded on iterations-to-converge
+    against the key's iteration history under the tighter ``iter_band``
+    (``kind: "iterations"``) — a route converging slower is a perf bug
+    even when wall noise hides it."""
     by_key: dict[tuple, list[float]] = {}
+    iters_by_key: dict[tuple, list[int]] = {}
     for row in history:
         w = row.get("wall_s")
         if isinstance(w, (int, float)) and w > 0:
             by_key.setdefault(history_key(row), []).append(float(w))
+        it = _iterations_of(row)
+        if it is not None:
+            iters_by_key.setdefault(history_key(row), []).append(it)
     flagged = []
     for row in fresh:
         w = row.get("wall_s")
@@ -249,10 +278,30 @@ def detect_regressions(
         if w > base * (1.0 + band) and (w - base) > abs_floor_s:
             flagged.append({
                 **row,
+                "kind": "wall",
                 "baseline_s": base,
                 "slowdown": w / base,
                 "band": band,
                 "history_n": len(hist),
+                "roofline_bound": _roofline_of(row, profile_records),
+            })
+        it = _iterations_of(row)
+        ihist = iters_by_key.get(history_key(row))
+        if it is None or not ihist or len(ihist) < min_history:
+            continue
+        ibase = statistics.median(ihist)
+        if (
+            it > ibase * (1.0 + iter_band)
+            and (it - ibase) > DEFAULT_ITER_ABS_FLOOR
+        ):
+            flagged.append({
+                **row,
+                "kind": "iterations",
+                "iterations": it,
+                "baseline_iterations": ibase,
+                "slowdown": it / ibase,
+                "band": iter_band,
+                "history_n": len(ihist),
                 "roofline_bound": _roofline_of(row, profile_records),
             })
     return flagged

@@ -118,6 +118,14 @@ def solve_record(
             "overlap_saved_s": float(g("overlap_saved_s", 0.0) or 0.0),
         },
         "edges_relaxed": int(g("edges_relaxed", 0) or 0),
+        # Iterations-to-converge across the compute phases (ISSUE 9):
+        # the input the CostModel's per-iteration calibration fits, so
+        # high-diameter graphs price by how long they actually iterate
+        # instead of a single solve-level wall.
+        "iterations": int(
+            sum((g("iterations_by_phase", {}) or {}).values())
+        ),
+        "convergence": g("convergence"),
         "cost": cost,
         "roofline": g("roofline"),
         "predicted_s": g("predicted_s"),
@@ -139,13 +147,22 @@ class CostModel:
       bytes_per_edge_row / flops_per_edge_row — analytic density
         (median), used to extrapolate analytic costs to a prospective
         shape.
+      s_per_edge_row_iter / median_iterations — the ITERATIONS term
+        (ISSUE 9): seconds per (batch x edges x iteration) unit, fitted
+        from records that carry ``iterations`` (solve records written
+        with the convergence observatory on; ``kind: "trajectory"``
+        records contribute iteration samples). An iterative route's
+        wall scales with iterations-to-converge — pure edge-row pricing
+        silently assumed every graph converges like the calibration
+        graph, which lies on high-diameter inputs. ``predict`` prefers
+        this basis whenever it is fitted.
 
     The per-unit seconds are the MINIMUM over the key's samples, not
     the median: timing noise is one-sided (compile time in a key's
     first record, scheduler contention) and only ever inflates, so the
     min is the steady-state cost — the same reason ``bench.py`` reports
     min-of-repeats. Densities are shape ratios, not timings, so they
-    take the median."""
+    take the median (iterations too — a count, not a timing)."""
 
     def __init__(self, entries: dict) -> None:
         self.entries = entries
@@ -155,11 +172,30 @@ class CostModel:
         """``source`` is a ProfileStore or a record list."""
         records = source.records() if hasattr(source, "records") else source
         samples: dict[tuple, dict] = {}
+
+        def bucket(route, platform):
+            return samples.setdefault(
+                (route, platform),
+                {"s_edge_row": [], "s_byte": [], "s_flop": [],
+                 "bytes_er": [], "flops_er": [], "compute": [],
+                 "s_er_iter": [], "iterations": []},
+            )
+
         for r in records:
-            if r.get("kind") not in (None, "solve", "bench", "offchip"):
-                continue
             route = r.get("route")
             platform = r.get("platform")
+            if r.get("kind") == "trajectory":
+                # Per-iteration trajectory records carry no measured
+                # wall of their own — they contribute iteration
+                # samples to the key's median_iterations only.
+                iters = (r.get("summary") or {}).get("iterations")
+                if route and platform and iters:
+                    bucket(route, platform)["iterations"].append(
+                        int(iters)
+                    )
+                continue
+            if r.get("kind") not in (None, "solve", "bench", "offchip"):
+                continue
             measured = r.get("measured") or {}
             compute = measured.get("compute_s") or measured.get("wall_s")
             edges = r.get("edges") or 0
@@ -169,13 +205,13 @@ class CostModel:
             edge_rows = float(batch) * float(edges)
             if edge_rows <= 0:
                 continue
-            s = samples.setdefault(
-                (route, platform),
-                {"s_edge_row": [], "s_byte": [], "s_flop": [],
-                 "bytes_er": [], "flops_er": [], "compute": []},
-            )
+            s = bucket(route, platform)
             s["s_edge_row"].append(compute / edge_rows)
             s["compute"].append(compute)
+            iters = r.get("iterations")
+            if iters and iters > 0:
+                s["iterations"].append(int(iters))
+                s["s_er_iter"].append(compute / (edge_rows * iters))
             cost = r.get("cost") or {}
             by = cost.get("bytes_accessed")
             fl = cost.get("flops")
@@ -187,6 +223,8 @@ class CostModel:
                 s["flops_er"].append(fl / edge_rows)
         entries = {}
         for key, s in samples.items():
+            if not s["s_edge_row"]:
+                continue  # iteration-only samples cannot price a route
             entries[key] = {
                 "route": key[0],
                 "platform": key[1],
@@ -197,6 +235,10 @@ class CostModel:
                 "bytes_per_edge_row": _median(s["bytes_er"]),
                 "flops_per_edge_row": _median(s["flops_er"]),
                 "median_compute_s": _median(s["compute"]),
+                "s_per_edge_row_iter": (
+                    min(s["s_er_iter"]) if s["s_er_iter"] else None
+                ),
+                "median_iterations": _median(s["iterations"]),
             }
         return cls(entries)
 
@@ -214,12 +256,23 @@ class CostModel:
         *,
         num_edges: int | None = None,
         platform: str | None = None,
+        iterations: int | None = None,
     ) -> dict | None:
         """Price a prospective ``(route, graph, B)`` solve from the
         calibration. ``graph`` may be a CSRGraph (its
         ``num_real_edges`` is used) or omitted in favor of
         ``num_edges``. None when the model has no data for the key —
-        an unpriced route must read as unpriced, not free."""
+        an unpriced route must read as unpriced, not free.
+
+        ``iterations``: expected iterations-to-converge (a diameter
+        estimate, or a measured trajectory's count). When the key has a
+        fitted per-iteration calibration the prediction becomes
+        ``s_per_edge_row_iter x edge_rows x iterations`` (basis
+        ``"s_per_edge_row_iter"``) — with ``iterations=None`` the key's
+        observed ``median_iterations`` stands in, so iterative routes
+        are priced by how long they iterate, not by one solve-level
+        wall (ISSUE 9 satellite; keeps the dispatch registry honest on
+        high-diameter graphs)."""
         if num_edges is None and graph is not None:
             num_edges = int(
                 getattr(graph, "num_real_edges", 0)
@@ -233,6 +286,13 @@ class CostModel:
         edge_rows = float(batch) * float(num_edges)
         predicted = e["s_per_edge_row"] * edge_rows
         basis = "s_per_edge_row"
+        iters = (
+            iterations if iterations is not None
+            else e.get("median_iterations")
+        )
+        if e.get("s_per_edge_row_iter") and iters:
+            predicted = e["s_per_edge_row_iter"] * edge_rows * float(iters)
+            basis = "s_per_edge_row_iter"
         # Analytic pricing when the key's capture succeeded: extrapolate
         # bytes by density, then apply the measured seconds-per-byte —
         # the same number by construction on in-sample shapes, but it
@@ -244,7 +304,7 @@ class CostModel:
         if e.get("flops_per_edge_row") and e.get("s_per_flop"):
             analytic["flops"] = e["flops_per_edge_row"] * edge_rows
             analytic["flop_s"] = analytic["flops"] * e["s_per_flop"]
-        return {
+        out = {
             "route": route,
             "platform": e["platform"],
             "predicted_s": predicted,
@@ -252,6 +312,9 @@ class CostModel:
             "n": e["n"],
             **analytic,
         }
+        if basis == "s_per_edge_row_iter":
+            out["iterations"] = float(iters)
+        return out
 
     def table(self) -> list[dict]:
         """The priced route table (``cli info`` / cost_report): one row

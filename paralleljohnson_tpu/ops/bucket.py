@@ -134,6 +134,7 @@ def step_model_seconds(
 def bellman_ford_bucketed(
     dist0, src, dst, w, indptr, delta, *, max_steps: int, capacity: int,
     max_degree: int, num_real_edges: int, edge_chunk: int = 1 << 20,
+    traj_cap: int | None = None,
 ):
     """Fixpoint bucketed relaxation (B=1). See the module docstring.
 
@@ -150,7 +151,14 @@ def bellman_ford_bucketed(
     must hand to the full-sweep kernel to finish and certify (this is
     NOT a negative-cycle flag); the counter pair decodes via
     :func:`relax.examined_exact`.
-    """
+
+    ``traj_cap`` (ISSUE 9, ``observe.convergence``): a static row count
+    appends per-step trajectory buffers to the carry and the return —
+    ``(..., traj_counts, traj_resid)`` — recording each step's improved
+    vertices / labels / residual mass on device (zero host syncs; one
+    D2H after convergence). None (the default) compiles the EXACT loop
+    above — the disabled path is a distinct Python branch, so the
+    uninstrumented jaxpr cannot drift (asserted in tests)."""
     v = dist0.shape[0]
     indptr = jnp.asarray(indptr, jnp.int32)
     indptr_ext = jnp.concatenate([indptr, indptr[-1:]])
@@ -271,8 +279,39 @@ def bellman_ford_bucketed(
 
     active0 = jnp.isfinite(dist0)
     pending0 = jnp.zeros(v, bool)
-    dist, active, pending, steps, ex_hi, ex_lo = lax.while_loop(
-        cond, body,
-        (dist0, active0, pending0, jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+    if traj_cap is None:
+        dist, active, pending, steps, ex_hi, ex_lo = lax.while_loop(
+            cond, body,
+            (dist0, active0, pending0, jnp.int32(0), jnp.int32(0),
+             jnp.int32(0)),
+        )
+        return dist, steps, jnp.any(active) | jnp.any(pending), ex_hi, ex_lo
+
+    from paralleljohnson_tpu.observe.convergence import (
+        traj_init,
+        traj_record,
     )
-    return dist, steps, jnp.any(active) | jnp.any(pending), ex_hi, ex_lo
+
+    def cond_traj(state):
+        return cond(state[:6])
+
+    def body_traj(state):
+        d0 = state[0]
+        i = state[3]
+        counts, resid = state[6], state[7]
+        d, active, pending, i2, ex_hi, ex_lo = body(state[:6])
+        counts, resid = traj_record(counts, resid, i, d0, d)
+        return d, active, pending, i2, ex_hi, ex_lo, counts, resid
+
+    counts0, resid0 = traj_init(traj_cap)
+    dist, active, pending, steps, ex_hi, ex_lo, counts, resid = (
+        lax.while_loop(
+            cond_traj, body_traj,
+            (dist0, active0, pending0, jnp.int32(0), jnp.int32(0),
+             jnp.int32(0), counts0, resid0),
+        )
+    )
+    return (
+        dist, steps, jnp.any(active) | jnp.any(pending), ex_hi, ex_lo,
+        counts, resid,
+    )

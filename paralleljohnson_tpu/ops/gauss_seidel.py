@@ -62,9 +62,19 @@ INF = jnp.inf
 def _gs_engine(
     dist0, src_blk, dstl_blk, w_blk, *,
     vb: int, halo: int, max_outer: int, inner_cap: int,
+    traj_cap: int | None = None,
 ):
     """Shared fixpoint engine. dist0 is [NB*vb] (SSSP) or [NB*vb, B]
     (vertex-major fan-out); see the module docstring for the schedule.
+
+    ``traj_cap`` (ISSUE 9, ``observe.convergence``): a static row count
+    records each OUTER round's improved vertices / labels / residual
+    mass into device trajectory buffers appended to the carry and the
+    return — ``(..., traj_counts, traj_resid)``. Outer-round
+    granularity is the honest unit here (inner block fixpoints are the
+    round's implementation detail, like chunk order in the sweeps).
+    None (the default) compiles the EXACT pre-observatory loop — a
+    distinct Python branch, so the disabled jaxpr cannot drift.
 
     Returns (dist, outer_rounds, still_improving, iters_blk) where
     ``iters_blk`` is int32[NB] — each block's total inner iterations
@@ -167,17 +177,45 @@ def _gs_engine(
 
     changed0 = jnp.any(jnp.isfinite(dist0))
     all_dirty = jnp.ones(flags_len, bool)
-    dist, rounds, changed, _, iters_blk = lax.while_loop(
-        outer_cond, outer_body,
-        (dist0, jnp.int32(0), changed0, all_dirty,
-         jnp.zeros(nb, jnp.int32)),
+    if traj_cap is None:
+        dist, rounds, changed, _, iters_blk = lax.while_loop(
+            outer_cond, outer_body,
+            (dist0, jnp.int32(0), changed0, all_dirty,
+             jnp.zeros(nb, jnp.int32)),
+        )
+        return dist, rounds, changed, iters_blk
+
+    from paralleljohnson_tpu.observe.convergence import (
+        traj_init,
+        traj_record,
     )
-    return dist, rounds, changed, iters_blk
+
+    def outer_cond_traj(state):
+        return outer_cond(state[:5])
+
+    def outer_body_traj(state):
+        d0 = state[0]
+        r = state[1]
+        counts, resid = state[5], state[6]
+        d, r2, changed, c_bwd, iters_blk = outer_body(state[:5])
+        counts, resid = traj_record(
+            counts, resid, r, d0, d, batch_axis=1 if batched else None
+        )
+        return d, r2, changed, c_bwd, iters_blk, counts, resid
+
+    counts0, resid0 = traj_init(traj_cap)
+    dist, rounds, changed, _, iters_blk, counts, resid = lax.while_loop(
+        outer_cond_traj, outer_body_traj,
+        (dist0, jnp.int32(0), changed0, all_dirty,
+         jnp.zeros(nb, jnp.int32), counts0, resid0),
+    )
+    return dist, rounds, changed, iters_blk, counts, resid
 
 
 def sssp_gs_blocks(
     dist0, src_blk, dstl_blk, w_blk, *,
     vb: int, halo: int, max_outer: int, inner_cap: int = 64,
+    traj_cap: int | None = None,
 ):
     """Blocked Gauss-Seidel SSSP on a bandwidth-reduced, block-bucketed
     edge layout (build with :func:`build_gs_layout`).
@@ -194,18 +232,21 @@ def sssp_gs_blocks(
     halo: static bound on |block(src) - block(dst)| over all edges (from
       the layout builder) — the dirty-window radius.
 
-    Returns (dist, outer_rounds, still_improving, iters_blk); see
+    Returns (dist, outer_rounds, still_improving, iters_blk) — plus
+    ``(traj_counts, traj_resid)`` when ``traj_cap`` is set; see
     :func:`_gs_engine` for the exact work-accounting contract.
     """
     return _gs_engine(
         dist0, src_blk, dstl_blk, w_blk,
         vb=vb, halo=halo, max_outer=max_outer, inner_cap=inner_cap,
+        traj_cap=traj_cap,
     )
 
 
 def fanout_gs_blocks(
     dist0_vm, src_blk, dstl_blk, w_blk, *,
     vb: int, halo: int, max_outer: int, inner_cap: int = 64,
+    traj_cap: int | None = None,
 ):
     """Multi-source variant of :func:`sssp_gs_blocks`: dist [NB*vb, B]
     vertex-major, same blocked layout. This is the fan-out answer to the
@@ -215,33 +256,40 @@ def fanout_gs_blocks(
     work (clean windows are skipped exactly) — with every op a
     contiguous [Em, B] tile, no scatter, no nonzero.
 
-    Returns (dist_vm, outer_rounds, still_improving, iters_blk); callers
+    Returns (dist_vm, outer_rounds, still_improving, iters_blk) — plus
+    ``(traj_counts, traj_resid)`` when ``traj_cap`` is set; callers
     multiply by per-block real edges AND the batch width B host-side.
     """
     return _gs_engine(
         dist0_vm, src_blk, dstl_blk, w_blk,
         vb=vb, halo=halo, max_outer=max_outer, inner_cap=inner_cap,
+        traj_cap=traj_cap,
     )
 
 
 def fanout_gs_body(
     srcs, src_blk, dstl_blk, w_blk, rank, *,
     v_pad: int, vb: int, halo: int, max_outer: int, inner_cap: int,
+    traj_cap: int | None = None,
 ):
     """Per-device fan-out body shared by the single-device jit kernel
     (``jax_backend._gs_fanout_kernel``) and the shard_map'ed sharded
     route (``parallel.mesh``): dist0 seeded at ``rank[srcs]``, blocked
     engine, unpermute back to ORIGINAL labels. One implementation so the
     two routes can never drift. Returns (dist [B, V], rounds,
-    still_improving, iters_blk)."""
+    still_improving, iters_blk) — plus ``(traj_counts, traj_resid)``
+    when ``traj_cap`` is set (frontier counts are label-invariant, so
+    recording in relabeled ids is exact)."""
     b = srcs.shape[0]
     dist0 = jnp.full((v_pad, b), jnp.inf, w_blk.dtype)
     dist0 = dist0.at[rank[srcs], jnp.arange(b)].set(0.0)
-    dist, rounds, improving, iters_blk = fanout_gs_blocks(
+    out = fanout_gs_blocks(
         dist0, src_blk, dstl_blk, w_blk,
         vb=vb, halo=halo, max_outer=max_outer, inner_cap=inner_cap,
+        traj_cap=traj_cap,
     )
-    return dist[rank, :].T, rounds, improving, iters_blk
+    dist, rounds, improving, iters_blk = out[:4]
+    return (dist[rank, :].T, rounds, improving, iters_blk, *out[4:])
 
 
 def build_gs_layout(

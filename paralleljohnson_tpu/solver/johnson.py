@@ -573,6 +573,50 @@ class ParallelJohnsonSolver:
         )
         return result
 
+    def _emit_trajectory(self, res, *, stage: str, batch=None) -> None:
+        """One ``trajectory`` flight event + heartbeat push per
+        instrumented kernel stage (ISSUE 9): the summary numbers, a
+        downsampled frontier-collapse curve (enough to replay the
+        shape from a dead run's JSONL — ``trace_summary.py
+        --convergence``), and the live ``iter``/``frontier_size``
+        heartbeat fields the TPU watchdog reads next to ``eta_s``.
+        No-op when the route carried no trajectory or telemetry is
+        off; never fatal."""
+        summ = getattr(res, "convergence", None)
+        if not summ or not self._tel:
+            return
+        try:
+            from paralleljohnson_tpu.observe.convergence import (
+                frontier_curve,
+            )
+
+            attrs = dict(
+                stage=stage,
+                route=res.route,
+                iterations=summ.get("iterations"),
+                frontier_half_life=summ.get("frontier_half_life"),
+                frontier_peak=summ.get("frontier_peak"),
+                frontier_last=summ.get("frontier_last"),
+                tail_fraction=round(
+                    float(summ.get("tail_fraction", 0.0)), 4
+                ),
+                jfr_skippable_edge_frac=round(
+                    float(summ.get("jfr_skippable_edge_frac", 0.0)), 4
+                ),
+            )
+            if batch is not None:
+                attrs["batch"] = batch
+            traj = getattr(res, "trajectory", None)
+            if traj is not None:
+                attrs["frontier_curve"] = frontier_curve(traj)
+            self._tel.event("trajectory", **attrs)
+            self._tel.note(
+                iter=summ.get("iterations"),
+                frontier_size=summ.get("frontier_last"),
+            )
+        except Exception:  # noqa: BLE001 — observability is never fatal
+            pass
+
     def _finish_observability(
         self, stats: SolverStats, graph: CSRGraph, batch: int, *,
         label: str,
@@ -640,6 +684,7 @@ class ParallelJohnsonSolver:
         # an event — trace_summary --by-route joins them back, keeping
         # flight recordings and cost profiles on one route vocabulary.
         self._tel.event("route", stage="bellman_ford", route=bf.route)
+        self._emit_trajectory(bf, stage="bellman_ford")
         if faults is not None:
             bf.dist = faults.poison_rows("bellman_ford", bf.dist)
         if bf.converged and not bf.negative_cycle:
@@ -769,6 +814,7 @@ class ParallelJohnsonSolver:
         pos = 0
         batch_idx = 0
         done = 0
+        t_solve0 = time.perf_counter()
         tel.progress(
             sources_total=n, sources_done=0, batches_done=0,
             current_batch_size=degrader.batch_size, pipeline_depth=depth,
@@ -779,7 +825,9 @@ class ParallelJohnsonSolver:
 
         def mark_done() -> None:
             """Heartbeat progress after one batch fully finalizes — the
-            liveness signal the TPU watcher keys stage deadlines off."""
+            liveness signal the TPU watcher keys stage deadlines off,
+            plus the trajectory-aware completion estimate (``eta_s``)
+            it extends fresh soft deadlines by (ISSUE 9)."""
             nonlocal done
             done += 1
             tel.progress(
@@ -789,6 +837,17 @@ class ParallelJohnsonSolver:
                 oom_degradations=stats.oom_degradations,
                 pipeline_depth=depth,
             )
+            if tel:
+                from paralleljohnson_tpu.observe.convergence import (
+                    estimate_eta,
+                )
+
+                remaining = -(-(n - pos) // max(degrader.batch_size, 1))
+                eta = estimate_eta(
+                    time.perf_counter() - t_solve0, done, remaining
+                )
+                if eta is not None:
+                    tel.note(eta_s=round(eta, 3))
 
         def run_finalize(bi, b, payload, resumed, parent=None):
             """One finalize, timed, through the resilience layer (stage
@@ -930,6 +989,7 @@ class ParallelJohnsonSolver:
                     "route", stage="fanout", batch=batch_idx,
                     route=res.route,
                 )
+                self._emit_trajectory(res, stage="fanout", batch=batch_idx)
                 if not res.converged:
                     raise ConvergenceError(
                         "fan-out hit max_iterations while still improving"

@@ -35,6 +35,30 @@ def warn_if_counter_wrapped(
         )
 
 
+def warn_if_traj_counter_wrapped(
+    batch: int, num_nodes: int, *, where: str
+) -> None:
+    """Addend wrap guard for the int32 convergence-trajectory counters
+    (``observe.convergence``): one iteration's ``relaxations_applied``
+    is bounded by batch x V distance labels, so the per-row int32 value
+    is exact while that bound stays below 2^31 — the same no-overflow
+    precondition the split examined counters of ``ops/bucket.py`` /
+    ``ops/relax.bellman_ford_frontier`` enforce on their per-round
+    addends. Shapes past the bound still record (the buffer write
+    cannot raise inside jit), but the counts become warned lower
+    bounds, never a silent lie. One implementation for every
+    instrumented route (the round-6 shared-guard standard)."""
+    if int(batch) * int(num_nodes) >= 1 << 31:
+        warnings.warn(
+            f"{where}: trajectory counter addend batch x V = "
+            f"{int(batch)} x {int(num_nodes)} >= 2^31: frontier_size / "
+            "relaxations_applied may have wrapped — treat the "
+            "trajectory as a lower bound, not exact",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
 @dataclasses.dataclass
 class SolverStats:
     """Accumulated per-solve instrumentation.
@@ -87,6 +111,18 @@ class SolverStats:
       solve's route/shape, made BEFORE this run's record landed (None
       without a store or calibration) — prediction vs ``compute_seconds``
       is the cost model's running accuracy check.
+    convergence: per-phase trajectory summaries (ISSUE 9,
+      ``observe.convergence.summarize_trajectory``): iterations,
+      frontier half-life, tail-iteration fraction (frontier < 1% of V
+      — the JFR opportunity number), estimated JFR-skippable edge
+      fraction. None when the convergence observatory is off (no
+      telemetry / profile store configured) or the resolved route is
+      not trajectory-instrumented.
+    trajectories: the raw decoded per-iteration arrays behind those
+      summaries, keyed by phase (one ``[n, 3]`` array per kernel call;
+      a multi-batch fan-out lands one per batch). Deliberately NOT in
+      ``as_dict`` — the curves go to the profile store
+      (``observe.finalize_solve``), not into every stats line.
     """
 
     phase_seconds: dict = dataclasses.field(
@@ -112,6 +148,8 @@ class SolverStats:
     analytic_cost: dict | None = None
     roofline: dict | None = None
     predicted_s: float | None = None
+    convergence: dict | None = None
+    trajectories: dict = dataclasses.field(default_factory=dict, repr=False)
 
     def accumulate(self, result, phase: str) -> None:
         """Fold one KernelResult into the totals."""
@@ -119,6 +157,7 @@ class SolverStats:
         self.edges_relaxed_by_phase[phase] += int(result.edges_relaxed)
         self.iterations_by_phase[phase] += int(result.iterations)
         self._accumulate_cost(getattr(result, "cost", None))
+        self._accumulate_trajectory(result, phase)
         route = getattr(result, "route", None)
         if route:
             # A phase can change route mid-solve (e.g. an auto route degrades
@@ -131,6 +170,25 @@ class SolverStats:
                 self.routes_by_phase[phase] = route
             elif route not in prev.split("+"):
                 self.routes_by_phase[phase] = prev + "+" + route
+
+    def _accumulate_trajectory(self, result, phase: str) -> None:
+        """Fold one KernelResult's convergence trajectory (ISSUE 9):
+        the raw curve joins ``trajectories[phase]`` (the profile-store
+        payload) and the backend-computed summary merges into
+        ``convergence[phase]`` (batches / iterations_total accumulate
+        across a multi-batch fan-out)."""
+        traj = getattr(result, "trajectory", None)
+        if traj is not None:
+            self.trajectories.setdefault(phase, []).append(traj)
+        summ = getattr(result, "convergence", None)
+        if summ:
+            from paralleljohnson_tpu.observe.convergence import (
+                merge_summaries,
+            )
+
+            conv = self.convergence if self.convergence is not None else {}
+            conv[phase] = merge_summaries(conv.get(phase), summ)
+            self.convergence = conv
 
     def _accumulate_cost(self, cost: dict | None) -> None:
         """Fold one KernelResult's compiled-cost capture. Every CAPTURED
@@ -207,6 +265,7 @@ class SolverStats:
             "analytic_cost": self.analytic_cost,
             "roofline": self.roofline,
             "predicted_s": self.predicted_s,
+            "convergence": self.convergence,
             "total_seconds": self.total_seconds,
             "edges_relaxed_per_sec": self.edges_relaxed_per_second(),
         }

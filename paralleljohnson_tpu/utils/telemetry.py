@@ -419,6 +419,17 @@ class HeartbeatReporter:
         with self._lock:
             self._state.update(fields)
 
+    def note(self, **fields) -> None:
+        """Merge live-progress fields from the SOLVE thread(s) — the
+        convergence observatory's channel for ``iter`` /
+        ``frontier_size`` / ``eta_s`` (ISSUE 9). Same lock-protected
+        dict merge as :meth:`update` (the writer thread serializes a
+        copy under the same lock, so a half-merged batch of fields can
+        never be published — the atomicity the telemetry tests pin);
+        a distinct name so call sites read as "push a fact", not
+        "rewrite the file"."""
+        self.update(**fields)
+
     def payload(self) -> dict:
         with self._lock:
             state = dict(self._state)
@@ -654,6 +665,14 @@ class Telemetry:
         if self.heartbeat is not None:
             self.heartbeat.update(**fields)
 
+    def note(self, **fields) -> None:
+        """The solver-side push channel for convergence facts (``iter``
+        / ``frontier_size`` / ``eta_s`` — ISSUE 9): a lock-protected
+        merge into the heartbeat state (``HeartbeatReporter.note``),
+        safe against the sampler thread. No-op without a heartbeat."""
+        if self.heartbeat is not None:
+            self.heartbeat.note(**fields)
+
     def current_span_id(self) -> int | None:
         return self.tracer.current_span_id()
 
@@ -713,6 +732,9 @@ class _NullTelemetry:
         return None
 
     def progress(self, **fields):
+        return None
+
+    def note(self, **fields):
         return None
 
     def current_span_id(self):

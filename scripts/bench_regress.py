@@ -150,11 +150,25 @@ def main(argv: list[str] | None = None) -> int:
         print(f"bench-regress: graded {graded} row(s) against "
               f"{len(history)} history row(s), band {args.band:.0%}")
         for f in flagged:
-            print(
-                f"  REGRESSION {f['bench']} [{f['backend']}/"
-                f"{f['platform']}"
+            key = (
+                f"{f['bench']} [{f['backend']}/{f['platform']}"
                 + (f"/{f['preset']}" if f.get("preset") else "")
-                + f"]: {f['wall_s']:.4f}s vs median "
+                + "]"
+            )
+            if f.get("kind") == "iterations":
+                # Convergence regression (ISSUE 9): the route iterated
+                # longer to converge — a perf bug even when the wall
+                # stayed inside its (wider) noise band.
+                print(
+                    f"  REGRESSION (iterations) {key}: "
+                    f"{f['iterations']} iter vs median "
+                    f"{f['baseline_iterations']:.0f} over "
+                    f"{f['history_n']} runs ({f['slowdown']:.2f}x) — "
+                    f"roofline: {f['roofline_bound']}"
+                )
+                continue
+            print(
+                f"  REGRESSION {key}: {f['wall_s']:.4f}s vs median "
                 f"{f['baseline_s']:.4f}s over {f['history_n']} runs "
                 f"({f['slowdown']:.2f}x) — roofline: "
                 f"{f['roofline_bound']}"

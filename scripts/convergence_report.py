@@ -1,0 +1,457 @@
+#!/usr/bin/env python
+"""Convergence-observatory reader (ISSUE 9) — render frontier-collapse
+curves and the JFR evidence from recorded trajectories.
+
+Input is either side of the observatory's persistence:
+
+  - a profile store (``--profile-store`` / ``PJ_PROFILE_DIR`` dirs):
+    ``kind: "trajectory"`` records carry the FULL per-iteration curve
+    (frontier_size, relaxations_applied, residual_mass);
+  - a flight-recorder JSONL (or a ``--trace-dir`` directory of them):
+    ``trajectory`` events carry the summary + a downsampled
+    ``frontier_curve`` — enough to render the collapse shape from a
+    dead run.
+
+Output: one summary line + ASCII collapse curve per trajectory
+(``--json OUT`` additionally dumps the machine-readable curves).
+
+``--evidence OUT.md`` (requires jax) measures the JFR opportunity
+(ROADMAP item 4) instead of reading old records: it solves the
+``dimacs_ny_scrambled`` and rmat graphs with the observatory on, takes
+the full-sweep trajectory, and VALIDATES the trajectory's
+uniform-degree ``jfr_skippable_edge_frac`` estimate against the exact
+examined-edge counters of the real frontier kernel on the same graph —
+the measured fraction of full-sweep edge examinations a
+frontier-compacted schedule actually skips.
+
+Usage:
+  python scripts/convergence_report.py bench_artifacts/profiles
+  python scripts/convergence_report.py flight-solve.jsonl --json curves.json
+  python scripts/convergence_report.py --evidence \\
+      bench_artifacts/convergence_evidence.md --preset mini
+
+Stdlib-only for the readers (no jax, no package import) — safe on a
+log-analysis box; only ``--evidence`` imports the solver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+
+# Preset sizes mirror benchmarks._SIZES for the two evidence configs.
+_EVIDENCE_SIZES = {
+    "quick": dict(rows=24, scale=8),
+    "mini": dict(rows=96, scale=12),
+    "full": dict(rows=515, scale=16),
+}
+
+
+# -- loading -----------------------------------------------------------------
+
+
+def _read_jsonl(path: Path) -> list[dict]:
+    out = []
+    lines = path.read_text(encoding="utf-8").splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                continue  # torn trailing line: kill damage, tolerated
+            raise ValueError(f"{path}: corrupt record at line {i + 1}")
+    return out
+
+
+def _from_profile_records(records: list[dict], source: str) -> list[dict]:
+    out = []
+    for r in records:
+        if r.get("kind") != "trajectory":
+            continue
+        curve = [row[0] for row in (r.get("trajectory") or [])]
+        out.append({
+            "source": source,
+            "label": r.get("label"),
+            "phase": r.get("phase"),
+            "batch_index": r.get("batch_index"),
+            "route": r.get("route"),
+            "platform": r.get("platform"),
+            "nodes": r.get("nodes"),
+            "edges": r.get("edges"),
+            "batch": r.get("batch"),
+            "summary": r.get("summary") or {},
+            "frontier_curve": curve,
+            "full_resolution": True,
+        })
+    return out
+
+
+def _from_flight_records(records: list[dict], source: str) -> list[dict]:
+    out = []
+    for r in records:
+        if r.get("type") != "event" or r.get("name") != "trajectory":
+            continue
+        a = dict(r.get("attrs") or {})
+        out.append({
+            "source": source,
+            "t": r.get("t"),
+            "label": a.get("stage"),
+            "phase": a.get("stage"),
+            "batch_index": a.get("batch"),
+            "route": a.get("route"),
+            "summary": {
+                k: a.get(k)
+                for k in (
+                    "iterations", "frontier_half_life", "frontier_peak",
+                    "frontier_last", "tail_fraction",
+                    "jfr_skippable_edge_frac",
+                )
+                if a.get(k) is not None
+            },
+            "frontier_curve": a.get("frontier_curve") or [],
+            # Flight events carry the head-biased downsample, not every
+            # iteration — the shape, not the ledger.
+            "full_resolution": False,
+        })
+    return out
+
+
+def load_trajectories(path: str | Path) -> list[dict]:
+    """Trajectories from a profile store dir / profiles.jsonl, a flight
+    JSONL, or a directory of flight-*.jsonl files — whichever ``path``
+    turns out to be."""
+    p = Path(path)
+    out: list[dict] = []
+    if p.is_dir():
+        prof = p / "profiles.jsonl"
+        if prof.exists():
+            out.extend(_from_profile_records(_read_jsonl(prof), str(prof)))
+        for f in sorted(p.glob("flight-*.jsonl")):
+            out.extend(_from_flight_records(_read_jsonl(f), str(f)))
+        return out
+    records = _read_jsonl(p)
+    # One file: profile records and flight records are distinguishable
+    # by shape (kind= vs type=) — accept either in the same file read.
+    out.extend(_from_profile_records(records, str(p)))
+    out.extend(_from_flight_records(records, str(p)))
+    return out
+
+
+# -- rendering ---------------------------------------------------------------
+
+
+def ascii_curve(
+    curve: list, *, width: int = 64, height: int = 8
+) -> list[str]:
+    """Frontier-collapse curve as ``height`` rows of '#' columns
+    (pure ASCII — renders anywhere a dead run's logs get read).
+    Columns downsample to ``width`` by max-pooling (a collapse must
+    never be hidden by the sampling)."""
+    vals = [max(0.0, float(v)) for v in curve]
+    if not vals:
+        return ["  (empty trajectory)"]
+    if len(vals) > width:
+        pooled = []
+        for c in range(width):
+            lo = c * len(vals) // width
+            hi = max(lo + 1, (c + 1) * len(vals) // width)
+            pooled.append(max(vals[lo:hi]))
+        vals = pooled
+    peak = max(vals) or 1.0
+    rows = []
+    for level in range(height, 0, -1):
+        cut = peak * (level - 0.5) / height
+        line = "".join("#" if v >= cut else " " for v in vals)
+        label = f"{peak * level / height:10.0f} |"
+        rows.append(label + line)
+    rows.append(" " * 10 + "+" + "-" * len(vals))
+    rows.append(
+        " " * 11 + f"iteration 0..{len(curve) - 1}  (frontier size/iter, "
+        "max-pooled)"
+    )
+    return rows
+
+
+def summary_line(t: dict) -> str:
+    s = t.get("summary") or {}
+    who = t.get("label") or "?"
+    phase = t.get("phase")
+    if phase and phase != who:
+        who += f"/{phase}"
+    if t.get("batch_index") is not None:
+        who += f"[{t['batch_index']}]"
+    parts = [
+        f"{who} route={t.get('route') or '?'}",
+        f"iters={s.get('iterations', '?')}",
+        f"half-life={s.get('frontier_half_life', '?')}",
+        f"peak={s.get('frontier_peak', '?')}",
+        f"tail={float(s.get('tail_fraction') or 0.0):.0%}",
+        f"jfr-skippable~{float(s.get('jfr_skippable_edge_frac') or 0.0):.0%}",
+    ]
+    return "  ".join(parts)
+
+
+def print_report(trajs: list[dict], *, curves: bool = True,
+                 out=sys.stdout) -> None:
+    if not trajs:
+        print("no trajectories found — was the convergence observatory "
+              "on? (--convergence true, or any telemetry/profile sink)",
+              file=out)
+        return
+    print(f"{len(trajs)} trajectory record(s)", file=out)
+    for t in trajs:
+        print("\n" + summary_line(t), file=out)
+        if curves and t.get("frontier_curve"):
+            res = "" if t.get("full_resolution") else \
+                "  (downsampled flight curve)"
+            if res:
+                print(res, file=out)
+            for row in ascii_curve(t["frontier_curve"]):
+                print(row, file=out)
+
+
+# -- the JFR evidence (measures, requires jax) -------------------------------
+
+
+def _evidence_graphs(preset: str):
+    from paralleljohnson_tpu.graphs import grid2d, permute_labels, rmat
+
+    sz = _EVIDENCE_SIZES[preset]
+    rows = sz["rows"]
+    yield (
+        "dimacs_ny_scrambled",
+        permute_labels(
+            grid2d(rows, rows, negative_fraction=0.2, seed=7), seed=11
+        ),
+        f"grid2d {rows}x{rows} (neg 20%), labels permuted — the honest "
+        "DIMACS proxy (auto declines DIA on it)",
+    )
+    yield (
+        f"rmat_s{sz['scale']}",
+        rmat(sz["scale"], 16, seed=42),
+        f"RMAT scale {sz['scale']}, avg degree 16 — the skewed-degree "
+        "contrast case",
+    )
+
+
+def measure_config(name: str, g, note: str) -> dict:
+    """One config's evidence: the full-sweep trajectory (observatory
+    on, frontier/bucket/dia/gs declined so the SWEEP is what gets
+    measured) vs the exact examined-edge counter of the real frontier
+    kernel on the same graph — estimate and ground truth side by side."""
+    from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+
+    # Single device, every compacted/stencil route declined: the full
+    # SWEEP is the baseline JFR would improve, so the sweep is what the
+    # trajectory must measure.
+    sweep_off = dict(
+        frontier=False, bucket=False, dia=False, gauss_seidel=False,
+        edge_shard=False, mesh_shape=(1,),
+    )
+    solver = ParallelJohnsonSolver(SolverConfig(
+        backend="jax", convergence=True, **sweep_off,
+    ))
+    t0 = time.perf_counter()
+    res = solver.sssp(g, 0)
+    sweep_wall = time.perf_counter() - t0
+    conv = dict(res.stats.convergence or {})
+    phase = "bellman_ford" if "bellman_ford" in conv else (
+        next(iter(conv), None)
+    )
+    summ = conv.get(phase, {})
+    trajs = (res.stats.trajectories or {}).get(phase) or []
+    curve = [int(r[0]) for r in trajs[0]] if len(trajs) else []
+    sweep_examined = int(res.stats.edges_relaxed)
+
+    # Ground truth: the frontier kernel relaxes ONLY the out-edges of
+    # vertices whose label changed — its split-int32 exact counter is
+    # the real examined-edge ledger of a JFR-style schedule.
+    frontier_solver = ParallelJohnsonSolver(SolverConfig(
+        backend="jax", frontier=True, bucket=False, dia=False,
+        gauss_seidel=False, edge_shard=False, mesh_shape=(1,),
+    ))
+    t0 = time.perf_counter()
+    fres = frontier_solver.sssp(g, 0)
+    frontier_wall = time.perf_counter() - t0
+    frontier_examined = int(fres.stats.edges_relaxed)
+    import numpy as np
+
+    assert np.array_equal(
+        np.asarray(res.dist), np.asarray(fres.dist)
+    ), f"{name}: frontier distances diverge from sweep distances"
+
+    measured_skip = (
+        1.0 - frontier_examined / sweep_examined if sweep_examined else 0.0
+    )
+    return {
+        "config": name,
+        "note": note,
+        "nodes": g.num_nodes,
+        "edges": g.num_real_edges,
+        "route": (res.stats.routes_by_phase or {}).get(phase),
+        "iterations": summ.get("iterations"),
+        "frontier_peak": summ.get("frontier_peak"),
+        "frontier_half_life": summ.get("frontier_half_life"),
+        "tail_iterations": summ.get("tail_iterations"),
+        "tail_fraction": summ.get("tail_fraction"),
+        "estimate_skippable_frac": summ.get("jfr_skippable_edge_frac"),
+        "sweep_examined_edges": sweep_examined,
+        "frontier_examined_edges": frontier_examined,
+        "measured_skippable_frac": measured_skip,
+        "sweep_wall_s": sweep_wall,
+        "frontier_wall_s": frontier_wall,
+        "frontier_curve": curve,
+    }
+
+
+def write_evidence(path: str | Path, preset: str) -> list[dict]:
+    rows = [measure_config(*spec) for spec in _evidence_graphs(preset)]
+    import paralleljohnson_tpu.observe as observe
+
+    lines = [
+        "# Convergence evidence — the frontier collapse, measured "
+        "(ISSUE 9)",
+        "",
+        f"Generated by `scripts/convergence_report.py --evidence` "
+        f"(preset `{preset}`, platform "
+        f"`{observe.current_platform()}`).",
+        "",
+        "ROADMAP item 4 (JFR frontier compaction, per PAPERS.md "
+        "\"JFR: An Efficient Jump Frontier Relaxation Strategy for "
+        "Bellman-Ford\") is premised on the active frontier collapsing "
+        "in late iterations, leaving full sweeps re-examining every "
+        "edge to improve almost nothing. This artifact measures that "
+        "premise two ways on each config and checks them against each "
+        "other:",
+        "",
+        "- **estimate**: the trajectory's uniform-degree "
+        "`jfr_skippable_edge_frac` — `1 - sum(frontier_i) / "
+        "(iterations x V)` from the on-device per-iteration counters;",
+        "- **measured**: `1 - frontier_examined / sweep_examined` from "
+        "the exact split-int32 examined-edge counters of the real "
+        "frontier kernel vs the full sweep on the same graph, "
+        "distances bitwise-checked equal.",
+        "",
+    ]
+    for r in rows:
+        lines += [
+            f"## {r['config']}",
+            "",
+            f"{r['note']}. V = {r['nodes']:,}, E = {r['edges']:,}, "
+            f"sweep route `{r['route']}`.",
+            "",
+            "| metric | value |",
+            "|---|---|",
+            f"| sweep iterations | {r['iterations']} |",
+            f"| frontier peak | {r['frontier_peak']:,} vertices |",
+            f"| frontier half-life | iteration "
+            f"{r['frontier_half_life']} of {r['iterations']} |",
+            f"| tail iterations (frontier < 1% of V) | "
+            f"{r['tail_iterations']} ({r['tail_fraction']:.0%}) |",
+            f"| full-sweep examined edges | "
+            f"{r['sweep_examined_edges']:,} |",
+            f"| frontier-schedule examined edges (exact) | "
+            f"{r['frontier_examined_edges']:,} |",
+            f"| **JFR-skippable, measured** | "
+            f"**{r['measured_skippable_frac']:.1%}** |",
+            f"| JFR-skippable, trajectory estimate | "
+            f"{r['estimate_skippable_frac']:.1%} |",
+            f"| sweep wall | {r['sweep_wall_s'] * 1e3:.1f} ms |",
+            f"| frontier wall | {r['frontier_wall_s'] * 1e3:.1f} ms |",
+            "",
+            "```",
+            *ascii_curve(r["frontier_curve"]),
+            "```",
+            "",
+        ]
+    est = [r for r in rows if r["estimate_skippable_frac"] is not None]
+    lines += [
+        "## Reading",
+        "",
+        "The measured number is the JFR opportunity: the fraction of "
+        "the sweep's edge examinations a frontier-compacted schedule "
+        "provably does not need (the frontier kernel's counter is "
+        "exact, and its distances are bitwise those of the sweep). The "
+        "uniform-degree estimate from the trajectory "
+        + (
+            "tracks it within "
+            + f"{max(abs(r['estimate_skippable_frac'] - r['measured_skippable_frac']) for r in est):.1%} "  # noqa: E501
+            "here"
+            if est else "is unavailable here"
+        )
+        + " — close enough that the on-device counters (zero extra "
+        "host syncs) can stand in for the full instrumented comparison "
+        "when sizing JFR work, and biased exactly where skewed degree "
+        "distributions say it should be (the estimate prices frontier "
+        "vertices at average degree).",
+        "",
+    ]
+    Path(path).write_text("\n".join(lines), encoding="utf-8")
+    return rows
+
+
+# -- cli ---------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render frontier-collapse curves from recorded "
+        "trajectories, or measure the JFR evidence (--evidence)"
+    )
+    ap.add_argument("source", nargs="?", default=None,
+                    help="profile store dir / profiles.jsonl / "
+                         "flight JSONL / trace dir")
+    ap.add_argument("--no-curves", action="store_true",
+                    help="summary lines only (no ASCII curves)")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="also dump the trajectories as JSON")
+    ap.add_argument("--evidence", default=None, metavar="OUT.md",
+                    help="measure the JFR evidence (solves the "
+                         "dimacs_ny_scrambled + rmat configs; needs "
+                         "jax) and write the markdown artifact here")
+    ap.add_argument("--preset", default="quick",
+                    choices=sorted(_EVIDENCE_SIZES),
+                    help="evidence graph sizes (default quick)")
+    args = ap.parse_args(argv)
+
+    if args.evidence:
+        sys.path.insert(0, str(_REPO))
+        rows = write_evidence(args.evidence, args.preset)
+        for r in rows:
+            print(
+                f"{r['config']}: measured JFR-skippable "
+                f"{r['measured_skippable_frac']:.1%} "
+                f"(estimate {r['estimate_skippable_frac']:.1%}), "
+                f"half-life {r['frontier_half_life']}/{r['iterations']}"
+            )
+        print(f"wrote {args.evidence}")
+        return 0
+
+    if args.source is None:
+        print("convergence_report: give a profile store / flight "
+              "source, or --evidence", file=sys.stderr)
+        return 2
+    try:
+        trajs = load_trajectories(args.source)
+    except (OSError, ValueError) as e:
+        print(f"convergence_report: cannot read {args.source}: {e}",
+              file=sys.stderr)
+        return 2
+    print_report(trajs, curves=not args.no_curves)
+    if args.json:
+        Path(args.json).write_text(
+            json.dumps(trajs, indent=2), encoding="utf-8"
+        )
+        print(f"\nwrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,22 @@ hb_age() {  # seconds since the heartbeat file was last rewritten
   echo $(( $(date +%s) - mtime ))
 }
 
+hb_eta() {  # the heartbeat's trajectory-aware completion estimate
+  # (integer seconds; empty when the solve has not published eta_s yet —
+  # the convergence observatory fits it from completed batches, so it
+  # only exists once there is evidence). Lets the soft-deadline
+  # extension below be a real completion estimate instead of a blind
+  # half-budget step.
+  python3 - "$PJ_HEARTBEAT_FILE" 2>/dev/null <<'PYEOF'
+import json, sys
+try:
+    eta = json.load(open(sys.argv[1])).get("eta_s")
+    print(int(float(eta)) if eta is not None else "")
+except Exception:
+    print("")
+PYEOF
+}
+
 FAILED_STAGES=""
 run() {  # run <seconds> <label> <cmd...>
   # Each stage gets up to 3 attempts with 30s/60s backoff: a nonzero
@@ -66,7 +82,7 @@ run() {  # run <seconds> <label> <cmd...>
   # steps up to a 3x hard cap; a stale heartbeat kills immediately. This
   # is the hung-vs-progressing distinction every previous round lacked.
   local t=$1 label=$2 rc attempt; shift 2
-  local hard_cap=$((t * 3)) stage_log pid start elapsed deadline age
+  local hard_cap=$((t * 3)) stage_log pid start elapsed deadline age eta
   for attempt in 1 2 3; do
     echo "=== $label (attempt $attempt) ===" | tee -a "$LOG"
     stage_log=$(mktemp)
@@ -81,8 +97,18 @@ run() {  # run <seconds> <label> <cmd...>
       if [ "$elapsed" -ge "$deadline" ]; then
         age=$(hb_age)
         if [ "$age" -lt "$HB_STALE_S" ] && [ "$elapsed" -lt "$hard_cap" ]; then
-          deadline=$((elapsed + t / 2 + 1))
-          echo "--- $label: soft deadline hit but heartbeat is ${age}s fresh; extending to ${deadline}s (cap ${hard_cap}s) ---" | tee -a "$LOG"
+          # Prefer the heartbeat's published ETA (convergence
+          # observatory: remaining-batches x seconds-per-batch fitted
+          # from the live trajectory) over the blind half-budget step;
+          # +25% margin, still bounded by the 3x hard cap below.
+          eta=$(hb_eta)
+          if [ -n "$eta" ] && [ "$eta" -gt 0 ] 2>/dev/null; then
+            deadline=$((elapsed + eta + eta / 4 + 1))
+            echo "--- $label: soft deadline hit; heartbeat ${age}s fresh, eta_s=${eta}; extending to ${deadline}s (cap ${hard_cap}s) ---" | tee -a "$LOG"
+          else
+            deadline=$((elapsed + t / 2 + 1))
+            echo "--- $label: soft deadline hit but heartbeat is ${age}s fresh; extending to ${deadline}s (cap ${hard_cap}s) ---" | tee -a "$LOG"
+          fi
         else
           echo "--- $label: HUNG (heartbeat age ${age}s, elapsed ${elapsed}s/${hard_cap}s); killing ---" | tee -a "$LOG"
           kill -TERM "$pid" 2>/dev/null
@@ -202,6 +228,13 @@ run 1200 bench.py python bench.py
 #     whole pass's profile store (the round's attribution artifact)
 run 120 bench-regress python scripts/bench_regress.py --history "$PJ_PROFILE_DIR" --last 1
 run 120 cost-report python scripts/cost_report.py "$PJ_PROFILE_DIR"
+#     ... and the convergence observatory's views of the same pass: the
+#     frontier-collapse curves of every trajectory the stages recorded
+#     (profile store + preserved flight dirs), plus the on-chip JFR
+#     evidence artifact (ROADMAP item 4's opportunity, measured at TPU
+#     scale instead of the committed CPU quick numbers).
+run 120 convergence-report python scripts/convergence_report.py "$PJ_PROFILE_DIR"
+run 900 convergence-evidence python scripts/convergence_report.py --evidence bench_artifacts/convergence_evidence.md --preset full
 
 # 6) memory-guard probe (VERDICT #10): rmat-20 x 128 fan-out, default
 #    config, assert no OOM + record suggested_source_batch

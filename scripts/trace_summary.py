@@ -144,6 +144,64 @@ def print_route_table(records: list[dict], out=sys.stdout) -> None:
               file=out)
 
 
+def print_convergence(records: list[dict], out=sys.stdout) -> None:
+    """``--convergence``: the per-stage trajectory events (ISSUE 9)
+    joined into the span timeline — offline replay of a dead run shows
+    WHERE convergence stalled (a stage whose frontier stopped
+    collapsing), not just which span was open at death. Each event
+    carries the summary the solver emitted plus a downsampled
+    frontier-collapse sparkline rendered from ``frontier_curve``."""
+    spans = {s["id"]: s for s in build_spans(records)}
+    events = [
+        r for r in records
+        if r.get("type") == "event" and r.get("name") == "trajectory"
+    ]
+    print(f"\nconvergence trajectories ({len(events)}):", file=out)
+    if not events:
+        print("  (none — was the convergence observatory on? "
+              "--convergence true, or any telemetry/profile sink)",
+              file=out)
+        return
+    for e in events:
+        a = e.get("attrs") or {}
+        span = spans.get(e.get("span"))
+        stage = a.get("stage", "?")
+        batch = a.get("batch")
+        tag = f" batch={batch}" if batch is not None else ""
+        within = f" (in span {span['name']})" if span else ""
+        print(
+            f"  [{e['t']:10.3f}s] {stage}{tag} route={a.get('route')}"
+            f"{within}: {a.get('iterations')} iter, "
+            f"half-life {a.get('frontier_half_life')}, "
+            f"peak {a.get('frontier_peak')}, "
+            f"last {a.get('frontier_last')}, "
+            f"tail {float(a.get('tail_fraction') or 0):.0%}, "
+            f"jfr-skippable ~"
+            f"{float(a.get('jfr_skippable_edge_frac') or 0):.0%}",
+            file=out,
+        )
+        curve = a.get("frontier_curve") or []
+        if curve:
+            peak = max(curve) or 1
+            marks = "".join(
+                "#-. "[min(3, int(4 * (1 - v / peak) * 0.999))]
+                for v in curve
+            )
+            print(f"      frontier |{marks}|  (0..{len(curve) - 1}, "
+                  "downsampled)", file=out)
+        last = a.get("frontier_last")
+        iters = a.get("iterations")
+        if last and iters:
+            # The stall diagnostic: a trajectory whose LAST frontier is
+            # still large did not collapse — the stage died or capped
+            # mid-propagation, not in the JFR tail.
+            peak = a.get("frontier_peak") or last
+            if last >= max(1, peak) / 2:
+                print("      !! frontier had NOT collapsed at the last "
+                      "recorded iteration — convergence stalled here",
+                      file=out)
+
+
 def _fmt_dur(s: dict) -> str:
     if s["open"]:
         return "   OPEN at death"
@@ -209,12 +267,20 @@ def main(argv: list[str] | None = None) -> int:
                     help="also print the per-route span aggregate "
                          "(total/mean wall per kernel-route tag — the "
                          "same vocabulary the cost profiles use)")
+    ap.add_argument("--convergence", action="store_true",
+                    help="also print the per-stage convergence "
+                         "trajectories (ISSUE 9): iterations, frontier "
+                         "half-life, collapse sparkline, and a stall "
+                         "diagnostic for stages whose frontier had not "
+                         "collapsed at the last recorded iteration")
     args = ap.parse_args(argv)
 
     records = load_flight(args.flight)
     print_summary(records, top=args.top)
     if args.by_route:
         print_route_table(records)
+    if args.convergence:
+        print_convergence(records)
     if args.chrome:
         trace = chrome_trace_from_records(records)
         validate_chrome_trace(trace)

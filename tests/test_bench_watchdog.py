@@ -37,6 +37,7 @@ def test_result_kept_despite_teardown_hang():
     assert measured is not None and measured["edges_per_sec"] == 5.0
 
 
+@pytest.mark.slow  # ~2 s of real watchdog sleep (ISSUE 9 suite-budget trim; the stage-line liveness path stays tier-1 via test_heartbeats_extend_stage_deadline)
 def test_stage_timeout_kills_silent_child():
     measured = bench._tpu_attempt(
         0, 0, 0, total_timeout=60, stage_timeout=2,
@@ -66,6 +67,7 @@ def test_heartbeats_extend_stage_deadline():
     assert measured is not None
 
 
+@pytest.mark.slow  # ~3 s of real subprocess sleeps (ISSUE 9 suite-budget trim)
 def test_burst_lines_do_not_starve_watchdog():
     """Many STAGE lines arriving in one pipe chunk must all be seen (the
     buffered-readline starvation bug class)."""

@@ -335,7 +335,12 @@ def test_gs_correct_on_high_bandwidth_rmat():
     )
 
 
-@pytest.mark.parametrize("neg", [0.0, 0.25])
+# The negative-weight variant rides the slow set (ISSUE 9 suite-budget
+# trim): the 0.0 run keeps the property in tier-1, and the dedicated
+# negative-weight oracle test above stays.
+@pytest.mark.parametrize(
+    "neg", [0.0, pytest.param(0.25, marks=pytest.mark.slow)]
+)
 def test_gs_property_random_grids(neg):
     """Randomized sweep over shapes x block sizes (hypothesis-style
     grid): GS == oracle on every combination."""

@@ -75,6 +75,7 @@ def test_condensed_bitwise_equal_on_grid():
     np.testing.assert_array_equal(dist, np.asarray(plain(g).matrix))
 
 
+@pytest.mark.slow  # ~3 s condensed closure (ISSUE 9 suite-budget trim; grid + negative-edge bitwise twins stay tier-1)
 def test_condensed_bitwise_equal_on_sparse_er_with_unreachables():
     g = intw(erdos_renyi(150, 0.015, seed=9), seed=2)
     dist, _, _ = solve_condensed(g, num_parts=4, config=SolverConfig())

@@ -15,6 +15,7 @@ from paralleljohnson_tpu.utils.reductions import (
 )
 
 
+@pytest.mark.slow  # ~8 s of jax.profiler session setup + trace IO (ISSUE 9 suite-budget trim; the telemetry-event side of device_trace stays tier-1 via test_observe.py::test_device_trace_records_event_on_telemetry)
 def test_device_trace_writes_profile(tmp_path):
     import jax
     import jax.numpy as jnp
