@@ -88,6 +88,8 @@ _SIZES = {
     "dense_apsp_fw": dict(n=96,        mini_n=384,       full_n=2048),
     "serve_queries": dict(n=256,       mini_n=1024,      full_n=4096,
                           queries=200, mini_queries=2000, full_queries=20000),
+    "distributed_fleet": dict(n=96,    mini_n=1024,      full_n=4096,
+                          workers=2,   mini_workers=3,   full_workers=4),
 }
 
 
@@ -591,6 +593,94 @@ def bench_serve_queries(backend: str, preset: str) -> BenchRecord:
     )
 
 
+def bench_distributed_fleet(backend: str, preset: str) -> BenchRecord:
+    """Config 8 (round-15 tentpole): the distributed solve fleet — N
+    local CPU worker processes vs 1 on the SAME graph (README
+    'Distributed fleet'). Both runs go through the full coordinator
+    machinery (lease claims over the flock'd log, per-worker checkpoint
+    shards, heartbeats, shard-manifest union), so the speedup number
+    prices exactly what a pod deployment pays: coordination + per-
+    worker process overhead vs parallel source ranges. Rows are checked
+    BITWISE between the two fleets through ``fleet_rows`` (the merged
+    manifests) — the graph is sparse (below the dense-density gate) and
+    the source batch is pinned, so every worker resolves the same
+    batch-invariant route and a drifted row is a bug, not rounding.
+    The smoke preset runs the workers in-process (same machinery minus
+    subprocess spawn — what tier-1 exercises); mini/full spawn real
+    subprocesses. Detail records the requeue/extension counters: a
+    clean run must show 0 requeues, and the host-loss drill lives in
+    ``scripts/fleet_dryrun.py``, not here."""
+    import tempfile
+
+    from paralleljohnson_tpu.distributed import (
+        fleet_rows,
+        launch_local_fleet,
+        plan_fleet,
+    )
+    from paralleljohnson_tpu.distributed.launch import run_in_process_fleet
+
+    n = _sz("distributed_fleet", "n", preset)
+    n_workers = _sz("distributed_fleet", "workers", preset)
+    # Average degree ~4: below the dense-density gate at every preset
+    # size, so every lease resolves the batch-invariant sparse fan-out.
+    graph_spec = f"er:n={n},p={round(4.0 / n, 6)},seed=13"
+    config = {"source_batch_size": max(16, n // 16)}
+    in_process = preset == "smoke"
+
+    def run_fleet(workers: int, d: str):
+        coord = plan_fleet(
+            d, graph_spec, n_workers=workers, backend=backend,
+            config=config,
+        )
+        t0 = time.perf_counter()
+        if in_process:
+            report = run_in_process_fleet(coord, workers)
+        else:
+            report = launch_local_fleet(
+                coord, workers, telemetry=_BENCH_TELEMETRY.get()
+            )
+        wall = time.perf_counter() - t0
+        if not report.ok:
+            raise RuntimeError(
+                f"fleet incomplete: {report.leases_committed}/"
+                f"{report.leases_total} leases committed "
+                f"(worker rcs {report.worker_rcs})"
+            )
+        return coord, report, wall
+
+    with tempfile.TemporaryDirectory() as d1, \
+            tempfile.TemporaryDirectory() as dn:
+        coord1, rep1, wall1 = run_fleet(1, d1 + "/coord")
+        coordn, repn, wall = run_fleet(n_workers, dn + "/coord")
+        rows1 = fleet_rows(coord1.dir)
+        rowsn = fleet_rows(coordn.dir)
+        detail = {
+            "nodes": n, "graph_spec": graph_spec,
+            "workers": n_workers,
+            "worker_mode": "in-process" if in_process else "subprocess",
+            "leases": repn.leases_total,
+            "requeues": repn.requeues,
+            "extensions": repn.extensions,
+            "single_worker_wall_s": round(wall1, 6),
+            "fleet_speedup": round(wall1 / max(wall, 1e-9), 3),
+            "committed_by": repn.status["committed_by"],
+        }
+        if sorted(rows1) != sorted(rowsn):
+            detail["failed"] = "fleet manifests cover different sources"
+        elif not all(
+            np.array_equal(rows1[s], rowsn[s]) for s in rows1
+        ):
+            detail["failed"] = (
+                f"{n_workers}-worker rows != 1-worker rows (bitwise)"
+            )
+    return BenchRecord(
+        "distributed_fleet", backend, preset, wall,
+        repn.edges_relaxed,
+        repn.edges_relaxed / max(wall, 1e-9), _n_chips(),
+        detail,
+    )
+
+
 CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "er1k_apsp": bench_er1k_apsp,
     "dimacs_ny_bf": bench_dimacs_ny_bf,
@@ -602,6 +692,7 @@ CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "batch_small": bench_batch_small,
     "dense_apsp_fw": bench_dense_apsp_fw,
     "serve_queries": bench_serve_queries,
+    "distributed_fleet": bench_distributed_fleet,
 }
 
 

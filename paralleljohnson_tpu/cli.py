@@ -460,6 +460,64 @@ def main(argv: list[str] | None = None) -> int:
                               "store counters, hit rate) to stderr at exit")
     _add_common(p_serve)
 
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="distributed solve fleet over a coordinator dir (README "
+             "'Distributed fleet'): solve = plan + run N local CPU "
+             "worker subprocesses + merge shard manifests; status = "
+             "lease/heartbeat snapshot; resume = continue an "
+             "interrupted fleet",
+    )
+    fsub = p_fleet.add_subparsers(dest="fleet_command", required=True)
+    pf_solve = fsub.add_parser(
+        "solve", help="plan a fleet, run local workers, merge the manifest"
+    )
+    pf_solve.add_argument("graph", help="path or loader spec (workers "
+                          "re-load it and verify the content digest)")
+    pf_solve.add_argument("--coordinator-dir", required=True, metavar="DIR",
+                          help="the fleet's shared state dir (plan + lease "
+                               "log + heartbeats + per-worker checkpoint "
+                               "shards + merged manifest)")
+    pf_solve.add_argument("--workers", type=int, default=2,
+                          help="local CPU worker subprocesses (default 2); "
+                               "pod slices run one worker per host "
+                               "directly — see the module docstring of "
+                               "distributed.launch")
+    pf_solve.add_argument("--num-sources", type=int, default=None,
+                          help="solve the first K sources only "
+                               "(default: all V)")
+    pf_solve.add_argument("--lease-sources", type=int, default=None,
+                          help="sources per lease (default: ~4 leases "
+                               "per worker)")
+    pf_solve.add_argument("--lease-deadline", type=float, default=30.0,
+                          metavar="SECONDS",
+                          help="lease deadline; at lapse a fresh worker "
+                               "heartbeat extends it, a stale one "
+                               "re-queues the range (default 30)")
+    pf_solve.add_argument("--heartbeat-stale", type=float, default=None,
+                          metavar="SECONDS",
+                          help="heartbeat age past which a worker counts "
+                               "as dead (default: 2x the lease deadline)")
+    pf_solve.add_argument("--backend", default="jax")
+    pf_solve.add_argument("--batch-size", type=int, default=None,
+                          help="worker source_batch_size override")
+    pf_solve.add_argument("--in-process", action="store_true",
+                          help="run the workers sequentially in this "
+                               "process instead of as subprocesses "
+                               "(debugging / smoke)")
+    pf_status = fsub.add_parser(
+        "status", help="lease counts, requeues, heartbeat ages, one JSON"
+    )
+    pf_status.add_argument("--coordinator-dir", required=True, metavar="DIR")
+    pf_resume = fsub.add_parser(
+        "resume", help="continue an interrupted fleet: re-open the "
+                       "coordinator, run workers over the surviving "
+                       "state (committed leases stay committed; held "
+                       "leases re-queue via heartbeat staleness)"
+    )
+    pf_resume.add_argument("--coordinator-dir", required=True, metavar="DIR")
+    pf_resume.add_argument("--workers", type=int, default=2)
+
     p_info = sub.add_parser(
         "info",
         help="environment / plugin summary; with a graph spec, also the "
@@ -506,6 +564,58 @@ def main(argv: list[str] | None = None) -> int:
         if args.update_baseline:
             benchmarks.update_baseline_md(records, args.update_baseline)
         return 0
+
+    if args.command == "fleet":
+        from paralleljohnson_tpu.distributed import (
+            Coordinator,
+            CoordinatorError,
+            launch_local_fleet,
+            plan_fleet,
+        )
+        from paralleljohnson_tpu.distributed.launch import (
+            run_in_process_fleet,
+        )
+
+        try:
+            if args.fleet_command == "status":
+                print(json.dumps(Coordinator(args.coordinator_dir).status(),
+                                 indent=2))
+                return 0
+            if args.fleet_command == "solve":
+                config = {}
+                if args.batch_size is not None:
+                    config["source_batch_size"] = args.batch_size
+                coord = plan_fleet(
+                    args.coordinator_dir,
+                    args.graph,
+                    n_workers=args.workers,
+                    num_sources=args.num_sources,
+                    lease_sources=args.lease_sources,
+                    lease_deadline_s=args.lease_deadline,
+                    heartbeat_stale_s=args.heartbeat_stale,
+                    backend=args.backend,
+                    config=config,
+                )
+            else:  # resume
+                coord = Coordinator(args.coordinator_dir)
+            if getattr(args, "in_process", False):
+                report = run_in_process_fleet(coord, args.workers)
+            else:
+                report = launch_local_fleet(coord, args.workers)
+            print(json.dumps(report.as_dict()))
+            if not report.ok:
+                print(
+                    f"error: fleet incomplete — "
+                    f"{report.leases_committed}/{report.leases_total} "
+                    f"leases committed (resume with: pjtpu fleet resume "
+                    f"--coordinator-dir {coord.dir})",
+                    file=sys.stderr,
+                )
+                return 3
+            return 0
+        except CoordinatorError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
 
     if args.command == "info":
         import jax

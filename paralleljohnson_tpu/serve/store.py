@@ -67,11 +67,21 @@ class TileStore:
         self.graph = graph
         self.digest = graph_digest(graph)
         self.root = Path(directory) if directory is not None else None
-        self.ckpt = (
-            BatchCheckpointer(directory, graph_key=self.digest)
-            if directory is not None
-            else None
-        )
+        if directory is None:
+            self.ckpt = None
+        elif (Path(directory) / "fleet_manifest.json").exists():
+            # A distributed-fleet dir (ISSUE 10): the cold tier reads
+            # through the merged shard manifest — rows solved by any
+            # worker of the fleet — via the same checkpointer read
+            # protocol; scheduled exact-miss solves still persist into
+            # this root and overlay the fleet map on re-index.
+            from paralleljohnson_tpu.distributed.manifest import (
+                ShardedCheckpointer,
+            )
+
+            self.ckpt = ShardedCheckpointer(directory, graph_key=self.digest)
+        else:
+            self.ckpt = BatchCheckpointer(directory, graph_key=self.digest)
         self.hot_rows = int(hot_rows)
         self.warm_rows = int(warm_rows)
         self._hot: collections.OrderedDict = collections.OrderedDict()

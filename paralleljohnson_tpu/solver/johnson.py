@@ -223,16 +223,18 @@ class ParallelJohnsonSolver:
 
             h, dgraph = self._potentials(graph, dgraph, stats)
 
-            # Phase 2 — batched fan-out over sources.
+            # Phase 2 — batched fan-out over sources. Phase 3 (the
+            # un-reweight d(u,v) = d'(u,v) - h(u) + h(v)) rides INSIDE
+            # each batch's finalize — mirroring solve_reduced — so
+            # checkpointed rows are FINAL distances keyed by the
+            # ORIGINAL graph's digest: any --checkpoint-dir (and every
+            # fleet shard, ISSUE 10) is directly attachable to the
+            # serving layer, negative weights included.
             with phase_timer(stats, "fanout", tel):
                 dist, pred = self._fanout(
-                    dgraph, sources, stats, with_pred=predecessors
+                    dgraph, sources, stats, with_pred=predecessors,
+                    graph=graph, h=h,
                 )
-
-            # Phase 3 — un-reweight: d(u,v) = d'(u,v) - h(u) + h(v).
-            with phase_timer(stats, "unreweight", tel):
-                if graph.has_negative_weights:
-                    dist = _unreweight(dist, h, sources)
             result = SolveResult(dist=dist, sources=sources, potentials=h,
                                  stats=stats, predecessors=pred)
             if self.config.validate:
@@ -241,6 +243,32 @@ class ParallelJohnsonSolver:
                 stats, graph, len(sources), label="solve"
             )
             return result
+
+    def solve_range(
+        self,
+        graph: CSRGraph,
+        start: int,
+        stop: int,
+        *,
+        predecessors: bool = False,
+    ) -> SolveResult:
+        """Johnson solve restricted to the contiguous source range
+        ``[start, stop)`` — the fleet's unit of work (ISSUE 10: a
+        coordinator lease IS a source range; a worker solves it through
+        this entry so checkpointing, resilience, and pipelining apply
+        unchanged, and a re-claimed lease on the same worker resumes
+        from its own shard's completed batches)."""
+        v = graph.num_nodes
+        if not 0 <= start < stop <= v:
+            raise ValueError(
+                f"source range [{start}, {stop}) is not a non-empty "
+                f"subrange of [0, {v})"
+            )
+        return self.solve(
+            graph,
+            sources=np.arange(start, stop, dtype=np.int64),
+            predecessors=predecessors,
+        )
 
     def solve_reduced(
         self,
@@ -399,7 +427,8 @@ class ParallelJohnsonSolver:
                 dgraph = self.backend.upload(graph)
             with phase_timer(stats, "fanout", tel):
                 dist, pred = self._fanout(
-                    dgraph, sources, stats, with_pred=predecessors
+                    dgraph, sources, stats, with_pred=predecessors,
+                    graph=graph,
                 )
         self._finish_observability(
             stats, graph, len(sources), label="multi_source"
@@ -1059,29 +1088,43 @@ class ParallelJohnsonSolver:
         stats: SolverStats,
         *,
         with_pred: bool = False,
+        graph: CSRGraph | None = None,
+        h=None,
     ) -> tuple[np.ndarray, np.ndarray | None]:
         """Run phase 2 in source batches; optionally checkpoint each batch
         (SURVEY.md §5 — the batch is the unit of recovery). Checkpoints are
         keyed by graph content so a different/modified graph never resumes
-        stale rows. The loop runs through the pipelined resilience driver
-        (``_resilient_batches``): batch k's D2H download + checkpoint
-        serialization run behind batch k+1's compute (pipeline_depth > 1),
-        a batch that OOMs first collapses the window and then is re-split
-        smaller and resumed — everything already completed is safe on disk
-        when checkpointing is on, and the solve does not return until the
-        checkpoint writer's flush barrier confirms every commit. Returns
-        (dist rows, predecessor rows or None)."""
+        stale rows — by the ORIGINAL graph (``graph``), not the reweighted
+        device copy, and with the Johnson un-reweight (``h``) applied per
+        batch BEFORE the save: what lands on disk is final distances, so a
+        checkpoint dir (or a fleet shard, ISSUE 10) serves through
+        ``TileStore`` for negative-weight graphs too. The loop runs
+        through the pipelined resilience driver (``_resilient_batches``):
+        batch k's D2H download + checkpoint serialization run behind batch
+        k+1's compute (pipeline_depth > 1), a batch that OOMs first
+        collapses the window and then is re-split smaller and resumed —
+        everything already completed is safe on disk when checkpointing is
+        on, and the solve does not return until the checkpoint writer's
+        flush barrier confirms every commit. Returns (dist rows,
+        predecessor rows or None)."""
         from paralleljohnson_tpu.utils.checkpoint import (
             AsyncCheckpointWriter,
             BatchCheckpointer,
             checked_save,
         )
 
+        unreweight = (
+            h is not None and graph is not None
+            and graph.has_negative_weights
+        )
         ckpt = None
         if self.config.checkpoint_dir:
-            graph = self.backend.download_graph(dgraph)
+            key_graph = (
+                graph if graph is not None
+                else self.backend.download_graph(dgraph)
+            )
             ckpt = BatchCheckpointer(
-                self.config.checkpoint_dir, graph_key=graph
+                self.config.checkpoint_dir, graph_key=key_graph
             )
         try_resume = None
         if ckpt is not None:
@@ -1118,10 +1161,14 @@ class ParallelJohnsonSolver:
             # exceed the device budget (suggested_source_batch), so
             # accumulating device buffers across batches would defeat
             # it. Checkpointing (host .npz) forces the download either
-            # way.
+            # way. The per-batch un-reweight runs in whatever namespace
+            # the rows are in at that point (host after a download,
+            # device for the resident single batch).
             row, pred = payload.dist, payload.pred
             if ckpt is not None or len(batch) < n_src:
                 row, pred = self._download_rows(dgraph, row, pred)
+                if unreweight:
+                    row = _unreweight(row, h, batch)
                 if ckpt is not None:
                     if writer is not None:
                         writer.submit(batch_idx, batch, row, pred=pred)
@@ -1131,6 +1178,8 @@ class ParallelJohnsonSolver:
                                 ckpt, batch_idx, batch, row, pred=pred,
                                 fault_hook=fault_hook,
                             )
+            elif unreweight:
+                row = _unreweight(row, h, batch)
             return row, pred
 
         def stage_async(res):

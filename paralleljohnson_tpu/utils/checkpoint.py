@@ -32,6 +32,77 @@ from paralleljohnson_tpu.utils.resilience import SolveCorruptionError
 MANIFEST_NAME = "manifest.json"
 
 
+class ManifestOverlapError(ValueError):
+    """Two shard manifests claim the same source vertex — merging them
+    would make the global source -> batch-file map ambiguous. Raised
+    loudly (naming both claiming files) rather than resolved silently:
+    overlapping shards mean the fleet's lease table was violated."""
+
+
+def read_manifest_file(directory: str | Path) -> dict | None:
+    """The persisted per-shard ``manifest.json`` of one checkpoint
+    (graph-level) directory, or None when absent/torn/not-a-manifest —
+    the same tolerance as the checkpointer's own reader (callers fall
+    back to a scan or fail loud, their choice)."""
+    p = Path(directory) / MANIFEST_NAME
+    if not p.exists():
+        return None
+    try:
+        data = json.loads(p.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or "files" not in data:
+        return None
+    return data
+
+
+def union_manifests(
+    directories: "list[str | Path]",
+) -> dict[int, tuple[int, str]]:
+    """Merge per-shard ``manifest.json`` files into ONE global map
+    ``source -> (batch_idx, "<dir>/<filename>")`` — the multi-shard
+    twin of :meth:`BatchCheckpointer.manifest` (ISSUE 10 satellite).
+
+    Unlike the single-dir manifest (where a re-listed source is the
+    same rows by construction), a source claimed by TWO DIFFERENT
+    shards is rejected loudly with a :class:`ManifestOverlapError`
+    naming both claiming batch files: shards are supposed to cover
+    disjoint lease ranges, so an overlap is corruption (or a violated
+    lease table), never something to resolve by pick-the-newest. A
+    directory with no readable manifest raises ``ValueError`` with the
+    path — a silent skip would turn a torn shard into serving misses.
+    """
+    out: dict[int, tuple[int, str]] = {}
+    claimed_dir: dict[int, tuple[str, str]] = {}  # source -> (dir, file)
+    for directory in directories:
+        directory = Path(directory)
+        data = read_manifest_file(directory)
+        if data is None:
+            raise ValueError(
+                f"{directory / MANIFEST_NAME}: missing or unreadable shard "
+                "manifest (is this a checkpoint graph directory?)"
+            )
+        dir_key = directory.as_posix()
+        for filename in sorted(data["files"]):
+            entry = data["files"][filename]
+            ref = (directory / filename).as_posix()
+            for s in entry["sources"]:
+                s = int(s)
+                prev = claimed_dir.get(s)
+                if prev is not None and prev[0] != dir_key:
+                    raise ManifestOverlapError(
+                        f"source {s} claimed by both {prev[1]} and "
+                        f"{ref} — shard manifests must cover disjoint "
+                        "source ranges"
+                    )
+                # Within ONE shard a re-listed source is the same rows
+                # by construction (checkpoints are keyed by graph
+                # content) — newest listing wins, like manifest().
+                claimed_dir[s] = (dir_key, ref)
+                out[s] = (int(entry["batch"]), ref)
+    return out
+
+
 def _sources_digest(sources: np.ndarray) -> str:
     return hashlib.sha256(
         np.ascontiguousarray(np.asarray(sources, np.int64)).tobytes()

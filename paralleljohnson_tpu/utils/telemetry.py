@@ -60,6 +60,12 @@ _CURRENT_SPAN: contextvars.ContextVar[int | None] = contextvars.ContextVar(
 
 _EVENT_NAMES_OF_INTEREST = (
     "retry", "abandon", "oom_degrade", "window_collapse", "batch_resumed",
+    # Fleet lease lifecycle (ISSUE 10, ``paralleljohnson_tpu/distributed``)
+    # — a worker's heartbeat carries its last lease transition, so
+    # `fleet status` can show what each worker last did even between
+    # coordinator log events.
+    "lease_claimed", "lease_committed", "lease_requeued",
+    "lease_stale_commit",
 )
 
 
@@ -497,6 +503,21 @@ def heartbeat_age_s(path: str | Path, now: float | None = None) -> float | None:
     if hb is None:
         return None
     return (time.time() if now is None else now) - float(hb["ts"])
+
+
+def heartbeat_fresh(
+    path: str | Path, stale_s: float, now: float | None = None
+) -> bool:
+    """Liveness verdict from one heartbeat file: True iff it exists, is
+    readable, and its last publish is younger than ``stale_s``. The
+    slow-but-alive vs dead distinction the fleet coordinator keys lease
+    requeues off (ISSUE 10) — an unreadable or absent beat never
+    vouches for anyone."""
+    try:
+        age = heartbeat_age_s(path, now=now)
+    except ValueError:
+        return False
+    return age is not None and age < stale_s
 
 
 # -- prometheus textfile export ----------------------------------------------

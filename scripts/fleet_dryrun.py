@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Fleet host-loss drill — the `fleet-dryrun` stage of the TPU pass.
+
+Runs the full distributed machinery on LOCAL CPU worker subprocesses
+(single-tenant discipline: the drill must never dial the device tunnel)
+with one worker SIGKILLing itself mid-lease, then asserts the whole
+ISSUE-10 acceptance contract end to end:
+
+  1. the killed worker's lease re-queues after its heartbeat goes stale
+     (requeues >= 1, visible in coordinator state);
+  2. the fleet still completes every lease exactly — rows BITWISE equal
+     to a single-process solve of the same graph;
+  3. the merged shard manifest serves every row through ``TileStore``
+     at 1.0 hit rate;
+  4. ``fleet status`` / ``fleet resume`` read the same coordinator dir.
+
+Emits a MULTICHIP-style dryrun row to
+``bench_artifacts/MULTICHIP_fleet.json`` (n_workers in place of
+n_devices): the same shape every virtual-mesh dryrun row has, so the
+round's evidence formats stay uniform.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_WORKERS = 3
+GRAPH_SPEC = "dag:n=192,p=0.03,neg=0.3,seed=5"  # negative weights ride too
+
+OUT = Path("bench_artifacts/MULTICHIP_fleet.json")
+
+
+def main() -> int:
+    import numpy as np
+
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.distributed import (
+        fleet_rows,
+        launch_local_fleet,
+        plan_fleet,
+    )
+    from paralleljohnson_tpu.graphs import load_graph
+    from paralleljohnson_tpu.serve import TileStore
+    from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+    t0 = time.time()
+    with tempfile.TemporaryDirectory() as d:
+        coord = plan_fleet(
+            d + "/coord", GRAPH_SPEC, n_workers=N_WORKERS,
+            lease_deadline_s=2.0, heartbeat_stale_s=2.0,
+            heartbeat_interval_s=0.2,
+            config={"source_batch_size": 32},
+        )
+        report = launch_local_fleet(
+            coord, N_WORKERS, poll_s=0.25, timeout_s=600,
+            self_kill={"w0": 2},  # w0 dies abruptly holding its 2nd lease
+        )
+        status = coord.status()
+        assert report.ok, f"fleet incomplete: {report.as_dict()}"
+        assert report.requeues >= 1, "killed worker's lease never re-queued"
+        assert report.worker_rcs["w0"] == -9, report.worker_rcs
+
+        g = load_graph(GRAPH_SPEC)
+        ref = ParallelJohnsonSolver(
+            SolverConfig(backend="jax", source_batch_size=32)
+        ).solve(g)
+        mat = np.asarray(ref.matrix)
+        rows = fleet_rows(coord.dir)
+        assert len(rows) == g.num_nodes, (len(rows), g.num_nodes)
+        for s, row in rows.items():
+            assert np.array_equal(row, mat[s]), f"row {s} drifted"
+
+        store = TileStore(coord.dir, g, hot_rows=8, warm_rows=64)
+        for s in range(g.num_nodes):
+            row, _ = store.get(s)
+            assert row is not None and np.array_equal(
+                np.asarray(row), mat[s]
+            ), f"store miss/drift at {s}"
+        assert store.hit_rate() == 1.0, store.stats()
+
+        orphans = json.loads(
+            (coord.dir / "fleet_manifest.json").read_text()
+        )["orphaned_files"]
+        tail = (
+            f"fleet_dryrun OK: {N_WORKERS} CPU workers on {GRAPH_SPEC}, "
+            f"w0 SIGKILLed mid-lease -> {report.requeues} requeue(s) "
+            f"(committed_by {status['committed_by']}), "
+            f"{report.leases_committed}/{report.leases_total} leases, "
+            f"{len(rows)} rows bitwise == single-process, "
+            f"TileStore hit-rate {store.hit_rate():.1f}, "
+            f"{len(orphans)} orphaned batch file(s)\n"
+        )
+    row = {
+        "n_workers": N_WORKERS,
+        "rc": 0,
+        "ok": True,
+        "skipped": False,
+        "wall_s": round(time.time() - t0, 3),
+        "tail": tail,
+    }
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    OUT.write_text(json.dumps(row, indent=2), encoding="utf-8")
+    print(tail, end="")
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        OUT.parent.mkdir(parents=True, exist_ok=True)
+        OUT.write_text(json.dumps({
+            "n_workers": N_WORKERS, "rc": 1, "ok": False,
+            "skipped": False, "tail": f"fleet_dryrun FAILED: {e}\n",
+        }, indent=2), encoding="utf-8")
+        print(f"fleet_dryrun FAILED: {e}", file=sys.stderr)
+        sys.exit(1)

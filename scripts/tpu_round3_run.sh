@@ -221,6 +221,20 @@ run 900 jax-serve-bench python -m paralleljohnson_tpu.cli bench serve_queries --
 #     cost observatory records on-chip
 run 1500 jax-fw-apsp python -m paralleljohnson_tpu.cli bench dense_apsp_fw --backend jax --preset full --update-baseline BASELINE.md
 
+# 4i) distributed-fleet dryrun (round-15 tentpole): the coordinator /
+#     lease / shard-manifest machinery end to end on LOCAL CPU worker
+#     subprocesses (it must never dial the single-tenant tunnel), with
+#     one worker SIGKILLed mid-lease — asserts the requeue fires, rows
+#     stay bitwise-identical to a single-process solve, and the merged
+#     manifest serves through TileStore at 1.0 hit rate; emits the
+#     MULTICHIP-style row bench_artifacts/MULTICHIP_fleet.json
+run 900 fleet-dryrun env JAX_PLATFORMS=cpu python scripts/fleet_dryrun.py
+
+# 4j) the recorded fleet bench row (N CPU workers vs 1, same graph,
+#     bitwise-checked through the merged manifests; requeue counters in
+#     detail) — CPU workers by design, so it rides any window state
+run 1200 jax-fleet-bench python -m paralleljohnson_tpu.cli bench distributed_fleet --backend jax --preset full --update-baseline BASELINE.md
+
 # 5) driver metric (should reflect the blocked kernel now)
 run 1200 bench.py python bench.py
 

@@ -12,6 +12,31 @@
 # Single-tenant discipline: only this watcher dials the device while it
 # runs; everything else in the session must force CPU
 # (paralleljohnson_tpu.utils.platform.honor_cpu_platform_request).
+#
+# POD-SLICE RUNBOOK (distributed fleet, ISSUE 10 — when a multi-HOST
+# slice replaces this single-host tunnel): do NOT run the local fleet
+# launcher on the pod. Instead, on any one machine that sees the pod's
+# shared filesystem:
+#   1. plan:    pjtpu fleet solve is local-only; for a pod, plan via
+#               python -c "from paralleljohnson_tpu.distributed import \
+#               plan_fleet; plan_fleet('<shared>/coord', '<graphspec>', \
+#               n_workers=<hosts>, lease_deadline_s=600)"
+#   2. workers: on EACH host (the pod manager's per-host command):
+#               python -m paralleljohnson_tpu.distributed.worker \
+#                 <shared>/coord --worker-id host$JAX_PROCESS_ID --multihost
+#               (--multihost runs parallel.multihost.initialize, so each
+#               worker's solver sees its host's chips; leases shard the
+#               SOURCES across hosts, the mesh shards within a host)
+#   3. watch:   pjtpu fleet status --coordinator-dir <shared>/coord
+#               (requeues>0 = a host died and its range moved; a lost
+#               host needs NO operator action — survivors absorb it)
+#   4. resume:  after a full-slice preemption, re-run step 2 on the new
+#               slice; committed leases stay committed, held ones
+#               requeue via heartbeat staleness.
+#   5. serve:   the merged <shared>/coord/fleet_manifest.json is a
+#               TileStore dir: pjtpu serve <graphspec> --store-dir \
+#               <shared>/coord ...; post-mortems: python \
+#               scripts/trace_summary.py --merge <shared>/coord/telemetry
 set -u
 cd "$(dirname "$0")/.."
 unset JAX_PLATFORMS XLA_FLAGS

@@ -21,9 +21,16 @@ the TPU pass under ``bench_artifacts/telemetry/``) and prints:
 No dependency on the package being importable beyond ``utils.telemetry``
 (pure python — safe to run on a machine with no jax).
 
+  - ``--merge DIR...``: join MANY workers' flight-recorder dirs (a
+    fleet's ``<coord>/telemetry/``) into one wall-clock timeline keyed
+    by worker id — the one-command fleet post-mortem (ISSUE 10): whose
+    process died, inside what, and when each lease claim / commit /
+    requeue happened relative to it.
+
 Usage:
   python scripts/trace_summary.py bench_artifacts/telemetry/flight-solve.jsonl
   python scripts/trace_summary.py flight.jsonl --chrome trace.json --top 20
+  python scripts/trace_summary.py --merge /path/to/coord/telemetry
 """
 
 from __future__ import annotations
@@ -254,11 +261,97 @@ def print_summary(records: list[dict], *, top: int = 10,
         print("  (none — a clean run)", file=out)
 
 
+def _merge_sources(paths: list[str]) -> list[tuple[str, list[dict]]]:
+    """``--merge`` inputs -> ``(label, records)`` per flight file. A
+    directory contributes every ``flight-*.jsonl`` under it (one level
+    of a fleet's ``telemetry/<worker>/`` layout included), labeled by
+    the worker dir / file stem; a file contributes itself."""
+    out: list[tuple[str, list[dict]]] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            flights = sorted(p.glob("flight-*.jsonl")) + sorted(
+                p.glob("*/flight-*.jsonl")
+            )
+            if not flights:
+                raise ValueError(f"{p}: no flight-*.jsonl here")
+            for f in flights:
+                label = (
+                    f.parent.name if f.parent != p else
+                    f.stem.replace("flight-", "")
+                )
+                out.append((label, load_flight(f)))
+        else:
+            out.append((p.stem.replace("flight-", ""), load_flight(p)))
+    return out
+
+
+def print_merged(sources: list[tuple[str, list[dict]]],
+                 out=sys.stdout) -> None:
+    """One fleet-wide timeline over many workers' flight recorders
+    (ISSUE 10 satellite): every span/resilience event on a single
+    wall-clock axis keyed by worker id — each file's monotonic ``t`` is
+    anchored to the epoch via its meta ``start_ts``, so cross-worker
+    ordering is real (the requeue of w0's lease visibly follows w0's
+    death). Spans OPEN at death are flagged per worker, which is the
+    fleet post-mortem: whose process died, inside what."""
+    width = max((len(label) for label, _ in sources), default=6)
+    timeline = []  # (abs_ts, label, line)
+    for label, records in sources:
+        meta = next((r for r in records if r.get("type") == "meta"), {})
+        t0 = float(meta.get("start_ts", 0.0))
+        for s in build_spans(records):
+            mark = (
+                "   OPEN at death" if s["open"]
+                else f"{s['dur'] * 1e3:12.2f} ms"
+            )
+            status = (
+                "" if s["status"] in (None, "ok") else f"  << {s['error']}"
+            )
+            timeline.append((
+                t0 + s["begin"], label,
+                f"{mark}  {s['name']} {s['attrs']}{status}",
+            ))
+        for r in records:
+            if r.get("type") == "event" and (
+                r["name"] in _RESILIENCE_EVENTS
+                or r["name"].startswith("lease_")
+            ):
+                timeline.append((
+                    t0 + r["t"], label,
+                    f"            --  {r['name']} {r.get('attrs') or {}}",
+                ))
+    timeline.sort(key=lambda row: row[0])
+    origin = timeline[0][0] if timeline else 0.0
+    print(f"merged fleet timeline: {len(sources)} flight recorder(s), "
+          f"{len(timeline)} entries", file=out)
+    for ts, label, line in timeline:
+        print(f"  [{ts - origin:10.3f}s] {label:<{width}} {line}",
+              file=out)
+    open_by = {}
+    for label, records in sources:
+        n_open = sum(1 for s in build_spans(records) if s["open"])
+        if n_open:
+            open_by[label] = n_open
+    if open_by:
+        print("\n!! spans OPEN at death per worker (where each process "
+              "died):", file=out)
+        for label, n in sorted(open_by.items()):
+            print(f"   {label}: {n} open span(s)", file=out)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="summarize a flight-recorder JSONL (pjtpu --trace-dir)"
     )
-    ap.add_argument("flight", help="path to a flight-*.jsonl")
+    ap.add_argument("flight", nargs="?", default=None,
+                    help="path to a flight-*.jsonl")
+    ap.add_argument("--merge", nargs="+", default=None, metavar="DIR",
+                    help="join multiple workers' flight-recorder dirs "
+                         "(or files) into ONE timeline keyed by worker "
+                         "id — the one-command fleet post-mortem (pass "
+                         "a fleet's coordinator telemetry/ dir, or the "
+                         "per-worker dirs)")
     ap.add_argument("--top", type=int, default=10,
                     help="how many slowest spans to list")
     ap.add_argument("--chrome", default=None, metavar="OUT.json",
@@ -275,6 +368,12 @@ def main(argv: list[str] | None = None) -> int:
                          "collapsed at the last recorded iteration")
     args = ap.parse_args(argv)
 
+    if args.merge is not None:
+        print_merged(_merge_sources(args.merge))
+        if args.flight is None:
+            return 0
+    if args.flight is None:
+        ap.error("need a flight file (or --merge DIR...)")
     records = load_flight(args.flight)
     print_summary(records, top=args.top)
     if args.by_route:

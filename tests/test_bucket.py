@@ -326,6 +326,8 @@ def test_scrambled_benchmark_is_the_honest_proxy():
     )
 
 
+@pytest.mark.slow  # suite-budget trim (round 15): f64 twin of the f32
+# bucket coverage above
 def test_bucket_f64():
     import os
     import subprocess

@@ -1,0 +1,462 @@
+"""Distributed solve fleet (ISSUE 10): coordinator lease state machine,
+shard-manifest union, worker loop, host-loss recovery, and the serving
+attach — the CPU-testable twins of the pod deployment. Fast tests drive
+the state machine with explicit clocks (``now=``) and hand-written
+heartbeat files (no sleeps); the in-process fleet test runs the REAL
+claim/solve/commit/merge machinery in this process; the subprocess +
+SIGKILL drill is slow-marked (``scripts/fleet_dryrun.py`` is its
+staged twin)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.distributed import (
+    Coordinator,
+    CoordinatorError,
+    StaleLeaseError,
+    build_fleet_manifest,
+    fleet_rows,
+    launch_local_fleet,
+    plan_fleet,
+    run_worker,
+)
+from paralleljohnson_tpu.distributed.launch import run_in_process_fleet
+from paralleljohnson_tpu.distributed.manifest import ShardedCheckpointer
+from paralleljohnson_tpu.graphs import load_graph
+from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+from paralleljohnson_tpu.utils.checkpoint import (
+    BatchCheckpointer,
+    ManifestOverlapError,
+    union_manifests,
+)
+
+SPEC = "er:n=96,p=0.04,seed=7"  # sparse: batch-invariant fan-out route
+NEG_SPEC = "dag:n=96,p=0.04,neg=0.3,seed=3"  # Johnson path rides too
+
+
+def _coord(tmp_path, *, num_sources=40, lease_sources=10,
+           deadline=5.0, stale=5.0, **kw):
+    return Coordinator.create(
+        tmp_path / "coord",
+        graph_spec=SPEC,
+        graph_digest="d" * 16,
+        num_sources=num_sources,
+        lease_sources=lease_sources,
+        lease_deadline_s=deadline,
+        heartbeat_stale_s=stale,
+        **kw,
+    )
+
+
+def _beat(coord, worker, ts):
+    """Hand-written heartbeat: liveness is just the ts field's age."""
+    p = coord.heartbeat_path(worker)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps({"ts": ts}), encoding="utf-8")
+
+
+# -- coordinator state machine ----------------------------------------------
+
+
+def test_plan_partitions_sources(tmp_path):
+    coord = _coord(tmp_path, num_sources=25, lease_sources=10)
+    leases = coord.leases()
+    assert [(l.start, l.stop) for l in leases] == [(0, 10), (10, 20), (20, 25)]
+    assert all(l.state == "pending" for l in leases)
+    assert not coord.done()
+
+
+def test_create_refuses_existing_plan(tmp_path):
+    _coord(tmp_path)
+    with pytest.raises(CoordinatorError, match="already exists"):
+        _coord(tmp_path)
+
+
+def test_claim_commit_lifecycle(tmp_path):
+    coord = _coord(tmp_path, num_sources=20, lease_sources=10)
+    a = coord.claim("w0", now=100.0)
+    assert (a.lease_id, a.state, a.owner) == (0, "leased", "w0")
+    assert a.deadline == 100.0 + coord.spec["lease_deadline_s"]
+    b = coord.claim("w1", now=100.0)
+    assert b.lease_id == 1
+    assert coord.claim("w2", now=100.0) is None  # nothing pending
+    coord.commit(0, "w0", now=101.0)
+    coord.commit(1, "w1", now=101.0)
+    assert coord.done()
+    status = coord.status(now=102.0)
+    assert status["leases"] == {"pending": 0, "leased": 0, "committed": 2}
+    assert status["committed_by"] == {"w0": 1, "w1": 1}
+
+
+def test_lapsed_lease_requeues_when_heartbeat_stale(tmp_path):
+    coord = _coord(tmp_path, deadline=5.0, stale=5.0)
+    coord.claim("w0", now=100.0)
+    _beat(coord, "w0", 100.0)
+    # Before the deadline: nothing to reap.
+    assert coord.reap(now=104.0) == []
+    # Past the deadline, beat 6s old (> stale 5): dead -> requeued.
+    events = coord.reap(now=106.0)
+    assert [e["ev"] for e in events] == ["requeued"]
+    lease = coord.leases()[0]
+    assert lease.state == "pending" and lease.requeues == 1
+    # Survivor claims the re-queued range.
+    again = coord.claim("w1", now=106.0)
+    assert again.lease_id == 0 and again.owner == "w1"
+
+
+def test_lapsed_lease_extends_when_heartbeat_fresh(tmp_path):
+    coord = _coord(tmp_path, deadline=5.0, stale=60.0)
+    coord.claim("w0", now=100.0)
+    _beat(coord, "w0", 104.0)  # 2s old at reap time: alive, just slow
+    events = coord.reap(now=106.0)
+    assert [e["ev"] for e in events] == ["extended"]
+    lease = coord.leases()[0]
+    assert lease.state == "leased" and lease.owner == "w0"
+    assert lease.deadline == 106.0 + 5.0 and lease.extensions == 1
+    # Slow-but-alive committed late: still its lease, commit lands.
+    coord.commit(0, "w0", now=108.0)
+    assert coord.leases()[0].state == "committed"
+
+
+def test_stale_commit_and_release_raise(tmp_path):
+    coord = _coord(tmp_path, deadline=5.0, stale=5.0)
+    coord.claim("w0", now=100.0)
+    coord.reap(now=200.0)  # no beat at all: requeued
+    coord.claim("w1", now=200.0)
+    with pytest.raises(StaleLeaseError, match="re-queued"):
+        coord.commit(0, "w0", now=201.0)
+    with pytest.raises(StaleLeaseError):
+        coord.release(0, "w0", reason="error", now=201.0)
+    coord.commit(0, "w1", now=202.0)  # the new owner's commit is good
+
+
+def test_release_requeues_and_recover_worker(tmp_path):
+    coord = _coord(tmp_path, num_sources=20, lease_sources=10)
+    coord.claim("w0", now=100.0)
+    coord.release(0, "w0", reason="error", now=101.0)
+    assert coord.leases()[0].state == "pending"
+    # recover_worker: a restarted worker requeues what it still holds
+    # (else its fresh heartbeat would extend its dead incarnation's
+    # leases forever).
+    coord.claim("w0", now=102.0)
+    assert coord.recover_worker("w0", now=103.0) == [0]
+    assert coord.leases()[0].state == "pending"
+
+
+def test_log_replay_resumes_and_rejects_corruption(tmp_path):
+    coord = _coord(tmp_path, num_sources=20, lease_sources=10)
+    coord.claim("w0", now=100.0)
+    coord.commit(0, "w0", now=101.0)
+    # A NEW instance (a restarted coordinator process) replays the log.
+    coord2 = Coordinator(coord.dir)
+    states = [l.state for l in coord2.leases()]
+    assert states == ["committed", "pending"]
+    log = coord.dir / "leases.jsonl"
+    # Torn trailing line (killed mid-append) is tolerated ...
+    log.write_text(log.read_text() + '{"ev": "claim', encoding="utf-8")
+    assert [l.state for l in Coordinator(coord.dir).leases()] == states
+    # ... corruption ANYWHERE else is loud, with file:line.
+    lines = log.read_text().splitlines()
+    lines[0] = '{"torn": '
+    log.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    with pytest.raises(CoordinatorError, match="leases.jsonl:1"):
+        Coordinator(coord.dir).leases()
+
+
+def test_invalid_transition_is_loud(tmp_path):
+    coord = _coord(tmp_path)
+    with open(coord.dir / "leases.jsonl", "a", encoding="utf-8") as f:
+        f.write(json.dumps({"ev": "committed", "lease": 0,
+                            "worker": "w0", "ts": 1.0}) + "\n")
+    with pytest.raises(CoordinatorError, match="invalid transition"):
+        coord.leases()
+
+
+# -- manifest union ----------------------------------------------------------
+
+
+def _shard_with(tmp_path, name, batches):
+    """A shard graph-dir with the given {batch_idx: sources} saved."""
+    d = tmp_path / name
+    ckpt = BatchCheckpointer(d)
+    for idx, sources in batches.items():
+        sources = np.asarray(sources, np.int64)
+        rows = np.full((len(sources), 4), float(idx), np.float32)
+        ckpt.save(idx, sources, rows)
+    return d
+
+
+def test_union_manifests_merges_disjoint_shards(tmp_path):
+    a = _shard_with(tmp_path, "a", {0: [0, 1], 1: [2, 3]})
+    b = _shard_with(tmp_path, "b", {0: [4, 5]})
+    merged = union_manifests([a, b])
+    assert sorted(merged) == [0, 1, 2, 3, 4, 5]
+    assert merged[4][0] == 0 and "b/" in merged[4][1]
+
+
+def test_union_manifests_rejects_overlap_loudly(tmp_path):
+    a = _shard_with(tmp_path, "a", {0: [0, 1, 2]})
+    b = _shard_with(tmp_path, "b", {0: [2, 3]})
+    with pytest.raises(ManifestOverlapError, match="source 2"):
+        union_manifests([a, b])
+
+
+def test_union_manifests_missing_manifest_is_loud(tmp_path):
+    a = _shard_with(tmp_path, "a", {0: [0]})
+    (tmp_path / "empty").mkdir()
+    with pytest.raises(ValueError, match="manifest.json"):
+        union_manifests([a, tmp_path / "empty"])
+
+
+def test_fleet_manifest_orphans_dead_workers_rows(tmp_path):
+    """A worker that checkpointed rows but never committed its lease:
+    the union must NOT reference them (the re-queued range was solved
+    by another worker) — they are counted as orphans instead."""
+    coord = _coord(tmp_path, num_sources=4, lease_sources=2,
+                   deadline=5.0, stale=5.0)
+    digest = coord.spec["graph_digest"]
+    rng = np.random.default_rng(0)
+
+    def solve_into(worker, lease):
+        sources = np.arange(lease.start, lease.stop)
+        ckpt = BatchCheckpointer(coord.shard_dir(worker), graph_key=digest)
+        ckpt.save(0, sources, rng.random((len(sources), 4)).astype(np.float32))
+
+    # w0 claims lease 0, writes rows, DIES (no commit, stale beat).
+    dead = coord.claim("w0", now=100.0)
+    solve_into("w0", dead)
+    coord.reap(now=200.0)
+    # w1 re-solves lease 0 and solves lease 1, commits both.
+    for _ in range(2):
+        lease = coord.claim("w1", now=200.0)
+        solve_into("w1", lease)
+        coord.commit(lease.lease_id, "w1", now=201.0)
+    manifest = build_fleet_manifest(coord)
+    assert manifest["leases_committed"] == 2
+    workers = {e["worker"] for e in manifest["files"].values()}
+    assert workers == {"w1"}
+    assert len(manifest["orphaned_files"]) == 1
+    assert manifest["orphaned_files"][0].startswith("shards/w0/")
+
+
+def test_fleet_manifest_missing_rows_is_loud(tmp_path):
+    coord = _coord(tmp_path, num_sources=4, lease_sources=4)
+    lease = coord.claim("w0", now=100.0)
+    ckpt = BatchCheckpointer(coord.shard_dir("w0"),
+                             graph_key=coord.spec["graph_digest"])
+    ckpt.save(0, np.arange(2), np.zeros((2, 4), np.float32))  # half only
+    coord.commit(lease.lease_id, "w0", now=101.0)
+    with pytest.raises(ValueError, match="missing 2 source row"):
+        build_fleet_manifest(coord)
+
+
+# -- solve_range -------------------------------------------------------------
+
+
+def test_solve_range_validates_and_matches_solve():
+    g = load_graph(SPEC)
+    solver = ParallelJohnsonSolver(SolverConfig(backend="jax"))
+    with pytest.raises(ValueError, match="subrange"):
+        solver.solve_range(g, 5, 5)
+    with pytest.raises(ValueError, match="subrange"):
+        solver.solve_range(g, 0, g.num_nodes + 1)
+    res = solver.solve_range(g, 8, 12)
+    assert list(res.sources) == [8, 9, 10, 11]
+
+
+# -- the in-process fleet (real machinery, no subprocess spawn) --------------
+
+
+def test_in_process_fleet_bitwise_and_serves(tmp_path):
+    """2 workers through the real coordinator + the real solver: rows
+    bitwise-identical to a single-process solve (negative weights, so
+    the per-batch unreweight + original-digest keying is covered), the
+    merged manifest complete, and TileStore serving every row at 1.0
+    hit rate — the acceptance contract, minus subprocesses."""
+    from paralleljohnson_tpu.serve import TileStore
+
+    coord = plan_fleet(
+        tmp_path / "coord", NEG_SPEC, n_workers=2,
+        config={"source_batch_size": 16},
+    )
+    report = run_in_process_fleet(coord, 2)
+    assert report.ok and report.requeues == 0
+    assert report.leases_committed == report.leases_total
+
+    g = load_graph(NEG_SPEC)
+    mat = np.asarray(
+        ParallelJohnsonSolver(
+            SolverConfig(backend="jax", source_batch_size=16)
+        ).solve(g).matrix
+    )
+    rows = fleet_rows(coord.dir)
+    assert sorted(rows) == list(range(g.num_nodes))
+    for s, row in rows.items():
+        assert np.array_equal(row, mat[s]), f"row {s} drifted"
+
+    store = TileStore(coord.dir, g, hot_rows=8, warm_rows=32)
+    assert isinstance(store.ckpt, ShardedCheckpointer)
+    for s in range(g.num_nodes):
+        row, tier = store.get(s)
+        assert row is not None
+        assert np.array_equal(np.asarray(row), mat[s])
+    assert store.hit_rate() == 1.0
+
+    # Worker summaries landed (the bench's edges accounting source).
+    assert report.edges_relaxed > 0
+    summary = json.loads(
+        coord.worker_summary_path("w0").read_text(encoding="utf-8")
+    )
+    assert summary["rc"] == 0 and summary["sources_solved"] > 0
+
+
+def test_fleet_resume_in_process(tmp_path):
+    """A fleet interrupted after some leases resumes: committed leases
+    stay committed (their rows resume from the shard), the rest solve."""
+    coord = plan_fleet(
+        tmp_path / "coord", SPEC, n_workers=2,
+        config={"source_batch_size": 16},
+    )
+    first = run_worker(coord.dir, "w0", max_leases=2)
+    assert len(first["leases_committed"]) == 2
+    assert not coord.done()
+    # "Resume": a fresh worker (new process in real life) finishes it.
+    run_worker(coord.dir, "w1")
+    assert coord.done()
+    build_fleet_manifest(coord)
+    g = load_graph(SPEC)
+    assert sorted(fleet_rows(coord.dir)) == list(range(g.num_nodes))
+
+
+def test_worker_rejects_wrong_graph_digest(tmp_path):
+    coord = Coordinator.create(
+        tmp_path / "coord", graph_spec=SPEC, graph_digest="0" * 16,
+        num_sources=8, lease_sources=4,
+    )
+    with pytest.raises(CoordinatorError, match="digest mismatch"):
+        run_worker(coord.dir, "w0")
+
+
+def test_sharded_checkpointer_growth_overlay(tmp_path):
+    """Scheduled solves into a fleet store's root (the serving engine's
+    exact-miss path) overlay the fleet map on re-index."""
+    coord = plan_fleet(
+        tmp_path / "coord", SPEC, n_workers=1, num_sources=16,
+        config={"source_batch_size": 16},
+    )
+    run_in_process_fleet(coord, 1)
+    g = load_graph(SPEC)
+    sc = ShardedCheckpointer(coord.dir, graph_key=g)
+    assert sorted(sc.manifest()) == list(range(16))
+    # A later solve checkpoints MORE sources into the root (what the
+    # engine does with checkpoint_dir = store root).
+    solver = ParallelJohnsonSolver(
+        SolverConfig(backend="jax", checkpoint_dir=str(coord.dir))
+    )
+    solver.solve(g, sources=np.arange(16, 24))
+    assert sorted(sc.manifest()) == list(range(24))
+    row, _ = sc.load(*_entry_for(sc, 20))
+    assert row is not None
+
+
+def _entry_for(sc, source):
+    batch, relpath = sc.manifest()[source]
+    return batch, sc.batch_sources(relpath)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_fleet_solve_and_status(tmp_path, capsys):
+    from paralleljohnson_tpu.cli import main
+
+    coord_dir = str(tmp_path / "coord")
+    rc = main(["fleet", "solve", SPEC, "--coordinator-dir", coord_dir,
+               "--workers", "2", "--num-sources", "24",
+               "--lease-sources", "8", "--batch-size", "8",
+               "--in-process"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["leases_committed"] == report["leases_total"] == 3
+    assert report["manifest_path"].endswith("fleet_manifest.json")
+
+    rc = main(["fleet", "status", "--coordinator-dir", coord_dir])
+    assert rc == 0
+    status = json.loads(capsys.readouterr().out)
+    assert status["done"] is True
+    assert status["leases"]["committed"] == 3
+
+    # status on a dir with no plan: diagnosable, exit 1
+    rc = main(["fleet", "status", "--coordinator-dir", str(tmp_path / "no")])
+    assert rc == 1
+    assert "no fleet plan" in capsys.readouterr().err
+
+
+# -- subprocess fleet + host loss (slow: real processes, real kill) ----------
+
+
+@pytest.mark.slow
+def test_subprocess_fleet_kill_requeues_and_completes(tmp_path):
+    """The acceptance drill: 3 local CPU worker subprocesses, one
+    SIGKILLed mid-lease; its lease re-queues after the heartbeat goes
+    stale, survivors finish, rows are bitwise-identical to a single
+    solve, and the requeue is visible in coordinator state."""
+    coord = plan_fleet(
+        tmp_path / "coord", SPEC, n_workers=3,
+        lease_deadline_s=2.0, heartbeat_stale_s=2.0,
+        heartbeat_interval_s=0.2,
+        config={"source_batch_size": 16},
+    )
+    report = launch_local_fleet(
+        coord, 3, poll_s=0.25, timeout_s=300, self_kill={"w0": 2},
+    )
+    assert report.ok, report.as_dict()
+    assert report.requeues >= 1
+    assert report.worker_rcs["w0"] == -9  # SIGKILL
+    assert report.status["leases"]["committed"] == report.leases_total
+    g = load_graph(SPEC)
+    mat = np.asarray(
+        ParallelJohnsonSolver(
+            SolverConfig(backend="jax", source_batch_size=16)
+        ).solve(g).matrix
+    )
+    rows = fleet_rows(coord.dir)
+    assert sorted(rows) == list(range(g.num_nodes))
+    for s, row in rows.items():
+        assert np.array_equal(row, mat[s]), f"row {s} drifted"
+    # The killed worker's flight recorder ends with an OPEN claim —
+    # and the merged timeline reader joins all three.
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "pj_trace_summary",
+        Path(__file__).resolve().parent.parent / "scripts" / "trace_summary.py",
+    )
+    ts = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ts)
+    sources = ts._merge_sources([str(coord.dir / "telemetry")])
+    assert {label for label, _ in sources} == {"w0", "w1", "w2"}
+    import io
+
+    buf = io.StringIO()
+    ts.print_merged(sources, out=buf)
+    assert "lease_requeued" in buf.getvalue() or report.requeues
+
+
+@pytest.mark.slow
+def test_cli_fleet_solve_subprocess(tmp_path, capsys):
+    from paralleljohnson_tpu.cli import main
+
+    rc = main(["fleet", "solve", SPEC,
+               "--coordinator-dir", str(tmp_path / "coord"),
+               "--workers", "2", "--lease-sources", "24",
+               "--batch-size", "16"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["leases_committed"] == report["leases_total"]
+    assert set(report["worker_rcs"].values()) == {0}

@@ -67,6 +67,9 @@ def test_auto_num_parts_bounds():
 # -- exactness (the acceptance criterion: bitwise, >= 2 graphs) ---------------
 
 
+@pytest.mark.slow  # suite-budget trim (round 15): ~2.8 s; the grid
+# bitwise case is covered tier-1 by test_condensed_source_subset_and
+# _duplicates + the ER/negative-weight variants
 def test_condensed_bitwise_equal_on_grid():
     g = intw(grid2d(16, 16, seed=3))
     dist, _, info = solve_condensed(g, num_parts=5, config=SolverConfig())
@@ -163,6 +166,8 @@ def test_condensed_negative_cycle_across_parts_raises():
 # -- predecessors (round-13 satellite: pred rides the condensed route) --------
 
 
+@pytest.mark.slow  # suite-budget trim (round 15): pred-on-condensed is
+# also exercised tier-1 via the fw-route pred tests
 def test_condensed_pred_extraction_and_cpp_equivalence():
     """Tight-edge extraction dispatches after the condensed route like
     every other route; trees validate against the route's own distances
