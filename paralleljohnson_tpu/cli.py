@@ -460,6 +460,40 @@ def main(argv: list[str] | None = None) -> int:
                               "store counters, hit rate) to stderr at exit")
     _add_common(p_serve)
 
+    p_update = sub.add_parser(
+        "update",
+        help="incremental graph update (README 'Incremental updates'): "
+             "apply an edge-update batch against a solved "
+             "--checkpoint-dir, re-closing only dirty parts + the "
+             "boundary core and re-expanding only affected source "
+             "ranges; the repaired checkpoint lands under the new "
+             "graph digest, bitwise-identical to a fresh full solve "
+             "on integer weights",
+    )
+    p_update.add_argument("graph",
+                          help="path or loader spec of the PRE-update "
+                               "graph the checkpoint was solved from "
+                               "(digests must match)")
+    p_update.add_argument("--updates", required=True, metavar="FILE",
+                          help="edge-update file: one update per line, "
+                               "either JSON {\"u\": U, \"v\": V, \"w\": "
+                               "W|null} or 'U V W' text (w of null/inf "
+                               "removes the edge; last update to a pair "
+                               "wins)")
+    p_update.add_argument("--dry-run", action="store_true",
+                          help="print the dirty-set diagnosis (which "
+                               "parts / the core a repair would "
+                               "re-close) without repairing")
+    p_update.add_argument("--fleet-dir", default=None, metavar="DIR",
+                          help="shard the row regeneration through "
+                               "repair leases of a fleet coordinator "
+                               "planned in DIR (in-process workers; "
+                               "inspect with pjtpu fleet status)")
+    p_update.add_argument("--fleet-workers", type=int, default=2,
+                          help="worker claim loops for --fleet-dir "
+                               "(default 2)")
+    _add_common(p_update)
+
     p_fleet = sub.add_parser(
         "fleet",
         help="distributed solve fleet over a coordinator dir (README "
@@ -534,6 +568,14 @@ def main(argv: list[str] | None = None) -> int:
                         help="cost-observatory profile store to price "
                              "routes from (default: $PJ_PROFILE_DIR, "
                              "else bench_artifacts/profiles when present)")
+    p_info.add_argument("--updates", default=None, metavar="FILE",
+                        help="with a graph spec and --checkpoint-dir: "
+                             "diagnose this edge-update file's dirty set "
+                             "(which parts / the core a pjtpu update "
+                             "would re-close) without repairing")
+    p_info.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                        help="checkpoint directory for the --updates "
+                             "dirty-set diagnosis")
     p_info.add_argument("--json", action="store_true", dest="as_json")
 
     args = parser.parse_args(argv)
@@ -705,6 +747,44 @@ def main(argv: list[str] | None = None) -> int:
                     "1": "some queries malformed / bad arguments",
                     "2": "negative cycle during a scheduled solve",
                     "3": "corruption or abandoned stage",
+                },
+            },
+            # The incremental-update surface (README "Incremental
+            # updates"): what pjtpu update repairs, its exit codes
+            # (consistent with serve/fleet), and the staleness
+            # contract; attach --updates + --checkpoint-dir for a
+            # dirty-set diagnosis of a concrete update file.
+            "incremental": {
+                "command": "pjtpu update <graph> --updates FILE "
+                           "--checkpoint-dir DIR [--dry-run] "
+                           "[--fleet-dir DIR]",
+                "update_format": (
+                    'one update per line: {"u": U, "v": V, "w": W|null} '
+                    "JSON or 'U V W' text; w of null/inf removes the "
+                    "edge, the last update to a pair wins"
+                ),
+                "repair": (
+                    "re-close only dirty parts + the boundary core "
+                    "(through the ordinary resilient solver), re-expand "
+                    "only affected source ranges, commit per batch "
+                    "through the corruption-checked checkpoint writer "
+                    "under the NEW graph digest — bitwise-identical to "
+                    "a fresh full solve on integer weights"
+                ),
+                "staleness": (
+                    "while (and after) repair runs, the OLD digest's "
+                    "store serves affected sources with stale: true "
+                    "(repair_status.json); unaffected rows are provably "
+                    "current for the updated graph and stay unflagged"
+                ),
+                "exit_codes": {
+                    "0": "repair complete (or dry-run diagnosis printed)",
+                    "1": "bad arguments, malformed update file, or no "
+                         "checkpoint for this graph",
+                    "2": "the update batch creates a negative cycle "
+                         "(checkpoint left intact; old answers stay "
+                         "stale-flagged)",
+                    "3": "corruption or abandoned stage during repair",
                 },
             },
             # The pipelined fan-out defaults (README "Pipelined
@@ -923,6 +1003,53 @@ def main(argv: list[str] | None = None) -> int:
                             "calibration_n": entry["n"],
                         }
                 info["graph"]["priced_routes"] = priced
+        if args.updates is not None:
+            # Dirty-set diagnosis of a concrete update file — the same
+            # diagnose() pjtpu update runs, no repair work (the state
+            # is built once and persisted if absent).
+            if args.graph is None or args.checkpoint_dir is None:
+                info["incremental"]["diagnosis_error"] = (
+                    "--updates needs a graph spec and --checkpoint-dir"
+                )
+            else:
+                try:
+                    from paralleljohnson_tpu.incremental import (
+                        IncrementalState,
+                        diagnose,
+                        load_updates,
+                    )
+                    from paralleljohnson_tpu.utils.checkpoint import (
+                        BatchCheckpointer,
+                        graph_digest,
+                    )
+
+                    _g = load_graph(args.graph)
+                    _digest = graph_digest(_g)
+                    _ck = BatchCheckpointer(
+                        args.checkpoint_dir, graph_key=_digest
+                    )
+                    _st = IncrementalState.load(
+                        _ck.dir, expect_digest=_digest
+                    )
+                    if _st is None:
+                        _st = IncrementalState.build(_g)
+                        _st.save(_ck.dir)
+                    _g2, _upd_report = _g.apply_edge_updates(
+                        load_updates(args.updates)
+                    )
+                    info["incremental"]["diagnosis"] = {
+                        "checkpoint_batches": len(
+                            _ck.completed_batches()
+                        ),
+                        "report": _upd_report.as_dict(),
+                        "dirty_set": diagnose(
+                            _st, _upd_report.changed_edges
+                        ).as_dict(),
+                    }
+                except (ValueError, FileNotFoundError) as e:
+                    info["incremental"]["diagnosis_error"] = (
+                        f"{type(e).__name__}: {e}"
+                    )
         print(json.dumps(info, indent=None if args.as_json else 2))
         return 0
 
@@ -1058,6 +1185,100 @@ def main(argv: list[str] | None = None) -> int:
             if args.summary:
                 print(json.dumps(engine.serve_summary()), file=sys.stderr)
             return 1 if n_errors else 0
+        elif args.command == "update":
+            from paralleljohnson_tpu.incremental import (
+                IncrementalState,
+                diagnose,
+                load_updates,
+                repair_checkpoint,
+            )
+
+            if not args.checkpoint_dir:
+                print(
+                    "error: pjtpu update requires --checkpoint-dir "
+                    "(the solved checkpoint to repair)",
+                    file=sys.stderr,
+                )
+                return 1
+            g = load_graph(args.graph)
+            updates = load_updates(args.updates)
+            if args.dry_run:
+                from paralleljohnson_tpu.utils.checkpoint import (
+                    BatchCheckpointer,
+                    graph_digest,
+                )
+
+                digest = graph_digest(g)
+                ck = BatchCheckpointer(args.checkpoint_dir,
+                                       graph_key=digest)
+                if not ck.manifest():
+                    print(
+                        f"error: {ck.dir}: no completed batches for "
+                        "this graph — nothing to diagnose",
+                        file=sys.stderr,
+                    )
+                    return 1
+                state = IncrementalState.load(ck.dir,
+                                              expect_digest=digest)
+                if state is None:
+                    state = IncrementalState.build(
+                        g, num_parts=args.partition_parts, config=cfg
+                    )
+                    state.save(ck.dir)
+                _g2, report = g.apply_edge_updates(updates)
+                payload = {
+                    "dry_run": True,
+                    "report": report.as_dict(),
+                    "dirty_set": diagnose(
+                        state, report.changed_edges
+                    ).as_dict(),
+                }
+                print(json.dumps(payload))
+                return 0
+            if args.fleet_dir:
+                from paralleljohnson_tpu.incremental.fleet import (
+                    run_in_process_repair_fleet,
+                )
+
+                result = run_in_process_repair_fleet(
+                    args.checkpoint_dir, g, updates,
+                    coordinator_dir=args.fleet_dir,
+                    workers=args.fleet_workers, config=cfg,
+                    num_parts=args.partition_parts,
+                )
+            else:
+                result = repair_checkpoint(
+                    args.checkpoint_dir, g, updates, config=cfg,
+                    num_parts=args.partition_parts,
+                )
+            payload = result.as_dict()
+            if args.as_json:
+                print(json.dumps(payload))
+            else:
+                if result.trivial:
+                    print("update was a no-op (no effective edge "
+                          "changes); checkpoint unchanged")
+                else:
+                    print(
+                        f"repaired {payload['batches_rewritten']} "
+                        f"batches under digest {result.new_digest}: "
+                        f"{payload['rows_recomputed']} rows re-expanded"
+                        f", {payload['rows_patched']} column-patched, "
+                        f"{payload['rows_copied']} copied bitwise"
+                    )
+                    print(
+                        f"  dirty parts closed: "
+                        f"{payload['dirty_parts_closed']} of "
+                        f"{payload['parts_total']}"
+                        + (" (+ boundary core)"
+                           if payload["core_recomputed"] else "")
+                    )
+                    print(
+                        f"  walls: closures "
+                        f"{payload['closures_s'] * 1e3:.1f} ms, expand "
+                        f"{payload['expand_s'] * 1e3:.1f} ms, io "
+                        f"{payload['io_s'] * 1e3:.1f} ms"
+                    )
         elif args.command == "batch":
             if args.predecessors:
                 print("error: batch mode does not support --predecessors",

@@ -1,6 +1,11 @@
 """Graph representation, loaders, and generators (SURVEY.md §2 #5, #7-#11)."""
 
-from paralleljohnson_tpu.graphs.csr import CSRGraph, PAD_WEIGHT, stack_graphs
+from paralleljohnson_tpu.graphs.csr import (
+    CSRGraph,
+    EdgeUpdateReport,
+    PAD_WEIGHT,
+    stack_graphs,
+)
 from paralleljohnson_tpu.graphs.generators import (
     erdos_renyi,
     grid2d,
@@ -23,6 +28,7 @@ from paralleljohnson_tpu.graphs.registry import (
 
 __all__ = [
     "CSRGraph",
+    "EdgeUpdateReport",
     "GraphFormatError",
     "PAD_WEIGHT",
     "available_loaders",

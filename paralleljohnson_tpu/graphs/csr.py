@@ -29,6 +29,44 @@ PAD_WEIGHT = np.inf
 
 
 @dataclasses.dataclass(frozen=True)
+class EdgeUpdateReport:
+    """What :meth:`CSRGraph.apply_edge_updates` actually changed.
+
+    ``changed_edges`` lists every EFFECTIVE change as ``(u, v, old_w,
+    new_w)`` with ``None`` for "edge absent" on the respective side —
+    no-op updates (removing a missing edge, re-setting the current
+    weight) are counted in ``unchanged`` and never listed. Digests are
+    the ``utils.checkpoint.graph_digest`` content hashes before/after:
+    a no-op batch reports ``new_digest == old_digest`` (the graph
+    object itself is returned unchanged), so digest equality IS the
+    "did anything happen" test the incremental subsystem keys on.
+    """
+
+    added: int
+    removed: int
+    reweighted: int
+    unchanged: int
+    changed_edges: tuple
+    old_digest: str
+    new_digest: str
+
+    @property
+    def num_changed(self) -> int:
+        return len(self.changed_edges)
+
+    def as_dict(self) -> dict:
+        return {
+            "added": self.added,
+            "removed": self.removed,
+            "reweighted": self.reweighted,
+            "unchanged": self.unchanged,
+            "num_changed": self.num_changed,
+            "old_digest": self.old_digest,
+            "new_digest": self.new_digest,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
 class CSRGraph:
     """An immutable directed weighted graph in CSR form."""
 
@@ -211,6 +249,121 @@ class CSRGraph:
         return CSRGraph.from_edges(
             self.indices[:e], self.src[:e], self.weights[:e],
             self.num_nodes, dtype=self.dtype,
+        )
+
+    def apply_edge_updates(
+        self, updates
+    ) -> "tuple[CSRGraph, EdgeUpdateReport]":
+        """Apply a batch of edge updates, returning ``(new_graph,
+        report)`` — the standalone entry of the incremental subsystem
+        (``paralleljohnson_tpu.incremental``), usable on its own.
+
+        ``updates``: iterable of ``(u, v, w)`` triples. A finite ``w``
+        sets (inserts or reweights) the directed edge ``u -> v``;
+        ``w`` of ``None`` or ``+inf`` removes it. The last update to a
+        given ``(u, v)`` within the batch wins. Weights are cast to the
+        graph's dtype BEFORE comparison, so an update that rounds to
+        the stored weight is honestly a no-op. Vertex ids outside
+        ``[0, V)`` (the vertex set is fixed), NaN, and ``-inf`` weights
+        raise ``ValueError``.
+
+        The new graph is rebuilt canonically through :meth:`from_edges`
+        (padding no-op edges dropped, parallel edges impossible by
+        construction), and the report carries the before/after content
+        digests — identical digests mean the batch was a no-op and
+        ``new_graph is self``. Host-side cost is O(E log E + k log E),
+        fully vectorized over the edge arrays — a k-edge update batch
+        against an RMAT-22-scale graph stays seconds, not a Python loop
+        over 67M edges.
+        """
+        from paralleljohnson_tpu.utils.checkpoint import graph_digest
+
+        v = self.num_nodes
+        e = self.num_real_edges
+        wtype = np.dtype(self.dtype).type
+
+        # Current edge set as sorted flat (u*V + v) keys; parallel edges
+        # in a non-canonical CSR resolve to the min, matching what
+        # from_edges(dedupe=True) would have kept.
+        keys = self.src[:e].astype(np.int64) * max(v, 1) + self.indices[:e]
+        uniq, inv = np.unique(keys, return_inverse=True)
+        cur_w = np.full(uniq.size, np.inf, np.float64)
+        np.minimum.at(cur_w, inv, self.weights[:e].astype(np.float64))
+
+        final: dict[int, float | None] = {}  # flat key -> new w / remove
+        for item in updates:
+            try:
+                u, d, w = item
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"edge update must be a (u, v, w) triple, got {item!r}"
+                ) from None
+            u, d = int(u), int(d)
+            if not (0 <= u < v and 0 <= d < v):
+                raise ValueError(
+                    f"edge update ({u}, {d}) out of vertex range [0, {v})"
+                )
+            if w is None or (isinstance(w, float) and np.isposinf(w)):
+                final[u * v + d] = None
+            else:
+                w = float(wtype(w))
+                if np.isnan(w) or np.isneginf(w):
+                    raise ValueError(
+                        f"edge update ({u}, {d}) has invalid weight {w!r}"
+                    )
+                final[u * v + d] = w
+
+        old_digest = graph_digest(self)
+        added = removed = reweighted = unchanged = 0
+        changed: list[tuple[int, int, float | None, float | None]] = []
+        keep = np.ones(uniq.size, bool)
+        new_w = cur_w.copy()
+        extra_keys: list[int] = []
+        extra_w: list[float] = []
+        for key, w_new in sorted(final.items()):
+            idx = int(np.searchsorted(uniq, key))
+            present = idx < uniq.size and uniq[idx] == key
+            w_old = float(cur_w[idx]) if present else None
+            u, d = divmod(key, v)
+            if w_new is None:
+                if not present:
+                    unchanged += 1
+                else:
+                    removed += 1
+                    changed.append((u, d, w_old, None))
+                    keep[idx] = False
+            elif not present:
+                added += 1
+                changed.append((u, d, None, w_new))
+                extra_keys.append(key)
+                extra_w.append(w_new)
+            elif w_old == w_new:
+                unchanged += 1
+            else:
+                reweighted += 1
+                changed.append((u, d, w_old, w_new))
+                new_w[idx] = w_new
+
+        if not changed:
+            return self, EdgeUpdateReport(
+                added=0, removed=0, reweighted=0, unchanged=unchanged,
+                changed_edges=(), old_digest=old_digest,
+                new_digest=old_digest,
+            )
+        all_keys = np.concatenate(
+            [uniq[keep], np.asarray(extra_keys, np.int64)]
+        )
+        all_w = np.concatenate(
+            [new_w[keep], np.asarray(extra_w, np.float64)]
+        ).astype(self.dtype)
+        g2 = CSRGraph.from_edges(
+            all_keys // max(v, 1), all_keys % max(v, 1), all_w, v,
+            dtype=self.dtype,
+        )
+        return g2, EdgeUpdateReport(
+            added=added, removed=removed, reweighted=reweighted,
+            unchanged=unchanged, changed_edges=tuple(changed),
+            old_digest=old_digest, new_digest=graph_digest(g2),
         )
 
     # -- padding ------------------------------------------------------------

@@ -194,7 +194,11 @@ class CostModel:
                         int(iters)
                     )
                 continue
-            if r.get("kind") not in (None, "solve", "bench", "offchip"):
+            if r.get("kind") not in (None, "solve", "bench", "offchip",
+                                     "repair"):
+                # "repair" records (ISSUE 11) calibrate like solves:
+                # route "incremental-repair" lands in the same priced
+                # table, so dispatch can compare repair-vs-resolve.
                 continue
             measured = r.get("measured") or {}
             compute = measured.get("compute_s") or measured.get("wall_s")

@@ -59,6 +59,7 @@ class ServeStats:
     errors: int = 0
     batches_scheduled: int = 0
     solved_sources: int = 0
+    stale_answers: int = 0
     latencies_ms: list = dataclasses.field(default_factory=list)
 
     def record_latency(self, ms: float) -> None:
@@ -77,6 +78,7 @@ class ServeStats:
             "errors": self.errors,
             "batches_scheduled": self.batches_scheduled,
             "solved_sources": self.solved_sources,
+            "stale_answers": self.stale_answers,
             **{k: round(v, 4) for k, v in self.percentiles().items()},
         }
 
@@ -99,6 +101,10 @@ SERVE_PROM_METRICS = (
     ("pjtpu_serve_batches_scheduled_total", "counter",
      "Exact solve batches the engine scheduled for store misses",
      lambda e: e.stats.batches_scheduled),
+    ("pjtpu_stale_answers_total", "counter",
+     "Answers served from a pre-update checkpoint while (or after) an "
+     "incremental repair ran — every one carries stale: true",
+     lambda e: e.stats.stale_answers),
     ("pjtpu_query_hit_rate", "gauge",
      "Fraction of row lookups served by a store tier (hot/warm/cold)",
      lambda e: e.store.hit_rate()),
@@ -275,6 +281,18 @@ class QueryEngine:
     def _answer(self, p: dict, rows: dict[int, tuple]) -> dict:
         s, dsts, many = p["source"], p["dsts"], p["many"]
         out: dict = {"id": p["id"], "source": s}
+        # Staleness contract (ISSUE 11): while (or after) an incremental
+        # repair runs against this store's graph, every answer whose
+        # source is in the repair's affected set reflects PRE-update
+        # distances — exact for the old graph, flagged here so it is
+        # never served as current silently. This applies to every tier
+        # AND to freshly scheduled solves / landmark bounds: they all
+        # answer for the engine's (pre-update) graph. Absence of the
+        # key means the answer is provably current for the updated
+        # graph too (the repair dependency argument).
+        if self.store.is_stale(s):
+            out["stale"] = True
+            self.stats.stale_answers += 1
         hit = rows.get(s)
         if hit is not None:
             row, tier = hit

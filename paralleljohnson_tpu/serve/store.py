@@ -26,6 +26,15 @@ modified graph are invisible by construction, and a solver writing new
 batches into the same directory (the engine's exact-miss path) just
 grows the cold tier — call :meth:`invalidate_cold_index` after a
 scheduled solve so the manifest is re-read.
+
+**Staleness (ISSUE 11).** When the incremental repair engine runs
+against this store's graph, it publishes ``repair_status.json`` into
+the per-graph subdirectory; :meth:`is_stale` reads it (mtime-cached)
+and reports whether a source's row reflects pre-update distances. The
+query engine flags every such answer ``stale: true`` — rows outside
+the affected set are PROVABLY bitwise identical on the updated graph
+(the repair engine's dependency argument), so they stay unflagged.
+``mark_stale`` exists for in-memory stores and tests.
 """
 
 from __future__ import annotations
@@ -95,6 +104,11 @@ class TileStore:
         self.demotions = 0
         self.evictions = 0
         self.cold_loads = 0
+        # Staleness: manual marking (in-memory stores / tests) plus the
+        # repair-status marker cache: (mtime_ns, size) -> parsed set.
+        self._manual_stale: "set[int] | str | None" = None
+        self._stale_cache_key = None
+        self._stale_cached: "set[int] | str | None" = None
 
     # -- lookup --------------------------------------------------------------
 
@@ -202,6 +216,74 @@ class TileStore:
         appended new batches to the backing directory."""
         with self._lock:
             self._cold_index = None
+
+    # -- staleness (ISSUE 11: stale-but-servable during repair) --------------
+
+    def mark_stale(self, sources) -> None:
+        """Manually flag sources (or ``"all"``) stale — the in-memory
+        twin of the repair-status marker; union'd with it."""
+        if isinstance(sources, str):
+            if sources != "all":
+                raise ValueError(f"mark_stale takes source ids or 'all', "
+                                 f"got {sources!r}")
+            self._manual_stale = "all"
+        elif self._manual_stale != "all":
+            fresh = {int(s) for s in sources}
+            self._manual_stale = (
+                fresh if self._manual_stale is None
+                else self._manual_stale | fresh
+            )
+
+    def clear_stale(self) -> None:
+        """Drop the MANUAL stale marks (the repair-status marker, if
+        present on disk, still applies — it records durable fact)."""
+        self._manual_stale = None
+
+    def _repair_stale(self) -> "set[int] | str | None":
+        """The repair-status marker's affected set, mtime-cached so the
+        hot path pays one ``stat`` per lookup batch, not a JSON parse."""
+        if self.ckpt is None:
+            return None
+        from paralleljohnson_tpu.incremental.status import (
+            REPAIR_STATUS_FILENAME,
+            read_repair_status,
+            stale_sources,
+        )
+
+        marker = Path(self.ckpt.dir) / REPAIR_STATUS_FILENAME
+        try:
+            st = marker.stat()
+            key = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            self._stale_cache_key = None
+            self._stale_cached = None
+            return None
+        if key != self._stale_cache_key:
+            self._stale_cached = stale_sources(
+                read_repair_status(self.ckpt.dir)
+            )
+            self._stale_cache_key = key
+        return self._stale_cached
+
+    def stale_info(self) -> "set[int] | str | None":
+        """``None`` (nothing stale), ``"all"``, or the set of stale
+        sources — manual marks union'd with the repair marker."""
+        repair = self._repair_stale()
+        manual = self._manual_stale
+        if repair == "all" or manual == "all":
+            return "all"
+        if repair is None and manual is None:
+            return None
+        return (repair or set()) | (manual or set())
+
+    def is_stale(self, source: int) -> bool:
+        """Whether this source's row reflects pre-update distances (a
+        repair ran or is running and this source is in its affected
+        set). Sources outside the affected set are provably current."""
+        info = self.stale_info()
+        if info is None:
+            return False
+        return True if info == "all" else int(source) in info
 
     # -- introspection -------------------------------------------------------
 

@@ -235,6 +235,14 @@ run 900 fleet-dryrun env JAX_PLATFORMS=cpu python scripts/fleet_dryrun.py
 #     detail) — CPU workers by design, so it rides any window state
 run 1200 jax-fleet-bench python -m paralleljohnson_tpu.cli bench distributed_fleet --backend jax --preset full --update-baseline BASELINE.md
 
+# 4k) incremental-update bench row (round-16 tentpole): full re-solve
+#     vs dirty-part repair on the SAME k-edge update, BITWISE-checked
+#     (integer weights); detail carries the exact dirty-part counter
+#     (must stay < parts_total) and the repair speedup — the number
+#     that prices the dynamic-graph workload class (traffic updates,
+#     link failures) against a cold re-solve
+run 1200 jax-incremental-bench python -m paralleljohnson_tpu.cli bench incremental_update --backend jax --preset full --update-baseline BASELINE.md
+
 # 5) driver metric (should reflect the blocked kernel now)
 run 1200 bench.py python bench.py
 

@@ -1,0 +1,622 @@
+"""Incremental APSP (ISSUE 11): dependency-tracked tile invalidation +
+dirty-part repair.
+
+The load-bearing property throughout: a repaired checkpoint is
+BITWISE-identical to a fresh full solve of the updated graph (integer
+weights, where every route agrees exactly), while the exact dirty-part
+counter stays below the part total — repair must be provably partial
+AND provably exact. Staleness: while (and after) repair runs, the old
+digest's store flags every affected answer ``stale: true`` and never
+serves an unflagged stale value.
+"""
+
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import CSRGraph, grid2d, save_dimacs
+from paralleljohnson_tpu.incremental import (
+    IncrementalState,
+    diagnose,
+    load_updates,
+    read_repair_status,
+    repair_checkpoint,
+)
+from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+from paralleljohnson_tpu.solver.johnson import NegativeCycleError
+from paralleljohnson_tpu.utils.checkpoint import (
+    BatchCheckpointer,
+    graph_digest,
+)
+
+BATCH = 32
+
+
+def intify(g: CSRGraph) -> CSRGraph:
+    return g.with_weights(
+        np.maximum(1.0, np.rint(g.weights)).astype(np.float32)
+    )
+
+
+def solve_rows(g: CSRGraph) -> np.ndarray:
+    res = ParallelJohnsonSolver(
+        SolverConfig(source_batch_size=BATCH)
+    ).solve(g)
+    return np.asarray(res.matrix)
+
+
+def checkpoint_rows(d, g: CSRGraph) -> dict:
+    """Every source's row from the checkpoint dir keyed by g's digest,
+    via the corruption-checked reader."""
+    ck = BatchCheckpointer(d, graph_key=graph_digest(g))
+    man = ck.manifest()
+    out = {}
+    for fn in sorted({f for _b, f in man.values()}):
+        srcs = ck.batch_sources(fn)
+        loaded = ck.load(int(man[int(srcs[0])][0]), srcs)
+        assert loaded is not None, f"unreadable repaired batch {fn}"
+        for i, s in enumerate(srcs):
+            out[int(s)] = loaded[0][i]
+    return out
+
+
+def assert_repaired_bitwise(d, old_g, updates, result):
+    """The acceptance property: repaired checkpoint == fresh full solve
+    of the updated graph, bitwise, over every checkpointed source."""
+    new_g, _report = old_g.apply_edge_updates(updates)
+    fresh = solve_rows(new_g)
+    rows = checkpoint_rows(d, new_g)
+    assert len(rows) == old_g.num_nodes
+    for s, row in rows.items():
+        np.testing.assert_array_equal(
+            row, fresh[s], err_msg=f"row {s} differs from fresh solve"
+        )
+    return new_g
+
+
+@pytest.fixture(scope="module")
+def base(tmp_path_factory):
+    """One solved + incremental-state-attached checkpoint, built once;
+    tests repair throwaway copies of it."""
+    g = intify(grid2d(9, 9, seed=1))
+    d = tmp_path_factory.mktemp("incr") / "ckpt"
+    cfg = SolverConfig(checkpoint_dir=str(d), source_batch_size=BATCH)
+    ParallelJohnsonSolver(cfg).solve(g)
+    ck = BatchCheckpointer(d, graph_key=graph_digest(g))
+    state = IncrementalState.build(g, num_parts=3, seed=0)
+    state.save(ck.dir)
+    return g, d
+
+
+@pytest.fixture
+def ckpt(base, tmp_path):
+    g, d = base
+    dst = tmp_path / "ckpt"
+    shutil.copytree(d, dst)
+    return g, dst
+
+
+def cfg_for(d, **kw) -> SolverConfig:
+    return SolverConfig(checkpoint_dir=str(d), source_batch_size=BATCH,
+                        **kw)
+
+
+# -- apply_edge_updates (standalone satellite) -------------------------------
+
+
+def test_apply_edge_updates_report_and_roundtrip(base):
+    g, _ = base
+    e0 = 10
+    u, v = int(g.src[e0]), int(g.indices[e0])
+    w0 = float(g.weights[e0])
+    # Reweight one edge, insert a fresh one, remove another.
+    u2, v2 = int(g.src[20]), int(g.indices[20])
+    assert (0, 80) not in {
+        (int(a), int(b)) for a, b in zip(g.src, g.indices)
+    }
+    g2, rep = g.apply_edge_updates(
+        [(u, v, w0 + 5.0), (0, 80, 7.0), (u2, v2, None)]
+    )
+    assert (rep.added, rep.removed, rep.reweighted) == (1, 1, 1)
+    assert rep.num_changed == 3
+    assert rep.old_digest == graph_digest(g)
+    assert rep.new_digest == graph_digest(g2)
+    assert rep.new_digest != rep.old_digest
+    # Inverse batch restores the original digest (round-trip).
+    g3, rep_inv = g2.apply_edge_updates(
+        [(u, v, w0), (0, 80, None),
+         (u2, v2, float(g.weights[20]))]
+    )
+    assert rep_inv.new_digest == rep.old_digest
+    assert graph_digest(g3) == graph_digest(g)
+    # Digest stability: same updates -> same digest, both times.
+    g4, rep2 = g.apply_edge_updates(
+        [(u, v, w0 + 5.0), (0, 80, 7.0), (u2, v2, None)]
+    )
+    assert rep2.new_digest == rep.new_digest
+
+
+def test_apply_edge_updates_noop_and_last_wins(base):
+    g, _ = base
+    u, v = int(g.src[0]), int(g.indices[0])
+    # Re-setting the stored weight, removing a missing edge: no-ops.
+    g2, rep = g.apply_edge_updates(
+        [(u, v, float(g.weights[0])), (0, 80, None)]
+    )
+    assert g2 is g
+    assert rep.num_changed == 0 and rep.unchanged == 2
+    assert rep.new_digest == rep.old_digest
+    # Last update to a pair wins: set then remove == remove.
+    ga, _ = g.apply_edge_updates([(u, v, 99.0), (u, v, None)])
+    gb, _ = g.apply_edge_updates([(u, v, None)])
+    assert graph_digest(ga) == graph_digest(gb)
+
+
+def test_apply_edge_updates_validation(base):
+    g, _ = base
+    with pytest.raises(ValueError, match="out of vertex range"):
+        g.apply_edge_updates([(0, g.num_nodes, 1.0)])
+    with pytest.raises(ValueError, match="invalid weight"):
+        g.apply_edge_updates([(0, 1, float("nan"))])
+    with pytest.raises(ValueError, match="invalid weight"):
+        g.apply_edge_updates([(0, 1, float("-inf"))])
+    with pytest.raises(ValueError, match="triple"):
+        g.apply_edge_updates([(0, 1)])
+
+
+def test_load_updates_formats(tmp_path):
+    p = tmp_path / "u.jsonl"
+    p.write_text(
+        "# comment\n"
+        '{"u": 1, "v": 2, "w": 3.5}\n'
+        '{"u": 3, "v": 4, "w": null}\n'
+        "5 6 inf\n"
+        "7 8 2\n",
+        encoding="utf-8",
+    )
+    assert load_updates(p) == [
+        (1, 2, 3.5), (3, 4, None), (5, 6, None), (7, 8, 2.0)
+    ]
+    bad = tmp_path / "bad.txt"
+    bad.write_text("1 2 3\nnot an update\n", encoding="utf-8")
+    with pytest.raises(ValueError, match=r"bad\.txt:2"):
+        load_updates(bad)
+    empty = tmp_path / "empty.txt"
+    empty.write_text("# nothing\n", encoding="utf-8")
+    with pytest.raises(ValueError, match="no updates"):
+        load_updates(empty)
+
+
+# -- dependency-tracked state ------------------------------------------------
+
+
+def test_state_persistence_and_digest_guard(base, tmp_path):
+    g, d = base
+    digest = graph_digest(g)
+    ck_dir = BatchCheckpointer(d, graph_key=digest).dir
+    state = IncrementalState.load(ck_dir, expect_digest=digest)
+    assert state is not None
+    assert state.graph_digest == digest
+    assert len(state.part_digests) == state.num_parts
+    assert len(state.locals_closed) == state.num_parts
+    # Wrong digest: invisible, never silently reused.
+    assert IncrementalState.load(ck_dir, expect_digest="0" * 16) is None
+    # Round-trips bitwise through save/load.
+    state.save(tmp_path)
+    again = IncrementalState.load(tmp_path, expect_digest=digest)
+    assert again.part_digests == state.part_digests
+    assert again.core_digest == state.core_digest
+    np.testing.assert_array_equal(again.labels, state.labels)
+    for a, b in zip(again.locals_closed, state.locals_closed):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(again.core_closed, state.core_closed)
+
+
+def test_diagnose_maps_updates_to_dirty_parts(base):
+    g, d = base
+    digest = graph_digest(g)
+    state = IncrementalState.load(
+        BatchCheckpointer(d, graph_key=digest).dir, expect_digest=digest
+    )
+    labels = state.labels
+    e = g.num_real_edges
+    within = np.flatnonzero(labels[g.src[:e]] == labels[g.indices[:e]])
+    cross = np.flatnonzero(labels[g.src[:e]] != labels[g.indices[:e]])
+    i, j = int(within[0]), int(cross[0])
+    changed = [
+        (int(g.src[i]), int(g.indices[i]), 1.0, 2.0),
+        (int(g.src[j]), int(g.indices[j]), 1.0, 2.0),
+    ]
+    diag = diagnose(state, changed)
+    assert diag.dirty_parts == [int(labels[g.src[i]])]
+    assert diag.cross_changed == 1 and diag.core_dirty
+    assert diag.num_parts == state.num_parts
+    d2 = diagnose(state, changed[:1])
+    assert d2.cross_changed == 0 and not d2.core_dirty
+
+
+# -- the repair engine -------------------------------------------------------
+
+
+def test_repair_heavy_insert_copies_everything(ckpt):
+    """A non-improving insert dirties one part's digest but changes no
+    closure bitwise -> the affected set is EMPTY: every row is copied,
+    the core is never re-closed, and nothing goes stale."""
+    g, d = ckpt
+    digest = graph_digest(g)
+    state = IncrementalState.load(
+        BatchCheckpointer(d, graph_key=digest).dir, expect_digest=digest
+    )
+    labels = state.labels
+    same = [(u, v) for u in range(0, 12) for v in range(12, 30)
+            if labels[u] == labels[v]]
+    existing = {(int(a), int(b)) for a, b in zip(g.src, g.indices)}
+    u, v = next(p for p in same if p not in existing)
+    updates = [(u, v, 900.0)]
+    out = repair_checkpoint(d, g, updates, config=cfg_for(d))
+    assert out.dirty_parts_closed == 1
+    assert not out.core_recomputed
+    assert out.affected_rows == 0
+    assert out.rows_copied == g.num_nodes
+    assert out.rows_recomputed == 0 and out.rows_patched == 0
+    assert_repaired_bitwise(d, g, updates, out)
+    status = read_repair_status(
+        BatchCheckpointer(d, graph_key=digest).dir
+    )
+    assert status["status"] == "done" and status["affected"] == []
+
+
+def test_repair_decrease_bitwise_and_stale_serving(ckpt):
+    """A distance-changing decrease: dirty-part counter < parts_total,
+    repaired rows bitwise == fresh solve, and the old store serves
+    affected answers with stale: true (counted, exported)."""
+    from paralleljohnson_tpu.serve import QueryEngine, TileStore
+
+    g, d = ckpt
+    e0 = 5
+    updates = [(int(g.src[e0]), int(g.indices[e0]), 1.0 / 4.0)]
+    out = repair_checkpoint(d, g, updates, config=cfg_for(d))
+    assert 0 < out.dirty_parts_closed < out.parts_total
+    assert out.affected_rows > 0
+    assert_repaired_bitwise(d, g, updates, out)
+    # The OLD store: every affected answer flagged, nothing unflagged.
+    store = TileStore(d, g)
+    engine = QueryEngine(g, store, config=SolverConfig())
+    stale_set = store.stale_info()
+    assert stale_set is not None
+    probe = [0, 1, g.num_nodes // 2, g.num_nodes - 1]
+    for s in probe:
+        resp = engine.query(s, 3)
+        expected = stale_set == "all" or s in stale_set
+        assert resp.get("stale", False) == expected, (s, resp)
+    n_stale = sum(
+        1 for s in probe if stale_set == "all" or s in stale_set
+    )
+    assert engine.stats.stale_answers == n_stale > 0
+    assert engine.serve_summary()["engine"]["stale_answers"] == n_stale
+    metrics = engine.write_metrics(d / "m.prom")
+    text = metrics.read_text(encoding="utf-8")
+    assert f"pjtpu_stale_answers_total {float(n_stale)}" in text
+    # The NEW digest's store serves fresh rows, unflagged.
+    new_g, _ = g.apply_edge_updates(updates)
+    store2 = TileStore(d, new_g)
+    engine2 = QueryEngine(new_g, store2, config=SolverConfig())
+    r = engine2.query(probe[0], 3)
+    assert "stale" not in r
+    assert r["exact"] is True
+
+
+def test_repair_k_edge_mixed_batch(ckpt):
+    """k-edge batch mixing reweight + insert + remove, spanning parts
+    and the core: still bitwise, still partial where provable."""
+    g, d = ckpt
+    digest = graph_digest(g)
+    state = IncrementalState.load(
+        BatchCheckpointer(d, graph_key=digest).dir, expect_digest=digest
+    )
+    labels = state.labels
+    e = g.num_real_edges
+    cross = np.flatnonzero(labels[g.src[:e]] != labels[g.indices[:e]])
+    j = int(cross[0])
+    existing = {(int(a), int(b)) for a, b in zip(g.src, g.indices)}
+    u_new, v_new = next(
+        (u, v) for u in range(g.num_nodes) for v in range(g.num_nodes)
+        if u != v and (u, v) not in existing
+    )
+    updates = [
+        (int(g.src[2]), int(g.indices[2]), 1.0),           # reweight down
+        (int(g.src[j]), int(g.indices[j]), None),          # remove cross
+        (u_new, v_new, 2.0),                               # insert
+    ]
+    out = repair_checkpoint(d, g, updates, config=cfg_for(d))
+    assert out.batches_rewritten > 0
+    assert_repaired_bitwise(d, g, updates, out)
+
+
+def test_repair_negative_cycle_create_then_destroy(ckpt):
+    """An update creating a negative cycle fails loudly (status
+    'failed', old checkpoint intact); widening the batch to destroy the
+    cycle again repairs cleanly — create/destroy both covered."""
+    g, d = ckpt
+    digest = graph_digest(g)
+    creating = [(0, 1, 1.0), (1, 0, -5.0)]
+    with pytest.raises(NegativeCycleError):
+        repair_checkpoint(d, g, creating, config=cfg_for(d))
+    old_dir = BatchCheckpointer(d, graph_key=digest).dir
+    assert read_repair_status(old_dir)["status"] == "failed"
+    # Old checkpoint is untouched and still fully readable.
+    assert len(checkpoint_rows(d, g)) == g.num_nodes
+    # Same edges, cycle destroyed within the batch: repair succeeds.
+    destroying = creating + [(1, 0, 6.0)]
+    out = repair_checkpoint(d, g, destroying, config=cfg_for(d))
+    assert_repaired_bitwise(d, g, destroying, out)
+
+
+def test_repair_disconnecting_parts(ckpt):
+    """Removing every cross-part edge disconnects the parts: boundary
+    collapses, all rows re-expand, cross-part distances become inf —
+    bitwise-equal to the fresh solve of the disconnected graph."""
+    g, d = ckpt
+    digest = graph_digest(g)
+    state = IncrementalState.load(
+        BatchCheckpointer(d, graph_key=digest).dir, expect_digest=digest
+    )
+    labels = state.labels
+    e = g.num_real_edges
+    cross = np.flatnonzero(labels[g.src[:e]] != labels[g.indices[:e]])
+    updates = [
+        (int(g.src[i]), int(g.indices[i]), None) for i in cross
+    ]
+    out = repair_checkpoint(d, g, updates, config=cfg_for(d))
+    assert out.boundary_changed
+    new_g = assert_repaired_bitwise(d, g, updates, out)
+    rows = checkpoint_rows(d, new_g)
+    s = int(np.flatnonzero(labels == labels[0])[0])
+    other = int(np.flatnonzero(labels != labels[0])[0])
+    assert np.isinf(rows[s][other])
+
+
+def test_repair_chained_updates(ckpt):
+    """Two sequential repairs: the second loads the state the first
+    persisted under the new digest (no rebuild) and stays bitwise."""
+    g, d = ckpt
+    upd1 = [(int(g.src[7]), int(g.indices[7]), 1.0)]
+    repair_checkpoint(d, g, upd1, config=cfg_for(d))
+    g1, _ = g.apply_edge_updates(upd1)
+    d1 = graph_digest(g1)
+    # The chained state exists under the new digest...
+    st = IncrementalState.load(
+        BatchCheckpointer(d, graph_key=d1).dir, expect_digest=d1
+    )
+    assert st is not None
+    # ...and the second repair uses it without a rebuild.
+    upd2 = [(int(g1.src[11]), int(g1.indices[11]), 1.0)]
+    out2 = repair_checkpoint(d, g1, upd2, config=cfg_for(d))
+    assert_repaired_bitwise(d, g1, upd2, out2)
+
+
+def test_repair_trivial_noop(ckpt):
+    g, d = ckpt
+    u, v = int(g.src[0]), int(g.indices[0])
+    out = repair_checkpoint(
+        d, g, [(u, v, float(g.weights[0]))], config=cfg_for(d)
+    )
+    assert out.trivial
+    assert out.new_digest == out.old_digest
+    # No repair marker: nothing went stale.
+    assert read_repair_status(
+        BatchCheckpointer(d, graph_key=graph_digest(g)).dir
+    ) is None
+
+
+def test_repair_profile_record(ckpt, tmp_path):
+    """The repair lands a kind="repair" profile record and calibrates
+    the incremental-repair route in the cost model."""
+    from paralleljohnson_tpu.observe import CostModel, ProfileStore
+
+    g, d = ckpt
+    store_dir = tmp_path / "profiles"
+    cfg = cfg_for(d, profile_store=str(store_dir))
+    repair_checkpoint(
+        d, g, [(int(g.src[3]), int(g.indices[3]), 1.0)], config=cfg
+    )
+    records = ProfileStore(store_dir).records()
+    reps = [r for r in records if r.get("kind") == "repair"]
+    assert len(reps) == 1
+    rec = reps[0]
+    assert rec["route"] == "incremental-repair"
+    assert rec["repair"]["dirty_parts_closed"] >= 1
+    model = CostModel.fit(ProfileStore(store_dir))
+    assert any(r == "incremental-repair" for r, _p in model.entries)
+
+
+# -- property tests: repaired == fresh, bitwise ------------------------------
+
+
+def _random_updates(g, rng, k):
+    """k random updates: reweights/removals of existing edges plus the
+    occasional insert, integer weights."""
+    e = g.num_real_edges
+    updates = []
+    for _ in range(k):
+        kind = rng.integers(0, 4)
+        if kind == 3 or e == 0:
+            u = int(rng.integers(0, g.num_nodes))
+            v = int(rng.integers(0, g.num_nodes - 1))
+            v = v + (v >= u)
+            updates.append((u, v, float(rng.integers(1, 9))))
+        else:
+            i = int(rng.integers(0, e))
+            u, v = int(g.src[i]), int(g.indices[i])
+            updates.append(
+                (u, v, None) if kind == 2
+                else (u, v, float(rng.integers(1, 9)))
+            )
+    return updates
+
+
+def _check_random_repair(seed: int, tmp_path, n_parts=2):
+    rng = np.random.default_rng(seed)
+    g = intify(grid2d(5, 5, seed=seed))
+    d = tmp_path / f"ck{seed}"
+    cfg = SolverConfig(checkpoint_dir=str(d), source_batch_size=BATCH)
+    ParallelJohnsonSolver(cfg).solve(g)
+    state = IncrementalState.build(g, num_parts=n_parts, seed=0)
+    state.save(BatchCheckpointer(d, graph_key=graph_digest(g)).dir)
+    updates = _random_updates(g, rng, int(rng.integers(1, 5)))
+    _g2, report = g.apply_edge_updates(updates)
+    out = repair_checkpoint(d, g, updates, config=cfg)
+    if report.num_changed:
+        assert out.dirty_parts_closed <= len(out.diag.dirty_parts)
+    assert_repaired_bitwise(d, g, updates, out)
+
+
+def test_random_repairs_deterministic_twin(tmp_path):
+    """Always-on twin of the hypothesis property: fixed seeds, random
+    single- and k-edge batches, repaired == fresh bitwise."""
+    for seed in (3, 11, 29):
+        _check_random_repair(seed, tmp_path)
+
+
+def test_random_repairs_hypothesis(tmp_path):
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    @settings(max_examples=5, deadline=None)
+    @given(seed=st.integers(min_value=0, max_value=10_000))
+    def run(seed):
+        _check_random_repair(seed, tmp_path)
+
+    run()
+
+
+# -- fleet repair ------------------------------------------------------------
+
+
+def test_repair_fleet_in_process(ckpt, tmp_path):
+    """Repair sharded through coordinator leases: claims committed by
+    multiple workers, rows bitwise-equal to a fresh solve."""
+    from paralleljohnson_tpu.distributed import Coordinator
+    from paralleljohnson_tpu.incremental.fleet import (
+        run_in_process_repair_fleet,
+    )
+
+    g, d = ckpt
+    updates = [(int(g.src[4]), int(g.indices[4]), 1.0)]
+    out = run_in_process_repair_fleet(
+        d, g, updates, coordinator_dir=tmp_path / "coord", workers=2,
+        lease_rows=16, config=cfg_for(d),
+    )
+    assert_repaired_bitwise(d, g, updates, out)
+    status = Coordinator(tmp_path / "coord").status()
+    assert status["leases"]["committed"] == status["leases_total"] > 1
+    assert status["leases"]["pending"] == 0
+    assert len(status["committed_by"]) >= 2  # round-robin spread
+    assert status["graph_spec"] == f"repair:{out.new_digest}"
+
+
+# -- CLI + bench -------------------------------------------------------------
+
+
+def test_cli_update_exit_codes(base, tmp_path, capsys):
+    from paralleljohnson_tpu.cli import main
+
+    g, d0 = base
+    d = tmp_path / "ckpt"
+    shutil.copytree(d0, d)
+    gr = tmp_path / "g.gr"
+    save_dimacs(g, gr)
+    upd = tmp_path / "u.jsonl"
+    upd.write_text(
+        json.dumps({"u": int(g.src[5]), "v": int(g.indices[5]),
+                    "w": 1.0}) + "\n",
+        encoding="utf-8",
+    )
+    # Dry run: dirty-set diagnosis, rc 0.
+    rc = main(["update", str(gr), "--updates", str(upd),
+               "--checkpoint-dir", str(d), "--dry-run"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["dry_run"]
+    assert payload["dirty_set"]["dirty_parts"]
+    # Real repair, rc 0, machine-readable summary.
+    rc = main(["update", str(gr), "--updates", str(upd),
+               "--checkpoint-dir", str(d), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["dirty_parts_closed"] < payload["parts_total"]
+    assert payload["batches_rewritten"] > 0
+    # Negative cycle -> rc 2 (consistent with serve/fleet codes).
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"u": 0, "v": 1, "w": 1}\n'
+                   '{"u": 1, "v": 0, "w": -9}\n', encoding="utf-8")
+    assert main(["update", str(gr), "--updates", str(bad),
+                 "--checkpoint-dir", str(d)]) == 2
+    # Malformed update file -> rc 1, file:line in the message.
+    mal = tmp_path / "mal.txt"
+    mal.write_text("not an update\n", encoding="utf-8")
+    assert main(["update", str(gr), "--updates", str(mal),
+                 "--checkpoint-dir", str(d)]) == 1
+    assert "mal.txt:1" in capsys.readouterr().err
+    # Missing --checkpoint-dir -> rc 1.
+    assert main(["update", str(gr), "--updates", str(upd)]) == 1
+
+
+def test_cli_info_incremental_block(base, tmp_path, capsys):
+    from paralleljohnson_tpu.cli import main
+
+    g, d0 = base
+    d = tmp_path / "ckpt"
+    shutil.copytree(d0, d)
+    gr = tmp_path / "g.gr"
+    save_dimacs(g, gr)
+    upd = tmp_path / "u.jsonl"
+    upd.write_text('{"u": 0, "v": 1, "w": 2}\n', encoding="utf-8")
+    rc = main(["info", str(gr), "--updates", str(upd),
+               "--checkpoint-dir", str(d), "--json"])
+    info = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    block = info["incremental"]
+    # Exit codes documented consistently with serve/fleet (0/1/2/3).
+    assert sorted(block["exit_codes"]) == ["0", "1", "2", "3"]
+    assert "pjtpu update" in block["command"]
+    diagnosis = block["diagnosis"]
+    assert diagnosis["checkpoint_batches"] > 0
+    assert diagnosis["report"]["num_changed"] == 1
+    assert "dirty_parts" in diagnosis["dirty_set"]
+
+
+def test_bench_incremental_update_smoke():
+    from paralleljohnson_tpu import benchmarks
+
+    rec = benchmarks.bench_incremental_update("jax", "smoke")
+    assert rec.config == "incremental_update"
+    detail = rec.detail
+    assert "failed" not in detail, detail
+    assert detail["dirty_parts"] < detail["parts_total"]
+    assert detail["repair_speedup"] > 0
+    assert "full_resolve_wall_s" in detail
+
+
+# -- store staleness unit surface --------------------------------------------
+
+
+def test_tilestore_manual_stale_marks():
+    from paralleljohnson_tpu.serve import TileStore
+
+    g = intify(grid2d(3, 3, seed=0))
+    store = TileStore(None, g)
+    assert store.stale_info() is None
+    assert not store.is_stale(0)
+    store.mark_stale([1, 2])
+    assert store.is_stale(1) and store.is_stale(2)
+    assert not store.is_stale(0)
+    store.mark_stale("all")
+    assert store.is_stale(0)
+    store.clear_stale()
+    assert store.stale_info() is None
+    with pytest.raises(ValueError):
+        store.mark_stale("some")
