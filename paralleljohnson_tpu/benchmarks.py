@@ -87,7 +87,8 @@ _SIZES = {
     "batch_small":   dict(count=32,    mini_count=512,   full_count=10000),
     "dense_apsp_fw": dict(n=96,        mini_n=384,       full_n=2048),
     "serve_queries": dict(n=256,       mini_n=1024,      full_n=4096,
-                          queries=200, mini_queries=2000, full_queries=20000),
+                          queries=200, mini_queries=2000, full_queries=20000,
+                          clients=4,   mini_clients=4,   full_clients=8),
     "distributed_fleet": dict(n=96,    mini_n=1024,      full_n=4096,
                           workers=2,   mini_workers=3,   full_workers=4),
     "incremental_update": dict(n=96,   mini_n=1024,      full_n=4096,
@@ -523,27 +524,38 @@ def bench_dense_apsp_fw(backend: str, preset: str) -> BenchRecord:
 
 
 def bench_serve_queries(backend: str, preset: str) -> BenchRecord:
-    """Config 6 (round-11 tentpole): the query-serving layer, measured
-    the way kernels are — ``queries/sec`` with p50/p99 latency in the
-    detail column. A checkpoint-backed store is warmed with a quarter of
-    the sources (one scheduled exact batch), a landmark index covers the
-    rest, then a seeded 85/15 hit/approx query mix is replayed through a
-    FRESH engine (clean counters) in CLI-sized aggregation batches. The
-    timed loop includes the tier walk, LRU promotion, and landmark bound
-    arithmetic — everything a served query pays except network."""
+    """Config 6 (round-11 tentpole, concurrent since ISSUE 12): the
+    query-serving layer measured as a TRAFFIC-BEARING SERVICE — K >= 4
+    client threads offering a sustained request rate, not one thread
+    replaying as fast as it can. A checkpoint-backed store is warmed
+    with a quarter of the sources (one scheduled exact batch), a
+    landmark index covers the rest, then a seeded 85/15 hit/approx mix
+    is split across K paced client threads against ONE shared engine
+    (the thread-safety contract under test is the deployment shape).
+    The offered rate is calibrated from a short closed-loop probe
+    (~70% of measured serial capacity — sustained load, not overload),
+    each client sleeps to its own send schedule, and the detail column
+    reports the STREAMING histogram p50/p99 with their one-bucket error
+    bounds plus the SLO burn verdict — the row is the CPU twin of the
+    staged `jax-serve-bench` stage."""
     import tempfile
+    import threading
 
     from paralleljohnson_tpu.graphs import erdos_renyi
+    from paralleljohnson_tpu.observe.live import SLO
     from paralleljohnson_tpu.serve import LandmarkIndex, QueryEngine, TileStore
 
     n = _sz("serve_queries", "n", preset)
     n_queries = _sz("serve_queries", "queries", preset)
+    n_clients = _sz("serve_queries", "clients", preset)
     g = erdos_renyi(n, 8.0 / n, seed=13)
     cfg_kwargs = dict(telemetry=_BENCH_TELEMETRY.get(),
                       profile_store=_BENCH_PROFILE.get())
     from paralleljohnson_tpu.config import SolverConfig
 
     cfg = SolverConfig(backend=backend, **cfg_kwargs)
+    slo = SLO(name="serve", latency_ms=250.0, availability=0.999,
+              rules=((60.0, 15.0, 14.4), (300.0, 60.0, 6.0)))
     rng = np.random.default_rng(17)
     warm_sources = np.sort(rng.choice(n, size=max(8, n // 4), replace=False))
     with tempfile.TemporaryDirectory() as d:
@@ -551,10 +563,13 @@ def bench_serve_queries(backend: str, preset: str) -> BenchRecord:
         landmarks = LandmarkIndex.build(g, k=8, config=cfg, seed=0)
         QueryEngine(g, store, landmarks=landmarks, config=cfg,
                     miss_policy="landmark").warm(warm_sources)
-        # Fresh engine for the timed loop: the warm batch's latencies
-        # and counters must not pollute the measurement.
+        # Separate calibration engine over the same store, then a fresh
+        # engine for the timed loop: neither the warm batch's nor the
+        # closed-loop probe's latencies may pollute the measurement.
+        probe_engine = QueryEngine(g, store, landmarks=landmarks,
+                                   config=cfg, miss_policy="landmark")
         engine = QueryEngine(g, store, landmarks=landmarks, config=cfg,
-                             miss_policy="landmark")
+                             miss_policy="landmark", slo=slo)
         warm_set = set(int(s) for s in warm_sources)
         cold_pool = np.array(sorted(set(range(n)) - warm_set), np.int64)
         hit = rng.random(n_queries) < 0.85
@@ -568,25 +583,89 @@ def bench_serve_queries(backend: str, preset: str) -> BenchRecord:
             {"id": i, "source": int(srcs[i]), "dst": int(dsts[i])}
             for i in range(n_queries)
         ]
-        engine.query_batch(requests[: min(32, n_queries)])  # warm caches
+        probe = requests[: min(64, n_queries)]
+        batch_size = 16  # per-client aggregation batch
         t0 = time.perf_counter()
-        for i in range(0, n_queries, 64):  # CLI-default aggregation size
-            engine.query_batch(requests[i : i + 64])
+        for i in range(0, len(probe), batch_size):  # closed-loop probe
+            probe_engine.query_batch(probe[i : i + batch_size])
+        serial_qps = len(probe) / max(time.perf_counter() - t0, 1e-9)
+        offered_qps = max(50.0, 0.7 * serial_qps)
+
+        # Split the mix round-robin across K clients; each paces its
+        # batches to the shared offered rate (open-loop per client: a
+        # slow server makes latency grow, it does not slow the offers).
+        per_client = offered_qps / n_clients
+        slices = [requests[k::n_clients] for k in range(n_clients)]
+        barrier = threading.Barrier(n_clients + 1)
+        errors: list[BaseException] = []
+
+        def client(k: int) -> None:
+            try:
+                mine = slices[k]
+                barrier.wait()
+                start = time.perf_counter()
+                sent = 0
+                for i in range(0, len(mine), batch_size):
+                    due = start + sent / per_client
+                    delay = due - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    batch = mine[i : i + batch_size]
+                    engine.query_batch(batch)
+                    sent += len(batch)
+            except BaseException as e:  # noqa: BLE001 — surface, don't hang
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(k,),
+                                    name=f"bench-client-{k}")
+                   for k in range(n_clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
         wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
         pcts = engine.stats.percentiles()
+        verdict = engine.metrics.slo(slo).evaluate()
+        latency = verdict.get("latency") or {}
         detail = {
             "nodes": g.num_nodes, "edges": g.num_real_edges,
             "queries": n_queries, "landmarks": landmarks.k,
             "warm_sources": len(warm_sources),
+            "clients": n_clients,
+            "offered_per_s": round(offered_qps, 2),
             "queries_per_s": round(n_queries / max(wall, 1e-9), 2),
+            # Streaming-histogram estimates with their one-bucket error
+            # bounds (never an unflagged approximation — ISSUE 12).
             "p50_ms": round(pcts["p50_ms"], 4),
+            "p50_err_ms": round(pcts["p50_err_ms"], 4),
             "p99_ms": round(pcts["p99_ms"], 4),
+            "p99_err_ms": round(pcts["p99_err_ms"], 4),
+            "slo": {
+                "p99_target_ms": slo.latency_ms,
+                "availability": slo.availability,
+                "verdict": "burn" if verdict["burning"] else "ok",
+                "burn_rate": verdict["burn_rate"],
+                "p99_met": latency.get("met"),
+            },
             "hit_rate": round(engine.store.hit_rate(), 4),
             "approx_frac": round(
                 engine.stats.approx_answers
                 / max(1, engine.stats.queries_total), 4,
             ),
         }
+        # Leave the live snapshot beside the flight recorder when the
+        # pass runs with telemetry (tpu_round3_run.sh preserves the dir;
+        # the slo-report stage reads it offline).
+        tel = _BENCH_TELEMETRY.get()
+        if tel is not None and getattr(tel, "trace_dir", None):
+            engine.metrics.write_snapshot(
+                Path(tel.trace_dir) / "serve_live.json"
+            )
+        engine.close()
     # The serving row's headline is queries/sec, not edges/sec — the
     # edges columns stay zero rather than conflating warm-solve compute
     # with the request loop being measured.

@@ -458,7 +458,50 @@ def main(argv: list[str] | None = None) -> int:
     p_serve.add_argument("--summary", action="store_true",
                          help="print the serving summary JSON (engine + "
                               "store counters, hit rate) to stderr at exit")
+    p_serve.add_argument("--slo-p99-ms", type=float, default=250.0,
+                         help="serving SLO latency target: p99 of the "
+                              "streaming latency histogram must stay "
+                              "under this (default 250 ms)")
+    p_serve.add_argument("--slo-availability", type=float, default=0.999,
+                         help="serving SLO availability target: the "
+                              "good-query fraction whose complement is "
+                              "the error budget burn-rate alerts spend "
+                              "(default 0.999)")
+    p_serve.add_argument("--stats-interval", type=float, default=5.0,
+                         metavar="SECONDS",
+                         help="atomically rewrite serve_stats.json in the "
+                              "store dir every N seconds while serving "
+                              "(heartbeat idiom — a killed process leaves "
+                              "stats fresh to within N; 0 disables)")
     _add_common(p_serve)
+
+    p_top = sub.add_parser(
+        "top",
+        help="fleet-wide operations console (README 'Live operations'): "
+             "join serve snapshots, coordinator lease table, worker "
+             "heartbeats + live metrics, and repair status into one "
+             "live-refreshing view (or --once [--json] for scripts/CI)",
+    )
+    p_top.add_argument("--serve-store", default=None, metavar="DIR",
+                       help="serving store / checkpoint directory whose "
+                            "graph_* subdirectories' serve_stats.json + "
+                            "repair_status.json to join")
+    p_top.add_argument("--coordinator-dir", default=None, metavar="DIR",
+                       help="fleet coordinator directory (lease table, "
+                            "worker heartbeats, metrics/<worker>.json)")
+    p_top.add_argument("--once", action="store_true",
+                       help="print one view and exit (default: refresh "
+                            "every --interval seconds until interrupted)")
+    p_top.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the joined document as JSON (one line "
+                            "with --once, one line per refresh otherwise)")
+    p_top.add_argument("--interval", type=float, default=2.0,
+                       metavar="SECONDS",
+                       help="refresh period of the live view (default 2)")
+    p_top.add_argument("--stale-after", type=float, default=15.0,
+                       metavar="SECONDS",
+                       help="flag a snapshot/heartbeat stale once its own "
+                            "publish stamp is older than this (default 15)")
 
     p_update = sub.add_parser(
         "update",
@@ -606,6 +649,38 @@ def main(argv: list[str] | None = None) -> int:
         if args.update_baseline:
             benchmarks.update_baseline_md(records, args.update_baseline)
         return 0
+
+    if args.command == "top":
+        import time as _time
+
+        from paralleljohnson_tpu.observe.top import gather_ops, render_ops
+
+        if args.serve_store is None and args.coordinator_dir is None:
+            print(
+                "error: pjtpu top needs --serve-store and/or "
+                "--coordinator-dir (nothing to watch)",
+                file=sys.stderr,
+            )
+            return 1
+        try:
+            while True:
+                doc = gather_ops(
+                    serve_store=args.serve_store,
+                    coordinator_dir=args.coordinator_dir,
+                    stale_after_s=args.stale_after,
+                )
+                if args.as_json:
+                    print(json.dumps(doc), flush=True)
+                else:
+                    if not args.once:
+                        # ANSI clear + home: repaint in place like top(1).
+                        print("\x1b[2J\x1b[H", end="")
+                    print(render_ops(doc), flush=True)
+                if args.once:
+                    return 0
+                _time.sleep(max(0.1, args.interval))
+        except KeyboardInterrupt:
+            return 0
 
     if args.command == "fleet":
         from paralleljohnson_tpu.distributed import (
@@ -1146,9 +1221,15 @@ def main(argv: list[str] | None = None) -> int:
                     landmarks = LandmarkIndex.build(g, k, config=cfg)
                     if store.ckpt is not None:
                         landmarks.save(store.ckpt.dir)
+            from paralleljohnson_tpu.observe.live import SLO
+
             engine = QueryEngine(
                 g, store, landmarks=landmarks, config=cfg,
                 miss_policy=args.miss_policy,
+                slo=SLO(name="serve", latency_ms=args.slo_p99_ms,
+                        latency_pct=99.0,
+                        availability=args.slo_availability),
+                stats_interval_s=args.stats_interval,
             )
             stream = (
                 sys.stdin if args.queries == "-"

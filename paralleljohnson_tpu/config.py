@@ -249,6 +249,14 @@ class SolverConfig:
         default and near-free when off (all call sites route through
         ``telemetry.NULL_TELEMETRY``). CLI: ``--trace-dir`` /
         ``--heartbeat-file`` / ``--heartbeat-interval``.
+      metrics: an ``observe.live.MetricsRegistry`` (or None, the
+        default) — the live SLO observatory (ISSUE 12): the batch loop
+        streams per-batch wall-clock into a log-bucketed histogram and
+        retry/OOM counts into sliding-window rate counters, and the
+        registry's snapshotter atomically publishes the view every few
+        seconds (what ``pjtpu top`` and fleet workers read). Near-free
+        when None (all call sites route through
+        ``observe.live.NULL_METRICS``).
     """
 
     backend: str = "jax"
@@ -289,6 +297,7 @@ class SolverConfig:
     profile_store: str | None = None
     convergence: bool | str = "auto"
     telemetry: object | None = None
+    metrics: object | None = None
 
     @property
     def np_dtype(self):

@@ -186,7 +186,8 @@ class Coordinator:
         tmp.write_text(json.dumps(spec, indent=2), encoding="utf-8")
         os.replace(tmp, directory / FLEET_SPEC)
         (directory / LEASE_LOG).touch()
-        for sub in ("heartbeats", "shards", "telemetry", "workers", "logs"):
+        for sub in ("heartbeats", "shards", "telemetry", "workers", "logs",
+                    "metrics"):
             (directory / sub).mkdir(exist_ok=True)
         return cls(directory)
 
@@ -206,6 +207,14 @@ class Coordinator:
 
     def worker_summary_path(self, worker: str) -> Path:
         return self.dir / "workers" / f"{worker}.summary.json"
+
+    def metrics_path(self, worker: str) -> Path:
+        """The worker's live-metrics snapshot (ISSUE 12): lease
+        claim-to-commit latency histogram, solver batch walls,
+        retry/OOM rates — atomically rewritten every heartbeat interval
+        by the worker's ``MetricsRegistry`` snapshotter, joined
+        fleet-wide by ``pjtpu top``."""
+        return self.dir / "metrics" / f"{worker}.json"
 
     # -- log machinery -------------------------------------------------------
 

@@ -78,6 +78,7 @@ def run_worker(
     """
     from paralleljohnson_tpu.config import SolverConfig
     from paralleljohnson_tpu.graphs import load_graph
+    from paralleljohnson_tpu.observe.live import MetricsRegistry
     from paralleljohnson_tpu.solver import ParallelJohnsonSolver
     from paralleljohnson_tpu.utils.checkpoint import graph_digest
     from paralleljohnson_tpu.utils.telemetry import Telemetry
@@ -92,6 +93,18 @@ def run_worker(
         heartbeat_interval_s=float(spec["heartbeat_interval_s"]),
         label=f"worker-{worker_id}",
     )
+    # Live metrics (ISSUE 12): claim-to-commit lease latency + the
+    # solver's batch walls/retry rates, atomically snapshotted into
+    # <coord>/metrics/<worker>.json on the heartbeat's clock — a
+    # SIGKILLed worker leaves a view fresh to within one interval, and
+    # `pjtpu top` joins every worker's snapshot into the fleet picture.
+    metrics = MetricsRegistry(
+        label=f"worker-{worker_id}", telemetry=tel
+    ).start_snapshotter(
+        coord.metrics_path(worker_id),
+        interval_s=float(spec["heartbeat_interval_s"]),
+    )
+    lease_hist = metrics.histogram("pjtpu_lease_wall_ms")
     summary = {
         "worker": worker_id,
         "pid": os.getpid(),
@@ -125,6 +138,7 @@ def run_worker(
         cfg_kwargs["backend"] = cfg_kwargs.get("backend", spec["backend"])
         cfg_kwargs["checkpoint_dir"] = str(coord.shard_dir(worker_id))
         cfg_kwargs["telemetry"] = tel
+        cfg_kwargs["metrics"] = metrics
         solver = ParallelJohnsonSolver(SolverConfig(**cfg_kwargs))
 
         idle_since = None
@@ -148,6 +162,8 @@ def run_worker(
                 continue
             idle_since = None
             summary["claims"] += 1
+            t_claim = time.perf_counter()
+            metrics.counter("pjtpu_lease_claims").add(1)
             if (
                 self_kill_after_claims is not None
                 and summary["claims"] >= self_kill_after_claims
@@ -186,10 +202,16 @@ def run_worker(
                 # range: drop it (the rows stay orphaned in this shard;
                 # the manifest union only references committing owners).
                 summary["stale_commits"] += 1
+                metrics.counter("pjtpu_lease_stale_commits").add(1)
                 if tel:
                     tel.event("lease_stale_commit", worker=worker_id,
                               lease=lease.lease_id)
                 continue
+            # Claim-to-commit wall: what a lease actually costs this
+            # worker (solve + checkpoint + coordinator round trips) —
+            # the number lease sizing will be priced against.
+            lease_hist.record((time.perf_counter() - t_claim) * 1e3)
+            metrics.counter("pjtpu_leases_committed").add(1)
             summary["leases_committed"].append(lease.lease_id)
             summary["sources_solved"] += lease.stop - lease.start
             summary["edges_relaxed"] += int(res.stats.edges_relaxed)
@@ -203,6 +225,7 @@ def run_worker(
         raise
     finally:
         summary["wall_s"] = round(time.perf_counter() - t0, 6)
+        metrics.stop_snapshotter()
         try:
             _write_json_atomic(coord.worker_summary_path(worker_id), summary)
         except OSError:

@@ -77,6 +77,7 @@ from paralleljohnson_tpu.utils.checkpoint import (
     checked_save,
     graph_digest,
 )
+from paralleljohnson_tpu.observe.live import resolve_metrics as _resolve_metrics
 from paralleljohnson_tpu.utils.telemetry import resolve as _resolve_telemetry
 
 ROUTE_TAG = "incremental-repair"
@@ -590,6 +591,7 @@ def execute_repair(plan: RepairPlan) -> RepairResult:
             closures_s=0.0, expand_s=0.0, io_s=0.0,
             wall_s=time.perf_counter() - t_start, diag=plan.diag,
         )
+    live = _resolve_metrics(getattr(plan.config, "metrics", None))
     manifest = plan.old_ckpt.manifest()
     files: dict[str, int] = {}
     for _s, (batch_idx, filename) in manifest.items():
@@ -660,6 +662,15 @@ def execute_repair(plan: RepairPlan) -> RepairResult:
         wall_s=time.perf_counter() - t_start,
         diag=plan.diag,
     )
+    # Live metrics (ISSUE 12): repair wall into the streaming histogram
+    # and the exact dirty-part accounting as gauges, so `pjtpu top` (and
+    # a fleet worker's snapshot, when repairs run under one) shows
+    # repair health alongside serve/solve health.
+    live.histogram("pjtpu_repair_wall_ms").record(result.wall_s * 1e3)
+    live.counter("pjtpu_repairs").add(1)
+    live.counter("pjtpu_repair_rows_recomputed").add(result.rows_recomputed)
+    live.gauge("pjtpu_repair_dirty_parts", result.dirty_parts_closed)
+    live.gauge("pjtpu_repair_parts_total", result.parts_total)
     _append_profile_record(plan, result)
     return result
 

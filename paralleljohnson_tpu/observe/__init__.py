@@ -28,6 +28,13 @@ package prices it. Four pieces sharing one persisted artifact:
   comparing new measurements against per-(bench, backend, platform)
   history with a noise band — each flagged row arrives pre-attributed
   with its roofline classification.
+- :mod:`~paralleljohnson_tpu.observe.live` — the live SLO observatory
+  (ISSUE 12): streaming log-bucketed latency histograms, sliding-window
+  rates, multi-window burn-rate SLO alerts, and the
+  :class:`~paralleljohnson_tpu.observe.live.MetricsRegistry` whose
+  atomic periodic snapshots ``pjtpu top``
+  (:mod:`~paralleljohnson_tpu.observe.top`) and
+  ``scripts/slo_report.py`` read.
 
 Everything here except :mod:`costs` is stdlib-only (no numpy, no jax),
 so the offline readers and the suite-budget guard can import it
@@ -49,6 +56,17 @@ from paralleljohnson_tpu.observe.costs import (  # noqa: F401
     CostCapture,
     resolve_profile_dir,
     shape_bucket,
+)
+from paralleljohnson_tpu.observe.live import (  # noqa: F401
+    NULL_METRICS,
+    SLO,
+    LogHistogram,
+    MetricsRegistry,
+    RateCounter,
+    SLOTracker,
+    read_snapshot,
+    resolve_metrics,
+    snapshot_age_s,
 )
 from paralleljohnson_tpu.observe.regress import (  # noqa: F401
     BenchHistory,

@@ -1,0 +1,365 @@
+"""``pjtpu top`` — the fleet-wide operations console (ISSUE 12).
+
+Before this module every operational surface was its own island:
+``serve_stats.json`` per store, ``pjtpu fleet status`` per coordinator,
+per-worker heartbeats, ``repair_status.json`` per graph directory. One
+incident means four file formats and no joined picture. ``top`` reads
+them all — every one an ATOMICALLY-published snapshot, so a reader
+never blocks a producer and a SIGKILLed producer's last view stays
+readable — and joins them into a single document:
+
+- **serve**: per graph directory, throughput (windowed queries/sec from
+  the live snapshot's rate counters), streaming-histogram p50/p99 with
+  their error bounds, hit/stale/error counters, and the SLO burn
+  verdict;
+- **fleet**: the coordinator's lease table (pending/leased/committed,
+  requeues, outstanding leases with deadlines), per-worker heartbeats
+  (stage, batches done, ETA) and live-metrics snapshots (lease
+  claim-to-commit latency, solver batch walls, retry rates);
+- **repairs**: each graph's ``repair_status.json`` (state, dirty parts,
+  remaining sources).
+
+Every joined snapshot carries its AGE (seconds since its own publish
+stamp) and a ``stale`` flag once the age exceeds ``stale_after_s`` —
+the heartbeat-freshness idiom: a fresh file is a live process, a stale
+one is hung or dead, and the console says which rather than presenting
+dead numbers as current.
+
+``gather_ops`` returns the JSON document (``pjtpu top --once --json``
+emits it verbatim for scripts/CI); ``render_ops`` formats the ASCII
+console view the live-refresh loop repaints.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+
+def _age(ts: float | None, now: float) -> float | None:
+    if ts is None:
+        return None
+    return round(now - float(ts), 3)
+
+
+def _flag_stale(entry: dict, ts: float | None, now: float,
+                stale_after_s: float) -> None:
+    age = _age(ts, now)
+    entry["age_s"] = age
+    entry["stale"] = age is None or age > stale_after_s
+
+
+def _read_json(path: Path) -> dict | None:
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def _summarize_live(live: dict | None) -> dict:
+    """Compact the registry snapshot embedded in serve_stats.json /
+    a worker metrics file: windowed rates, key histograms (estimate +
+    error bound), SLO verdicts."""
+    if not live:
+        return {}
+    out: dict = {}
+    counters = live.get("counters") or {}
+    rates = {}
+    for name, c in counters.items():
+        rate_keys = sorted(k for k in c if k.startswith("rate_"))
+        if rate_keys:
+            rates[name] = {k: c[k] for k in rate_keys}
+            rates[name]["total"] = c.get("total")
+    if rates:
+        out["rates"] = rates
+    hists = {}
+    for name, h in (live.get("histograms") or {}).items():
+        hists[name] = {
+            k: h.get(k)
+            for k in ("count", "mean", "p50_ms", "p50_err_ms",
+                      "p99_ms", "p99_err_ms", "max")
+            if k in h
+        }
+    if hists:
+        out["histograms"] = hists
+    slos = {}
+    for name, s in (live.get("slos") or {}).items():
+        slos[name] = {
+            "burning": s.get("burning"),
+            "burn_rate": s.get("burn_rate"),
+            "bad_total": s.get("bad_total"),
+            "events_total": s.get("events_total"),
+            "latency": s.get("latency"),
+        }
+    if slos:
+        out["slos"] = slos
+    if live.get("gauges"):
+        out["gauges"] = live["gauges"]
+    return out
+
+
+def _gather_serve(root: Path, now: float, stale_after_s: float) -> list[dict]:
+    from paralleljohnson_tpu.incremental.status import read_repair_status
+    from paralleljohnson_tpu.serve.engine import SERVE_STATS_FILENAME
+
+    entries = []
+    for d in sorted({root, *root.glob("graph_*")}):
+        stats = _read_json(d / SERVE_STATS_FILENAME)
+        repair = read_repair_status(d)
+        if stats is None and repair is None:
+            continue
+        entry: dict = {"dir": str(d)}
+        if stats is not None:
+            engine = stats.get("engine") or {}
+            store = stats.get("store") or {}
+            entry["serve"] = {
+                "pid": stats.get("pid"),
+                "queries_total": engine.get("queries_total"),
+                "errors": engine.get("errors"),
+                "stale_answers": engine.get("stale_answers"),
+                "hits_by_tier": engine.get("hits_by_tier"),
+                "p50_ms": engine.get("p50_ms"),
+                "p50_err_ms": engine.get("p50_err_ms"),
+                "p99_ms": engine.get("p99_ms"),
+                "p99_err_ms": engine.get("p99_err_ms"),
+                "hit_rate": store.get("hit_rate"),
+                "digest": store.get("digest"),
+                "live": _summarize_live(stats.get("live")),
+            }
+            _flag_stale(entry["serve"], stats.get("ts"), now, stale_after_s)
+        if repair is not None:
+            remaining = repair.get("remaining")
+            entry["repair"] = {
+                "status": repair.get("status"),
+                "new_digest": repair.get("new_digest"),
+                "dirty_parts": repair.get("dirty_parts"),
+                "parts_total": repair.get("parts_total"),
+                "affected": (
+                    "all" if repair.get("affected") == "all"
+                    else len(repair.get("affected") or [])
+                ),
+                "remaining": (
+                    "all" if remaining == "all"
+                    else len(remaining or [])
+                ),
+                "reason": repair.get("reason"),
+            }
+            _flag_stale(entry["repair"], repair.get("ts"), now, stale_after_s)
+        entries.append(entry)
+    return entries
+
+
+def _gather_fleet(coord_dir: Path, now: float, stale_after_s: float) -> dict:
+    from paralleljohnson_tpu.distributed.coordinator import (
+        Coordinator,
+        CoordinatorError,
+    )
+
+    try:
+        coord = Coordinator(coord_dir)
+    except CoordinatorError as e:
+        return {"dir": str(coord_dir), "error": str(e)}
+    status = coord.status(now=now)
+    workers: dict[str, dict] = {}
+    hb_dir = coord_dir / "heartbeats"
+    if hb_dir.is_dir():
+        for p in sorted(hb_dir.glob("*.json")):
+            hb = _read_json(p) or {}
+            w: dict = {
+                "pid": hb.get("pid"),
+                "stage": hb.get("stage"),
+                "lease": hb.get("lease"),
+                "lease_range": hb.get("lease_range"),
+                "batches_done": hb.get("batches_done"),
+                "sources_done": hb.get("sources_done"),
+                "sources_total": hb.get("sources_total"),
+                "eta_s": hb.get("eta_s"),
+                "leases_committed": hb.get("leases_committed"),
+                "last_event": hb.get("last_event"),
+            }
+            _flag_stale(w, hb.get("ts"), now, stale_after_s)
+            workers[p.stem] = w
+    metrics_dir = coord_dir / "metrics"
+    if metrics_dir.is_dir():
+        for p in sorted(metrics_dir.glob("*.json")):
+            snap = _read_json(p)
+            if snap is None:
+                continue
+            w = workers.setdefault(p.stem, {})
+            live = {"pid": snap.get("pid"),
+                    **_summarize_live(snap)}
+            _flag_stale(live, snap.get("ts"), now, stale_after_s)
+            w["metrics"] = live
+    return {
+        "dir": str(coord_dir),
+        "graph_spec": status.get("graph_spec"),
+        "leases": status.get("leases"),
+        "leases_total": status.get("leases_total"),
+        "requeues": status.get("requeues"),
+        "extensions": status.get("extensions"),
+        "outstanding": status.get("outstanding"),
+        "committed_by": status.get("committed_by"),
+        "done": status.get("done"),
+        "workers": workers,
+    }
+
+
+def gather_ops(
+    *,
+    serve_store: str | Path | None = None,
+    coordinator_dir: str | Path | None = None,
+    stale_after_s: float = 15.0,
+    now: float | None = None,
+) -> dict:
+    """One joined operations document (the ``--once --json`` payload).
+    Any source may be absent — the document reports what exists and
+    flags by age what stopped publishing."""
+    now = time.time() if now is None else now
+    doc: dict = {
+        "ts": now,
+        "stale_after_s": float(stale_after_s),
+        "serve": [],
+        "fleet": None,
+        "repairs": [],
+    }
+    if serve_store is not None:
+        root = Path(serve_store)
+        entries = _gather_serve(root, now, stale_after_s)
+        doc["serve"] = [e for e in entries if "serve" in e]
+        doc["repairs"] = [
+            {"dir": e["dir"], **e["repair"]}
+            for e in entries if "repair" in e
+        ]
+    if coordinator_dir is not None:
+        doc["fleet"] = _gather_fleet(Path(coordinator_dir), now,
+                                     stale_after_s)
+    return doc
+
+
+# -- rendering ----------------------------------------------------------------
+
+
+def _fmt(v, nd: int = 2) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "y" if v else "n"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _staleness(entry: dict) -> str:
+    if entry.get("stale"):
+        return (f"STALE ({_fmt(entry.get('age_s'), 1)}s old)"
+                if entry.get("age_s") is not None else "STALE (no snapshot)")
+    return f"fresh {_fmt(entry.get('age_s'), 1)}s"
+
+
+def _render_serve(lines: list[str], entries: list[dict]) -> None:
+    for e in entries:
+        s = e["serve"]
+        lines.append(f"SERVE {e['dir']}  [{_staleness(s)}]")
+        live = s.get("live") or {}
+        qrate = ((live.get("rates") or {}).get("pjtpu_queries") or {})
+        rate_60 = qrate.get("rate_60s")
+        lines.append(
+            f"  queries {_fmt(s.get('queries_total'))} "
+            f"({_fmt(rate_60)}/s 1m)   "
+            f"p50 {_fmt(s.get('p50_ms'))}±{_fmt(s.get('p50_err_ms'))} ms   "
+            f"p99 {_fmt(s.get('p99_ms'))}±{_fmt(s.get('p99_err_ms'))} ms   "
+            f"hit {_fmt(s.get('hit_rate'))}   "
+            f"stale-answers {_fmt(s.get('stale_answers'))}   "
+            f"errors {_fmt(s.get('errors'))}"
+        )
+        for name, slo in (live.get("slos") or {}).items():
+            lat = slo.get("latency") or {}
+            verdict = "BURNING" if slo.get("burning") else "ok"
+            lines.append(
+                f"  SLO {name}: {verdict} (burn {_fmt(slo.get('burn_rate'))}"
+                f", bad {_fmt(slo.get('bad_total'), 0)}/"
+                f"{_fmt(slo.get('events_total'), 0)})"
+                + (f"   p{_fmt(lat.get('pct'), 0)} "
+                   f"{_fmt(lat.get('observed_ms'))} ms "
+                   f"(±{_fmt(lat.get('max_error_ms'))}) "
+                   f"vs target {_fmt(lat.get('target_ms'))} ms -> "
+                   f"{lat.get('met')}" if lat else "")
+            )
+
+
+def _render_fleet(lines: list[str], fleet: dict) -> None:
+    lines.append(f"FLEET {fleet.get('dir')}")
+    if "error" in fleet:
+        lines.append(f"  error: {fleet['error']}")
+        return
+    by_state = fleet.get("leases") or {}
+    lines.append(
+        f"  {fleet.get('graph_spec')}   leases "
+        f"{_fmt(by_state.get('committed'), 0)} committed / "
+        f"{_fmt(by_state.get('leased'), 0)} leased / "
+        f"{_fmt(by_state.get('pending'), 0)} pending of "
+        f"{_fmt(fleet.get('leases_total'), 0)}   "
+        f"requeues {_fmt(fleet.get('requeues'), 0)}   "
+        f"extensions {_fmt(fleet.get('extensions'), 0)}"
+        + ("   DONE" if fleet.get("done") else "")
+    )
+    for lease in fleet.get("outstanding") or []:
+        lines.append(
+            f"  lease {lease.get('lease')} {lease.get('range')} "
+            f"owner {lease.get('owner')} deadline in "
+            f"{_fmt(lease.get('deadline_in_s'), 1)}s"
+        )
+    workers = fleet.get("workers") or {}
+    if workers:
+        lines.append(
+            f"  {'worker':<12} {'state':<22} {'stage':<12} "
+            f"{'done/total':<12} {'eta':<8} {'lease-p50':<12} committed"
+        )
+    for name, w in workers.items():
+        m = (w.get("metrics") or {})
+        lease_hist = ((m.get("histograms") or {})
+                      .get("pjtpu_lease_wall_ms") or {})
+        done = (f"{_fmt(w.get('sources_done'), 0)}/"
+                f"{_fmt(w.get('sources_total'), 0)}")
+        lines.append(
+            f"  {name:<12} {_staleness(w):<22} "
+            f"{_fmt(w.get('stage')):<12} {done:<12} "
+            f"{_fmt(w.get('eta_s'), 1):<8} "
+            f"{_fmt(lease_hist.get('p50_ms'), 1):<12} "
+            f"{_fmt(w.get('leases_committed'), 0)}"
+        )
+
+
+def _render_repairs(lines: list[str], repairs: list[dict]) -> None:
+    for r in repairs:
+        lines.append(
+            f"REPAIR {r.get('dir')}  [{_staleness(r)}]\n"
+            f"  {r.get('status')} -> {r.get('new_digest')}   dirty parts "
+            f"{_fmt(r.get('dirty_parts'), 0)}/{_fmt(r.get('parts_total'), 0)}"
+            f"   affected {r.get('affected')}   remaining "
+            f"{r.get('remaining')}"
+            + (f"   reason: {r.get('reason')}" if r.get("reason") else "")
+        )
+
+
+def render_ops(doc: dict) -> str:
+    """ASCII console view of one gathered document."""
+    t = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(doc.get("ts")))
+    lines = [
+        f"pjtpu top — {t} (snapshots stale after "
+        f"{_fmt(doc.get('stale_after_s'), 0)}s)"
+    ]
+    if doc.get("serve"):
+        _render_serve(lines, doc["serve"])
+    if doc.get("fleet"):
+        _render_fleet(lines, doc["fleet"])
+    if doc.get("repairs"):
+        _render_repairs(lines, doc["repairs"])
+    if len(lines) == 1:
+        lines.append(
+            "nothing to show — point --serve-store at a checkpoint/store "
+            "directory and/or --coordinator-dir at a fleet directory"
+        )
+    return "\n".join(lines)

@@ -8,6 +8,7 @@ certified ``(estimate, max_error)`` bound. ``pjtpu serve`` is the CLI
 front end (JSONL request loop)."""
 
 from paralleljohnson_tpu.serve.engine import (
+    DEFAULT_SLO,
     QueryEngine,
     QueryError,
     SERVE_PROM_METRICS,
@@ -24,6 +25,7 @@ from paralleljohnson_tpu.serve.store import (
 __all__ = [
     "Bounds",
     "DEFAULT_HOT_ROWS",
+    "DEFAULT_SLO",
     "DEFAULT_WARM_ROWS",
     "LandmarkIndex",
     "QueryEngine",

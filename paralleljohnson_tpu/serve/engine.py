@@ -21,11 +21,33 @@ The exact-vs-approximate contract every response carries:
   the landmarks carry no information about the pair — the caller sees
   exactly how much the answer is worth).
 
+Concurrency (ISSUE 12): the engine is thread-safe — one re-entrant
+lock serializes the batch pipeline (tier walk, scheduled solve, counter
+updates), so K client threads hammering :meth:`query_batch` get exact
+answers, lost-increment-free counters, and still exactly ONE scheduled
+solve per aggregated miss batch. Latency samples include lock wait —
+queueing delay is real serving latency, not overhead to hide.
+
+Live metrics (ISSUE 12): per-query latency streams into a log-bucketed
+:class:`~paralleljohnson_tpu.observe.live.LogHistogram` (bounded
+memory, exact counts, percentile error bounded by one bucket width and
+reported beside the estimate) instead of the old unbounded sample
+list; hit-tier / stale / error counts feed sliding-window rate
+counters; an optional :class:`~paralleljohnson_tpu.observe.live.SLO`
+is evaluated with multi-window burn-rate rules (``slo_burn`` flight
+events + the ``pjtpu_slo_burn_rate`` gauge). With a checkpoint-backed
+store, ``serve_stats.json`` is atomically REWRITTEN every
+``stats_interval_s`` while the engine serves (the heartbeat idiom) —
+a SIGKILLed serve process leaves usable stats, fresh to within one
+interval, plus a final write at :meth:`close`.
+
 Telemetry: every batch is a ``serve_batch`` span, every query a
 ``query`` span (round-10 ``Tracer``); heartbeat progress carries
 ``queries_done``; :meth:`write_metrics` exports ``pjtpu_queries_total``
-/ ``pjtpu_query_latency_*`` Prometheus gauges through the same atomic
-``write_prom_metrics`` writer the solver uses.
+/ ``pjtpu_query_latency_ms`` (a real Prometheus histogram) plus
+compatibility ``pjtpu_query_latency_p50/p99_ms`` gauges derived from
+it, through the same atomic ``write_prom_metrics`` writer the solver
+uses.
 """
 
 from __future__ import annotations
@@ -33,25 +55,43 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
+import weakref
 from pathlib import Path
 
 import numpy as np
 
-from paralleljohnson_tpu.utils.metrics import latency_percentiles
+from paralleljohnson_tpu.observe.live import (
+    SLO,
+    LogHistogram,
+    MetricsRegistry,
+)
 from paralleljohnson_tpu.utils.telemetry import resolve as _resolve_telemetry
 from paralleljohnson_tpu.utils.telemetry import write_prom_metrics
 
 SERVE_STATS_FILENAME = "serve_stats.json"
+SERVE_LIVE_FILENAME = "serve_live.json"
 
-# Latency reservoir cap: percentiles over the most recent samples only —
-# a long-lived server must not grow host memory linearly in queries.
-_MAX_LATENCY_SAMPLES = 65536
+# Default periodic serve_stats.json rewrite interval; 0/None disables.
+DEFAULT_STATS_INTERVAL_S = 5.0
+
+# The default serving objective `pjtpu serve` runs under when no SLO is
+# configured explicitly: 99.9% of queries good, p99 under 250 ms. The
+# CLI overrides via --slo-p99-ms / --slo-availability.
+DEFAULT_SLO = SLO(name="serve", latency_ms=250.0, latency_pct=99.0,
+                  availability=0.999)
 
 
 @dataclasses.dataclass
 class ServeStats:
-    """Per-engine query counters + a bounded latency reservoir."""
+    """Per-engine query counters + a streaming latency histogram.
+
+    ``hist`` replaced the round-11 bounded sample LIST (ISSUE 12): the
+    histogram absorbs any query volume in bounded memory with exact
+    counts; only percentile positions are bucket-rounded, and every
+    estimate travels with that bound (``p50_err_ms`` / ``p99_err_ms``).
+    """
 
     queries_total: int = 0
     exact_answers: int = 0
@@ -60,15 +100,19 @@ class ServeStats:
     batches_scheduled: int = 0
     solved_sources: int = 0
     stale_answers: int = 0
-    latencies_ms: list = dataclasses.field(default_factory=list)
+    hits_by_tier: dict = dataclasses.field(default_factory=dict)
+    hist: LogHistogram = dataclasses.field(default_factory=LogHistogram)
 
     def record_latency(self, ms: float) -> None:
-        if len(self.latencies_ms) >= _MAX_LATENCY_SAMPLES:
-            del self.latencies_ms[: _MAX_LATENCY_SAMPLES // 2]
-        self.latencies_ms.append(float(ms))
+        self.hist.record(float(ms))
 
     def percentiles(self) -> dict:
-        return latency_percentiles(self.latencies_ms)
+        """``{"p50_ms", "p50_err_ms", "p99_ms", "p99_err_ms"}`` — the
+        streaming estimates with their one-bucket error bounds."""
+        if self.hist.count == 0:
+            return {"p50_ms": 0.0, "p50_err_ms": 0.0,
+                    "p99_ms": 0.0, "p99_err_ms": 0.0}
+        return self.hist.percentiles((50, 99))
 
     def as_dict(self) -> dict:
         return {
@@ -79,6 +123,7 @@ class ServeStats:
             "batches_scheduled": self.batches_scheduled,
             "solved_sources": self.solved_sources,
             "stale_answers": self.stale_answers,
+            "hits_by_tier": dict(self.hits_by_tier),
             **{k: round(v, 4) for k, v in self.percentiles().items()},
         }
 
@@ -108,12 +153,27 @@ SERVE_PROM_METRICS = (
     ("pjtpu_query_hit_rate", "gauge",
      "Fraction of row lookups served by a store tier (hot/warm/cold)",
      lambda e: e.store.hit_rate()),
+    # The real latency distribution (ISSUE 12): cumulative _bucket /
+    # _sum / _count lines so PromQL histogram_quantile works...
+    ("pjtpu_query_latency_ms", "histogram",
+     "Per-query latency distribution (log-bucketed streaming histogram; "
+     "percentile error bounded by one bucket width ~19%)",
+     lambda e: e.stats.hist),
+    # ...with the round-11 p50/p99 gauges kept one release for dashboard
+    # compatibility, now DERIVED from the same histogram (estimates, one
+    # bucket width of error — the _err_ms gauges carry the bound).
     ("pjtpu_query_latency_p50_ms", "gauge",
-     "Median per-query latency (batch-relative, most recent samples)",
+     "Median per-query latency (derived from pjtpu_query_latency_ms; "
+     "deprecated in favor of histogram_quantile)",
      lambda e: e.stats.percentiles()["p50_ms"]),
     ("pjtpu_query_latency_p99_ms", "gauge",
-     "99th-percentile per-query latency",
+     "99th-percentile per-query latency (derived from "
+     "pjtpu_query_latency_ms; deprecated)",
      lambda e: e.stats.percentiles()["p99_ms"]),
+    ("pjtpu_slo_burn_rate", "gauge",
+     "Error-budget burn rate per registered SLO (1 = spending exactly "
+     "the budget; the multi-window alert fires per the SLO's rules)",
+     lambda e: e.metrics.slo_burn_gauge(), "slo"),
 )
 
 _MISS_POLICIES = ("solve", "landmark")
@@ -128,10 +188,17 @@ class QueryEngine:
     landmark index). ``config`` is the :class:`SolverConfig` the
     exact-miss solver runs under; its ``checkpoint_dir`` is overridden
     to the store's backing directory so scheduled batches persist into
-    the cold tier (or to None for an in-memory store)."""
+    the cold tier (or to None for an in-memory store).
+
+    ``metrics``: a shared :class:`MetricsRegistry` (one is created per
+    engine when None). ``slo``: the serving objective to evaluate
+    (None = :data:`DEFAULT_SLO`). ``stats_interval_s``: period of the
+    live ``serve_stats.json`` rewrite for checkpoint-backed stores
+    (started lazily with the first served batch; 0 disables)."""
 
     def __init__(self, graph, store, *, landmarks=None, config=None,
-                 miss_policy: str = "solve") -> None:
+                 miss_policy: str = "solve", metrics=None, slo=None,
+                 stats_interval_s: float = DEFAULT_STATS_INTERVAL_S) -> None:
         import dataclasses as _dc
 
         from paralleljohnson_tpu.config import SolverConfig
@@ -158,7 +225,28 @@ class QueryEngine:
         )
         self.solver = ParallelJohnsonSolver(self.config)
         self._tel = _resolve_telemetry(self.config.telemetry)
-        self.stats = ServeStats()
+        self.metrics = metrics if metrics is not None else MetricsRegistry(
+            label="serve", telemetry=self.config.telemetry
+        )
+        self.slo = slo if slo is not None else DEFAULT_SLO
+        # The stats histogram IS the registry's, so snapshots and prom
+        # exports read one set of counts (no drift between surfaces).
+        self.stats = ServeStats(
+            hist=self.metrics.histogram("pjtpu_query_latency_ms")
+        )
+        self.metrics.slo(self.slo, histogram="pjtpu_query_latency_ms")
+        # One re-entrant lock serializes the whole batch pipeline: the
+        # tier walk + scheduled solve + counters are a critical section
+        # (TileStore's own lock protects its dicts, but hit counters and
+        # the miss->solve->put sequence span many store calls).
+        self._lock = threading.RLock()
+        self.stats_interval_s = (
+            float(stats_interval_s) if stats_interval_s else 0.0
+        )
+        self._stats_stop = threading.Event()
+        self._stats_thread: threading.Thread | None = None
+        # A dropped engine must not leave its writer thread spinning.
+        self._finalizer = weakref.finalize(self, self._stats_stop.set)
 
     # -- request parsing -----------------------------------------------------
 
@@ -218,9 +306,19 @@ class QueryEngine:
         """Answer many requests in one pass: each distinct source's row
         is fetched ONCE, every exact-mode miss joins one scheduled solve
         batch, responses come back in request order. Malformed requests
-        yield ``{"error": ...}`` responses (the batch survives)."""
+        yield ``{"error": ...}`` responses (the batch survives).
+        Thread-safe: concurrent batches serialize on the engine lock
+        (each aggregated batch still schedules at most one solve); the
+        per-query latency samples include the lock wait — queueing is
+        part of what a client experiences."""
         t_batch = time.perf_counter()
         tel = self._tel
+        with self._lock:
+            self._ensure_stats_writer()
+            responses = self._query_batch_locked(requests, t_batch, tel)
+        return responses
+
+    def _query_batch_locked(self, requests, t_batch, tel) -> list[dict]:
         with tel.span("serve_batch", n_queries=len(requests)):
             parsed: list[dict | None] = []
             responses: list[dict | None] = []
@@ -231,6 +329,8 @@ class QueryEngine:
                 except QueryError as e:
                     parsed.append(None)
                     self.stats.errors += 1
+                    self.metrics.counter("pjtpu_query_errors").add(1)
+                    self.metrics.observe_slo(self.slo.name, None, ok=False)
                     responses.append({
                         "id": req.get("id") if isinstance(req, dict) else None,
                         "error": str(e),
@@ -258,6 +358,7 @@ class QueryEngine:
                     res = self.solver.solve(self.graph, sources=batch)
                 self.stats.batches_scheduled += 1
                 self.stats.solved_sources += len(batch)
+                self.metrics.counter("pjtpu_serve_batches_scheduled").add(1)
                 self.store.put(res.sources, res.dist, tier="hot")
                 if self.store.ckpt is not None:
                     self.store.invalidate_cold_index()
@@ -271,9 +372,12 @@ class QueryEngine:
                               many=p["many"]):
                     responses[i] = self._answer(p, rows)
                 self.stats.queries_total += 1
-                self.stats.record_latency(
-                    (time.perf_counter() - t_batch) * 1e3
-                )
+                latency_ms = (time.perf_counter() - t_batch) * 1e3
+                self.stats.record_latency(latency_ms)
+                self.metrics.counter("pjtpu_queries").add(1)
+                self.metrics.observe_slo(self.slo.name, latency_ms, ok=True)
+            self.metrics.gauge("pjtpu_query_hit_rate",
+                               self.store.hit_rate())
             tel.progress(queries_done=self.stats.queries_total,
                          batches_scheduled=self.stats.batches_scheduled)
         return responses  # type: ignore[return-value]
@@ -293,6 +397,7 @@ class QueryEngine:
         if self.store.is_stale(s):
             out["stale"] = True
             self.stats.stale_answers += 1
+            self.metrics.counter("pjtpu_stale_answers").add(1)
         hit = rows.get(s)
         if hit is not None:
             row, tier = hit
@@ -306,12 +411,17 @@ class QueryEngine:
             est, err = self.landmarks.estimate_row(s, dsts)
             vals = est
             self.stats.approx_answers += 1
+            tier = "landmark"
             out.update(
                 exact=False, tier="landmark",
                 max_error=(
                     [float(e) for e in err] if many else float(err[0])
                 ),
             )
+        self.stats.hits_by_tier[tier] = (
+            self.stats.hits_by_tier.get(tier, 0) + 1
+        )
+        self.metrics.counter(f"pjtpu_answers_{tier}").add(1)
         if many:
             out["dst"] = None if dsts is None else [int(d) for d in dsts]
             out["distances"] = [float(x) for x in vals]
@@ -326,19 +436,20 @@ class QueryEngine:
         """Pre-solve ``sources`` into the store (one scheduled batch for
         whichever of them the store does not already hold). Returns how
         many sources were actually solved."""
-        missing = [int(s) for s in np.asarray(sources, np.int64)
-                   if self.store.get(int(s))[0] is None]
-        if not missing:
-            return 0
-        batch = np.asarray(sorted(set(missing)), np.int64)
-        with self._tel.span("serve_warm", n_sources=len(batch)):
-            res = self.solver.solve(self.graph, sources=batch)
-        self.stats.batches_scheduled += 1
-        self.stats.solved_sources += len(batch)
-        self.store.put(res.sources, res.dist, tier="hot")
-        if self.store.ckpt is not None:
-            self.store.invalidate_cold_index()
-        return len(batch)
+        with self._lock:
+            missing = [int(s) for s in np.asarray(sources, np.int64)
+                       if self.store.get(int(s))[0] is None]
+            if not missing:
+                return 0
+            batch = np.asarray(sorted(set(missing)), np.int64)
+            with self._tel.span("serve_warm", n_sources=len(batch)):
+                res = self.solver.solve(self.graph, sources=batch)
+            self.stats.batches_scheduled += 1
+            self.stats.solved_sources += len(batch)
+            self.store.put(res.sources, res.dist, tier="hot")
+            if self.store.ckpt is not None:
+                self.store.invalidate_cold_index()
+            return len(batch)
 
     def query_lines(self, lines) -> tuple[list[dict], int]:
         """Parse JSONL request lines and answer them as one aggregated
@@ -371,8 +482,9 @@ class QueryEngine:
         return responses, n_errors
 
     def write_metrics(self, path, *, labels: dict | None = None) -> Path:
-        """Prometheus textfile export (``pjtpu_queries_total``,
-        ``pjtpu_query_latency_p50_ms`` / ``_p99_ms``, hit rate, ...)."""
+        """Prometheus textfile export (``pjtpu_queries_total``, the
+        ``pjtpu_query_latency_ms`` histogram + derived p50/p99 gauges,
+        hit rate, ``pjtpu_slo_burn_rate{slo=...}``, ...)."""
         return write_prom_metrics(self, path, labels=labels,
                                   metrics=SERVE_PROM_METRICS)
 
@@ -382,16 +494,66 @@ class QueryEngine:
             "store": self.store.stats(),
             "landmarks": 0 if self.landmarks is None else self.landmarks.k,
             "miss_policy": self.miss_policy,
+            # The live view (ISSUE 12): windowed rates, histogram with
+            # its full mergeable state, and the SLO burn verdicts —
+            # what `pjtpu top` and slo_report read.
+            "live": self.metrics.snapshot(),
         }
 
+    # -- periodic stats publishing (ISSUE 12 satellite) -----------------------
+
+    def _stats_path(self) -> Path | None:
+        if self.store.ckpt is None:
+            return None
+        return self.store.ckpt.dir / SERVE_STATS_FILENAME
+
+    def _write_stats(self) -> None:
+        """One atomic serve_stats.json publish (tmp + rename — the
+        HeartbeatReporter guarantee: a reader never sees a torn file)."""
+        path = self._stats_path()
+        if path is None:
+            return
+        payload = self.serve_summary()
+        payload["ts"] = time.time()
+        payload["pid"] = os.getpid()
+        tmp = path.with_name(path.name + f".tmp{os.getpid()}")
+        tmp.write_text(json.dumps(payload), encoding="utf-8")
+        os.replace(tmp, path)
+
+    def _ensure_stats_writer(self) -> None:
+        """Start the periodic rewriter lazily with the first served
+        batch (an engine that never serves never spawns a thread)."""
+        if (self._stats_thread is not None or not self.stats_interval_s
+                or self.store.ckpt is None):
+            return
+
+        def loop() -> None:
+            while not self._stats_stop.wait(self.stats_interval_s):
+                try:
+                    self._write_stats()
+                except Exception:  # noqa: BLE001 — stats must never kill serving
+                    pass
+
+        self._stats_stop.clear()
+        self._stats_thread = threading.Thread(
+            target=loop, name="pj-serve-stats", daemon=True
+        )
+        self._stats_thread.start()
+
     def close(self) -> None:
-        """Persist the serving counters next to the store's batches
-        (atomic) so ``pjtpu info --serve-store`` can report capacity,
-        landmark count, and hit rates after the loop exits. Does NOT
-        close the telemetry façade — its owner (the CLI) does."""
+        """Stop the periodic writer and persist the final serving
+        counters next to the store's batches (atomic) so ``pjtpu info
+        --serve-store`` / ``pjtpu top`` can report capacity, landmark
+        count, and hit rates after the loop exits. Does NOT close the
+        telemetry façade — its owner (the CLI) does."""
+        self._stats_stop.set()
+        t = self._stats_thread
+        if t is not None:
+            t.join(timeout=max(1.0, 2 * self.stats_interval_s))
+            self._stats_thread = None
         if self.store.ckpt is None:
             return
-        path = self.store.ckpt.dir / SERVE_STATS_FILENAME
-        tmp = path.with_name(path.name + f".tmp{os.getpid()}")
-        tmp.write_text(json.dumps(self.serve_summary()), encoding="utf-8")
-        os.replace(tmp, path)
+        try:
+            self._write_stats()
+        except OSError:
+            pass  # a read-only store dir still served every query

@@ -184,6 +184,15 @@ class ParallelJohnsonSolver:
         # span/event/progress are allocation-free no-ops — the disabled
         # path must stay near-free.
         self._tel = _resolve_telemetry(self.config.telemetry)
+        # Live-metrics registry (ISSUE 12, ``observe.live``): the batch
+        # loop streams per-batch wall + retry/OOM rates into it so a
+        # fleet worker's snapshot shows solver health between
+        # heartbeats. Same null-object discipline as telemetry.
+        from paralleljohnson_tpu.observe.live import resolve_metrics
+
+        self._metrics = resolve_metrics(
+            getattr(self.config, "metrics", None)
+        )
 
     # -- public API ---------------------------------------------------------
 
@@ -851,14 +860,37 @@ class ParallelJohnsonSolver:
         # In-flight finalize window: (batch_idx, batch, payload, future).
         pending: collections.deque = collections.deque()
         worker = None
+        metrics = self._metrics
+        last_done_t = t_solve0
+        counted = {"retries": 0, "oom": 0}
 
         def mark_done() -> None:
             """Heartbeat progress after one batch fully finalizes — the
             liveness signal the TPU watcher keys stage deadlines off,
             plus the trajectory-aware completion estimate (``eta_s``)
             it extends fresh soft deadlines by (ISSUE 9)."""
-            nonlocal done
+            nonlocal done, last_done_t
             done += 1
+            now_t = time.perf_counter()
+            # Live metrics (ISSUE 12): per-batch wall into the streaming
+            # histogram, retry/OOM COUNTER DELTAS into the sliding-rate
+            # counters (stats carries the exact totals; the registry
+            # carries the rates a live console reads).
+            metrics.histogram("pjtpu_solver_batch_wall_ms").record(
+                (now_t - last_done_t) * 1e3
+            )
+            last_done_t = now_t
+            metrics.counter("pjtpu_solver_batches").add(1)
+            if stats.retries > counted["retries"]:
+                metrics.counter("pjtpu_solver_retries").add(
+                    stats.retries - counted["retries"]
+                )
+                counted["retries"] = stats.retries
+            if stats.oom_degradations > counted["oom"]:
+                metrics.counter("pjtpu_solver_oom_degradations").add(
+                    stats.oom_degradations - counted["oom"]
+                )
+                counted["oom"] = stats.oom_degradations
             tel.progress(
                 batches_done=done, sources_done=pos,
                 current_batch_size=degrader.batch_size,

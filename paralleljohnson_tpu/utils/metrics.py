@@ -272,18 +272,26 @@ class SolverStats:
 
 
 def latency_percentiles(samples_ms, pcts=(50, 99)) -> dict:
-    """``{"p50_ms": ..., "p99_ms": ...}`` over a latency sample list —
-    shared by the query engine's stats and the serving bench row so both
-    report the SAME definition (numpy linear-interpolation percentile).
-    Empty samples yield zeros (a row of a store that served nothing)."""
-    import numpy as np
+    """``{"p50_ms": ..., "p99_ms": ...}`` over a latency sample list.
 
-    if len(samples_ms) == 0:
-        return {f"p{p}_ms": 0.0 for p in pcts}
-    arr = np.asarray(samples_ms, np.float64)
-    return {
-        f"p{p}_ms": float(np.percentile(arr, p)) for p in pcts
-    }
+    Routed through the streaming log-bucket histogram
+    (``observe.live.LogHistogram`` — ISSUE 12) so the sample-list path
+    and the live serving path share ONE percentile definition: an
+    estimate whose error is bounded by one bucket width (~19% relative)
+    of the exact nearest-rank percentile, with the bound reported in
+    the companion ``p<N>_err_ms`` keys — never an unflagged
+    approximation. Accepts any iterable (generators included) and any
+    sample count: empty input yields zeros (a store that served
+    nothing), no pre-check required."""
+    from paralleljohnson_tpu.observe.live import LogHistogram
+
+    hist = LogHistogram()
+    hist.record_many(float(s) for s in samples_ms)
+    if hist.count == 0:
+        out = {f"p{p}_ms": 0.0 for p in pcts}
+        out.update({f"p{p}_err_ms": 0.0 for p in pcts})
+        return out
+    return hist.percentiles(pcts)
 
 
 @contextlib.contextmanager

@@ -591,6 +591,17 @@ def write_prom_metrics(stats: Any, path: str | Path, *,
     ``{label_value: sample}`` and one line is emitted per label value
     (e.g. ``pjtpu_roofline_bound{kind="hbm"} 1.0``); an empty dict
     emits no samples (the metric has nothing to report).
+
+    A 4-tuple entry whose type is ``"histogram"`` (ISSUE 12) expects
+    its getter to return an ``observe.live.LogHistogram`` (anything
+    with ``cumulative_buckets()`` / ``count`` / ``sum``) and emits the
+    real Prometheus histogram series: ``<name>_bucket{le="..."}`` lines
+    with CUMULATIVE counts and strictly increasing ``le`` edges (one
+    per occupied log bucket, closing with ``le="+Inf"``), plus
+    ``<name>_sum`` and ``<name>_count`` — so percentile queries work in
+    PromQL (``histogram_quantile``) instead of only via the exported
+    p50/p99 gauges. Run :func:`validate_prom_text` over the output in
+    tests — the cumulative-bucket invariants are checked, not assumed.
     """
 
     def fmt_labels(extra: dict | None = None) -> str:
@@ -603,6 +614,11 @@ def write_prom_metrics(stats: Any, path: str | Path, *,
             f'{k}="{str(v)}"' for k, v in sorted(merged.items())
         )
         return "{" + inner + "}"
+
+    def fmt_le(edge: float) -> str:
+        if edge == float("inf"):
+            return "+Inf"
+        return repr(float(edge))
 
     label_str = fmt_labels()
     lines = []
@@ -620,6 +636,16 @@ def write_prom_metrics(stats: Any, path: str | Path, *,
         name, mtype, help_text, get = entry
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {mtype}")
+        if mtype == "histogram":
+            hist = get(stats)
+            for edge, cum in hist.cumulative_buckets():
+                lines.append(
+                    f"{name}_bucket{fmt_labels({'le': fmt_le(edge)})} "
+                    f"{float(cum)}"
+                )
+            lines.append(f"{name}_sum{label_str} {float(hist.sum)}")
+            lines.append(f"{name}_count{label_str} {float(hist.count)}")
+            continue
         lines.append(f"{name}{label_str} {float(get(stats))}")
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
@@ -627,6 +653,115 @@ def write_prom_metrics(stats: Any, path: str | Path, *,
     tmp.write_text("\n".join(lines) + "\n", encoding="utf-8")
     os.replace(tmp, p)
     return p
+
+
+_PROM_SAMPLE_RE = None  # compiled lazily (keep import time free of re work)
+
+
+def validate_prom_text(text: str) -> None:
+    """Raise ``ValueError`` unless ``text`` conforms to the Prometheus
+    text-exposition subset this writer emits: every sample line parses
+    as ``name{labels} value``, every series is preceded by its HELP and
+    TYPE lines, and histogram series satisfy the cumulative-bucket
+    contract — ``le`` edges strictly increasing, bucket counts
+    non-decreasing, a closing ``le="+Inf"`` bucket whose count equals
+    ``<name>_count``, and ``_sum``/``_count`` present. The telemetry
+    tests run every export through this before anything may claim
+    scrape-ready (the ``validate_chrome_trace`` pattern)."""
+    import re
+
+    global _PROM_SAMPLE_RE
+    if _PROM_SAMPLE_RE is None:
+        _PROM_SAMPLE_RE = re.compile(
+            r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+            r"(?:\{(?P<labels>[^}]*)\})?"
+            r" (?P<value>[-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|inf|nan))$"
+        )
+    typed: dict[str, str] = {}
+    helped: set[str] = set()
+    # histogram name -> list of (le, count); plus captured _sum/_count.
+    buckets: dict[str, list[tuple[float, float]]] = {}
+    sums: dict[str, float] = {}
+    counts: dict[str, float] = {}
+    for n, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) < 4 or not parts[3].strip():
+                raise ValueError(f"line {n}: HELP without text: {line!r}")
+            helped.add(parts[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4 or parts[3] not in (
+                "counter", "gauge", "histogram", "summary", "untyped"
+            ):
+                raise ValueError(f"line {n}: bad TYPE line: {line!r}")
+            if parts[2] not in helped:
+                raise ValueError(
+                    f"line {n}: TYPE for {parts[2]} before its HELP"
+                )
+            typed[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"line {n}: unknown comment: {line!r}")
+        m = _PROM_SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {n}: unparseable sample: {line!r}")
+        name = m.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed \
+                    and typed[name[: -len(suffix)]] == "histogram":
+                base = name[: -len(suffix)]
+                break
+        if base not in typed:
+            raise ValueError(
+                f"line {n}: sample {name} has no preceding TYPE"
+            )
+        value = float(m.group("value"))
+        if typed[base] == "histogram":
+            if name == base + "_bucket":
+                labels = m.group("labels") or ""
+                le_m = re.search(r'le="([^"]+)"', labels)
+                if le_m is None:
+                    raise ValueError(
+                        f"line {n}: histogram bucket without le label"
+                    )
+                raw = le_m.group(1)
+                le = float("inf") if raw == "+Inf" else float(raw)
+                buckets.setdefault(base, []).append((le, value))
+            elif name == base + "_sum":
+                sums[base] = value
+            elif name == base + "_count":
+                counts[base] = value
+            else:
+                raise ValueError(
+                    f"line {n}: bare sample {name} for histogram {base}"
+                )
+    for base, series in buckets.items():
+        les = [le for le, _ in series]
+        cums = [c for _, c in series]
+        if les != sorted(les) or len(set(les)) != len(les):
+            raise ValueError(
+                f"{base}: bucket le edges not strictly increasing: {les}"
+            )
+        if cums != sorted(cums):
+            raise ValueError(
+                f"{base}: bucket counts not cumulative: {cums}"
+            )
+        if les[-1] != float("inf"):
+            raise ValueError(f"{base}: missing le=\"+Inf\" bucket")
+        if base not in counts or base not in sums:
+            raise ValueError(f"{base}: histogram missing _sum/_count")
+        if cums[-1] != counts[base]:
+            raise ValueError(
+                f"{base}: +Inf bucket {cums[-1]} != _count {counts[base]}"
+            )
+    for base, mtype in typed.items():
+        if mtype == "histogram" and base not in buckets:
+            raise ValueError(f"{base}: histogram TYPE with no buckets")
 
 
 # -- the façade the engine is wired through ----------------------------------

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Offline SLO-observatory reader (ISSUE 12) — the "how was the service
+doing" twin of ``cost_report.py``'s "why does it cost that".
+
+Point it at a directory of live-metrics snapshots (what a
+``MetricsRegistry`` snapshotter publishes: ``serve_live.json``, a fleet
+coordinator's ``metrics/<worker>.json``, or a serve store's
+``serve_stats.json`` with its embedded ``live`` payload) — or at a
+single snapshot file — and it prints, per snapshot:
+
+  - counter totals + windowed rates (queries/sec at capture time);
+  - every histogram's percentiles WITH their one-bucket error bounds
+    (the streaming estimates are bounded approximations, flagged as
+    such — never bare numbers);
+  - SLO state: burn rate per rule window, burning verdict, latency
+    target vs observed;
+
+and, when ``*_history.jsonl`` files sit beside the snapshots (the
+snapshotter appends one compact line per publish), the burn-rate
+HISTORY: per SLO, the trajectory of burn rates across publishes, time
+spent burning, and the worst window.
+
+No jax, no numpy, no package import: ``observe/live.py`` is loaded
+standalone (the ``cost_report.py`` pattern), safe on any log-analysis
+box.
+
+Usage:
+  python scripts/slo_report.py bench_artifacts/telemetry
+  python scripts/slo_report.py /tmp/fleet/coord/metrics
+  python scripts/slo_report.py store/graph_ab12/serve_stats.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_live():
+    spec = importlib.util.spec_from_file_location(
+        "pj_live", _REPO / "paralleljohnson_tpu" / "observe" / "live.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    # Register before exec: the dataclasses in live.py resolve their
+    # module via sys.modules at class-creation time (py3.10).
+    sys.modules["pj_live"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+live = _load_live()
+
+
+def _snapshot_payload(path: Path) -> dict | None:
+    """A live-metrics payload from either a raw registry snapshot or a
+    serve_stats.json carrying one under "live"."""
+    data = live.read_snapshot(path)
+    if data is None:
+        return None
+    if data.get("kind") == "live_metrics":
+        return data
+    inner = data.get("live")
+    if isinstance(inner, dict) and inner.get("kind") == "live_metrics":
+        inner = dict(inner)
+        inner.setdefault("ts", data.get("ts"))
+        inner.setdefault("label", f"serve:{path.parent.name}")
+        return inner
+    return None
+
+
+def _find_snapshots(root: Path) -> list[Path]:
+    if root.is_file():
+        return [root]
+    out = []
+    for p in sorted(root.rglob("*.json")):
+        if p.name.endswith("_history.jsonl"):
+            continue
+        if _snapshot_payload(p) is not None:
+            out.append(p)
+    return out
+
+
+def _fmt(v, nd=2):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def report_snapshot(path: Path, snap: dict, out=sys.stdout) -> None:
+    age = live.snapshot_age_s(snap)
+    print(f"\n{path}", file=out)
+    print(f"  label {snap.get('label')}  pid {snap.get('pid')}  "
+          f"seq {snap.get('seq')}  age {_fmt(age, 1)}s", file=out)
+    counters = snap.get("counters") or {}
+    for name, c in sorted(counters.items()):
+        rates = "  ".join(
+            f"{k.replace('rate_', '')}: {_fmt(c[k], 3)}/s"
+            for k in sorted(c) if k.startswith("rate_")
+        )
+        print(f"  counter {name:<34} total {_fmt(c.get('total'), 0):>8}  "
+              f"{rates}", file=out)
+    for name, h in sorted((snap.get("histograms") or {}).items()):
+        print(
+            f"  hist    {name:<34} n {_fmt(h.get('count'), 0):>8}  "
+            f"p50 {_fmt(h.get('p50_ms'))}±{_fmt(h.get('p50_err_ms'))} ms  "
+            f"p99 {_fmt(h.get('p99_ms'))}±{_fmt(h.get('p99_err_ms'))} ms  "
+            f"max {_fmt(h.get('max'))} ms", file=out,
+        )
+    for name, s in sorted((snap.get("slos") or {}).items()):
+        verdict = "BURNING" if s.get("burning") else "ok"
+        print(f"  slo     {name:<34} {verdict}  "
+              f"burn {_fmt(s.get('burn_rate'))}  bad "
+              f"{_fmt(s.get('bad_total'), 0)}/"
+              f"{_fmt(s.get('events_total'), 0)}", file=out)
+        lat = s.get("latency") or {}
+        if lat:
+            print(f"          p{_fmt(lat.get('pct'), 0)} "
+                  f"{_fmt(lat.get('observed_ms'))} ms "
+                  f"(±{_fmt(lat.get('max_error_ms'))}) vs target "
+                  f"{_fmt(lat.get('target_ms'))} ms -> {lat.get('met')}",
+                  file=out)
+        for rule in s.get("rules") or []:
+            print(f"          window {_fmt(rule.get('long_window_s'), 0)}s/"
+                  f"{_fmt(rule.get('short_window_s'), 0)}s "
+                  f"burn {_fmt(rule.get('burn_long'))}/"
+                  f"{_fmt(rule.get('burn_short'))} "
+                  f"(threshold {_fmt(rule.get('threshold'), 1)})"
+                  + ("  FIRING" if rule.get("firing") else ""), file=out)
+
+
+def report_history(path: Path, out=sys.stdout) -> None:
+    lines = live.read_history(path)
+    if not lines:
+        return
+    print(f"\n{path} — {len(lines)} publish(es)", file=out)
+    slo_names = sorted({n for line in lines
+                        for n in (line.get("slos") or {})})
+    for name in slo_names:
+        series = [
+            (line.get("ts"), line["slos"][name])
+            for line in lines if name in (line.get("slos") or {})
+        ]
+        burns = [s.get("burn_rate", 0.0) for _, s in series]
+        burning = sum(1 for _, s in series if s.get("burning"))
+        t_first, t_last = series[0][0], series[-1][0]
+        span = (t_last - t_first) if (t_first and t_last) else 0.0
+        print(
+            f"  slo {name}: burn min {_fmt(min(burns))} / median "
+            f"{_fmt(sorted(burns)[len(burns) // 2])} / max "
+            f"{_fmt(max(burns))}  burning in {burning}/{len(series)} "
+            f"publish(es) over {_fmt(span, 1)}s", file=out,
+        )
+        # A compact trajectory — newest 12 publishes, oldest first.
+        tail = series[-12:]
+        marks = " ".join(
+            f"{_fmt(s.get('burn_rate'))}{'*' if s.get('burning') else ''}"
+            for _, s in tail
+        )
+        print(f"      trajectory (newest {len(tail)}): {marks}", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="offline reader over live-metrics snapshot dirs "
+                    "(burn-rate history + bounded histogram percentiles)"
+    )
+    ap.add_argument("path", help="snapshot dir (searched recursively), or "
+                                 "one snapshot / serve_stats.json file")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="dump every parsed snapshot as one JSON line")
+    ap.add_argument("--allow-empty", action="store_true",
+                    help="exit 0 when no snapshots are found (staged runs "
+                         "whose serve stages were skipped)")
+    args = ap.parse_args(argv)
+    root = Path(args.path)
+    if not root.exists():
+        print(f"slo-report: {root} does not exist", file=sys.stderr)
+        return 2
+    snaps = _find_snapshots(root)
+    if not snaps:
+        level = 0 if args.allow_empty else 1
+        print(f"slo-report: no live-metrics snapshots under {root}",
+              file=sys.stderr)
+        return level
+    if args.as_json:
+        for p in snaps:
+            print(json.dumps({"path": str(p), **_snapshot_payload(p)}))
+        return 0
+    print(f"slo-report: {len(snaps)} snapshot(s) under {root}")
+    for p in snaps:
+        report_snapshot(p, _snapshot_payload(p))
+    histories = (
+        sorted(root.rglob("*_history.jsonl")) if root.is_dir()
+        else sorted(root.parent.glob("*_history.jsonl"))
+    )
+    for h in histories:
+        report_history(h)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

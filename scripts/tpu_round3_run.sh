@@ -250,6 +250,12 @@ run 1200 bench.py python bench.py
 #     whole pass's profile store (the round's attribution artifact)
 run 120 bench-regress python scripts/bench_regress.py --history "$PJ_PROFILE_DIR" --last 1
 run 120 cost-report python scripts/cost_report.py "$PJ_PROFILE_DIR"
+#     ... and the SLO observatory's view of the pass (ISSUE 12): the
+#     serve bench stage left its live-metrics snapshot (streaming
+#     latency histograms with error bounds, burn-rate history) in the
+#     telemetry dir; render it offline. --allow-empty: a pass whose
+#     serve stages were cut by the tunnel still grades its other stages.
+run 120 slo-report python scripts/slo_report.py "$PJ_TRACE_DIR" --allow-empty
 #     ... and the convergence observatory's views of the same pass: the
 #     frontier-collapse curves of every trajectory the stages recorded
 #     (profile store + preserved flight dirs), plus the on-chip JFR

@@ -1,0 +1,384 @@
+"""Live SLO observatory tests (ISSUE 12 — ``observe/live.py``).
+
+The streaming contracts under test:
+- histogram counts are EXACT and percentile estimates agree with
+  exact-sample percentiles within the one-bucket error bound they
+  report (never an unflagged approximation);
+- histograms merge across processes (fleet unions) and round-trip
+  through snapshots losslessly;
+- burn-rate SLO alerts fire on sustained budget spend (both windows),
+  not on one bad batch, and emit the ``slo_burn`` flight event;
+- registry snapshots publish atomically on a daemon thread and a
+  SIGKILLed process leaves a readable, age-flaggable snapshot;
+- the prometheus histogram export satisfies cumulative-bucket
+  semantics (checked by ``validate_prom_text``, itself under test).
+"""
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu.observe.live import (
+    NULL_METRICS,
+    SLO,
+    LogHistogram,
+    MetricsRegistry,
+    RateCounter,
+    SLOTracker,
+    read_history,
+    read_snapshot,
+    resolve_metrics,
+    snapshot_age_s,
+)
+from paralleljohnson_tpu.utils.metrics import latency_percentiles
+from paralleljohnson_tpu.utils.telemetry import (
+    Tracer,
+    validate_prom_text,
+    write_prom_metrics,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# -- histogram ---------------------------------------------------------------
+
+
+def _exact_nearest_rank(samples, p):
+    rank = max(1, math.ceil(p / 100.0 * len(samples)))
+    return float(np.sort(np.asarray(samples, np.float64))[rank - 1])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_histogram_percentiles_within_reported_bound(seed):
+    """Acceptance: streaming percentiles agree with exact-sample
+    percentiles within one bucket width — via the bound the estimate
+    itself reports, on lognormal/uniform/heavy-tail sample shapes."""
+    rng = np.random.default_rng(seed)
+    shapes = [
+        rng.lognormal(0.0, 1.5, 4000),
+        rng.uniform(0.0005, 300.0, 3000),
+        np.concatenate([rng.exponential(2.0, 2000),
+                        rng.uniform(1e3, 1e5, 20)]),
+    ]
+    for samples in shapes:
+        h = LogHistogram()
+        h.record_many(samples.tolist())
+        assert h.count == len(samples)  # counts are exact, always
+        for p in (50, 90, 99, 99.9):
+            r = h.percentile(p)
+            exact = _exact_nearest_rank(samples, p)
+            # The nearest-rank percentile lies in the reported bracket.
+            assert r["lower"] <= exact <= r["upper"] + 1e-12
+            assert abs(r["value"] - exact) <= r["max_error"] + 1e-12
+            # numpy's interpolated definition stays within one extra
+            # bucket width of the estimate.
+            interp = float(np.percentile(samples, p))
+            width = r["upper"] - r["lower"]
+            assert abs(r["value"] - interp) <= r["max_error"] + width + 1e-9
+
+
+def test_histogram_exact_extremes_and_sum():
+    h = LogHistogram()
+    vals = [0.2, 7.0, 7.0, 5000.0]
+    h.record_many(vals)
+    assert h.min == 0.2 and h.max == 5000.0
+    assert h.sum == pytest.approx(sum(vals))
+    # Degenerate distribution: bounds collapse to the exact value.
+    h2 = LogHistogram()
+    h2.record_many([3.0] * 50)
+    r = h2.percentile(99)
+    assert r["value"] == pytest.approx(3.0)
+    assert r["max_error"] == pytest.approx(0.0)
+
+
+def test_histogram_empty_and_overflow():
+    h = LogHistogram()
+    assert h.percentile(99) == {
+        "value": 0.0, "lower": 0.0, "upper": 0.0, "max_error": 0.0
+    }
+    h.record(1e12)  # beyond hi: overflow bucket, narrowed by max
+    r = h.percentile(50)
+    assert r["upper"] == pytest.approx(1e12)
+    assert r["lower"] >= h.hi
+
+
+def test_histogram_merge_equals_union():
+    rng = np.random.default_rng(7)
+    xs = rng.lognormal(1.0, 1.0, 2000)
+    a, b, u = LogHistogram(), LogHistogram(), LogHistogram()
+    a.record_many(xs[:900].tolist())
+    b.record_many(xs[900:].tolist())
+    u.record_many(xs.tolist())
+    a.merge(b)
+    da, du = a.as_dict(), u.as_dict()
+    # Counts/extremes are exact; the float sum may re-associate.
+    assert da["buckets"] == du["buckets"]
+    assert (da["count"], da["min"], da["max"]) == (
+        du["count"], du["min"], du["max"]
+    )
+    assert da["sum"] == pytest.approx(du["sum"])
+    assert a.percentile(99) == u.percentile(99)
+    with pytest.raises(ValueError, match="geometry"):
+        a.merge(LogHistogram(growth=2.0))
+
+
+def test_histogram_snapshot_roundtrip():
+    h = LogHistogram()
+    h.record_many([0.01, 1.0, 250.0, 1e9])
+    clone = LogHistogram.from_dict(json.loads(json.dumps(h.as_dict())))
+    assert clone.as_dict() == h.as_dict()
+    assert clone.percentile(99) == h.percentile(99)
+
+
+def test_latency_percentiles_empty_and_iterable_safe():
+    """Satellite: no pre-check required — empties and generators both
+    work, and the sample-list path shares the histogram definition so
+    it agrees with the streaming path bitwise."""
+    assert latency_percentiles([])["p50_ms"] == 0.0
+    assert latency_percentiles(iter([]))["p99_ms"] == 0.0
+    gen = (float(x) for x in [1.0, 2.0, 3.0])
+    out = latency_percentiles(gen)
+    assert out["p50_ms"] > 0 and "p50_err_ms" in out
+    samples = [0.5, 1.5, 2.5, 100.0]
+    h = LogHistogram()
+    h.record_many(samples)
+    assert latency_percentiles(samples) == h.percentiles((50, 99))
+
+
+# -- rate counter ------------------------------------------------------------
+
+
+def test_rate_counter_windows():
+    rc = RateCounter(window_s=600)
+    t0 = 10_000.0
+    for i in range(300):
+        rc.add(1, now=t0 + i)  # 1/s for 5 minutes
+    assert rc.total == 300
+    assert rc.rate(60, now=t0 + 299) == pytest.approx(1.0, abs=0.05)
+    # After 10 minutes of silence the windowed rate decays to zero but
+    # the monotone total survives.
+    assert rc.rate(60, now=t0 + 900) == 0.0
+    assert rc.total == 300
+    assert rc.count_in(1200, now=t0 + 299) <= 300  # clamped to window
+
+
+# -- SLO burn rates ----------------------------------------------------------
+
+
+def _slo(rules=((60.0, 15.0, 10.0),)):
+    return SLO(name="t", latency_ms=10.0, availability=0.99, rules=rules)
+
+
+def test_slo_no_traffic_and_healthy_traffic_do_not_burn():
+    t = SLOTracker(_slo())
+    assert t.evaluate(now=1000.0)["burning"] is False
+    for i in range(200):
+        t.observe(1.0, ok=True, now=1000.0 + i * 0.05)
+    v = t.evaluate(now=1010.0)
+    assert v["burning"] is False and v["burn_rate"] == 0.0
+
+
+def test_slo_burns_on_sustained_violations_both_windows():
+    """A short violation spike fails only the short window (no alert);
+    sustained violations fire both windows -> burning."""
+    t = SLOTracker(_slo())
+    now = 5000.0
+    # 55s of healthy traffic at 10/s.
+    for i in range(550):
+        t.observe(1.0, ok=True, now=now + i * 0.1)
+    # A 2-second spike of latency violations: short window sees it,
+    # the 60s window stays under threshold (20 bad / 570 total ≈ 3.5x
+    # budget < 10x) -> not burning.
+    for i in range(20):
+        t.observe(50.0, ok=True, now=now + 55 + i * 0.1)
+    v = t.evaluate(now=now + 57)
+    assert v["burning"] is False
+    # Sustain the violations for the rest of the minute: both windows
+    # cross 10x budget -> burning, and errors count like slow answers.
+    for i in range(400):
+        t.observe(None, ok=False, now=now + 57 + i * 0.1)
+    v = t.evaluate(now=now + 97)
+    assert v["burning"] is True
+    assert v["burn_rate"] >= 10.0
+
+
+def test_registry_emits_slo_burn_event_once_per_transition():
+    tracer = Tracer()
+
+    class Tel:
+        def event(self, name, **attrs):
+            tracer.event(name, **attrs)
+
+    m = MetricsRegistry(label="t", telemetry=Tel())
+    m.slo(_slo(rules=((30.0, 5.0, 2.0),)))
+    now = 100.0
+    for i in range(100):
+        m.observe_slo("t", 99.0, ok=True, now=now + i * 0.05)
+    burns = [r for r in tracer.records()
+             if r.get("type") == "event" and r["name"] == "slo_burn"]
+    assert len(burns) == 1  # the transition, not every violation
+    assert burns[0]["attrs"]["slo"] == "t"
+
+
+def test_slo_validation():
+    with pytest.raises(ValueError, match="availability"):
+        SLO(name="x", latency_ms=1.0, availability=1.5)
+    with pytest.raises(ValueError, match="latency_ms"):
+        SLO(name="x", latency_ms=0.0)
+    with pytest.raises(ValueError, match="burn rule"):
+        SLO(name="x", latency_ms=1.0, rules=((5.0, 50.0, 1.0),))
+
+
+# -- registry snapshots ------------------------------------------------------
+
+
+def test_registry_snapshot_atomic_under_concurrent_reads(tmp_path):
+    """The HeartbeatReporter guarantee applied to metrics: concurrent
+    reads during rapid publishes never see a torn file."""
+    m = MetricsRegistry(label="atomic")
+    h = m.histogram("lat_ms")
+    path = tmp_path / "live.json"
+    stop = threading.Event()
+    torn: list[Exception] = []
+
+    def reader():
+        while not stop.is_set():
+            if path.exists():
+                try:
+                    json.loads(path.read_text(encoding="utf-8"))
+                except ValueError as e:  # a torn read would land here
+                    torn.append(e)
+            time.sleep(0.001)
+
+    r = threading.Thread(target=reader)
+    r.start()
+    m.start_snapshotter(path, interval_s=0.01)
+    for i in range(200):
+        h.record(float(i % 17) + 0.1)
+        m.counter("q").add(1)
+        if i % 50 == 0:
+            time.sleep(0.01)
+    m.stop_snapshotter()
+    stop.set()
+    r.join()
+    assert torn == []
+    snap = read_snapshot(path)
+    assert snap["histograms"]["lat_ms"]["count"] == 200
+    assert snap["counters"]["q"]["total"] == 200
+    assert snapshot_age_s(snap) is not None
+    hist = read_history(path.with_name("live_history.jsonl"))
+    assert len(hist) >= 1 and hist[-1]["counters"]["q"] == 200
+
+
+def test_null_metrics_is_free_and_complete():
+    assert resolve_metrics(None) is NULL_METRICS
+    assert not NULL_METRICS
+    NULL_METRICS.histogram("x").record(1.0)
+    NULL_METRICS.counter("x").add(2)
+    NULL_METRICS.gauge("x", 1.0)
+    NULL_METRICS.observe_slo("x", 1.0)
+    assert NULL_METRICS.snapshot() == {}
+    assert NULL_METRICS.slo_burn_gauge() == {}
+
+
+# -- prometheus histogram export ---------------------------------------------
+
+
+def test_prom_histogram_export_validates():
+    h = LogHistogram()
+    h.record_many([0.5, 1.0, 5.0, 5.0, 500.0])
+
+    class Obj:
+        hist = h
+
+    table = (
+        ("pjtpu_query_latency_ms", "histogram", "latency",
+         lambda o: o.hist),
+        ("pjtpu_queries_total", "counter", "queries", lambda o: 5),
+        ("pjtpu_slo_burn_rate", "gauge", "burn",
+         lambda o: {"serve": 0.25}, "slo"),
+    )
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        out = write_prom_metrics(Obj(), Path(d) / "m.prom",
+                                 labels={"command": "serve"}, metrics=table)
+        text = out.read_text()
+    validate_prom_text(text)
+    assert 'pjtpu_query_latency_ms_bucket{command="serve",le="+Inf"} 5.0' \
+        in text
+    assert 'pjtpu_query_latency_ms_count{command="serve"} 5.0' in text
+    assert "pjtpu_query_latency_ms_sum" in text
+    assert 'pjtpu_slo_burn_rate{command="serve",slo="serve"} 0.25' in text
+    # The le edges are cumulative and increasing — corrupting either
+    # invariant must fail the self-check.
+    with pytest.raises(ValueError, match="cumulative"):
+        validate_prom_text(text.replace('le="+Inf"} 5.0', 'le="+Inf"} 3.0'))
+    with pytest.raises(ValueError, match="no preceding TYPE"):
+        validate_prom_text("orphan_metric 1.0\n")
+    with pytest.raises(ValueError, match="_sum/_count"):
+        validate_prom_text(
+            "# HELP h x\n# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 1.0\n'
+        )
+
+
+# -- kill survival (the heartbeat-idiom acceptance) --------------------------
+
+_KILL_CHILD = """
+import sys, time
+from paralleljohnson_tpu.observe.live import MetricsRegistry, SLO
+
+m = MetricsRegistry(label="victim")
+m.histogram("lat_ms").record_many([1.0, 2.0, 3.0])
+m.slo(SLO(name="serve", latency_ms=50.0), histogram="lat_ms")
+m.observe_slo("serve", 1.0)
+m.start_snapshotter(sys.argv[1], interval_s=0.05)
+print("READY", flush=True)
+while True:
+    m.counter("beats").add(1)
+    time.sleep(0.02)
+"""
+
+
+def test_sigkilled_snapshotter_leaves_readable_stale_flagged_snapshot(
+    tmp_path,
+):
+    """Acceptance: a SIGKILLed worker's last snapshot remains readable
+    and is flagged stale by age (both by the reader helpers and by the
+    `pjtpu top` gatherer's stale flag)."""
+    path = tmp_path / "metrics" / "w0.json"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _KILL_CHILD, str(path)],
+        cwd=REPO, stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        deadline = time.time() + 20
+        while not path.exists() and time.time() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.2)  # let a few periodic publishes land
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    snap = read_snapshot(path)  # readable — atomic publishes only
+    assert snap is not None
+    assert snap["histograms"]["lat_ms"]["count"] == 3
+    assert "serve" in snap["slos"]
+    age = snapshot_age_s(snap)
+    assert age is not None and age >= 0
+    # The snapshot ages into staleness: with a tight threshold the
+    # dead process is flagged, with a loose one it still reads fresh.
+    time.sleep(0.3)
+    assert snapshot_age_s(snap) > 0.25

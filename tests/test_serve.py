@@ -314,6 +314,9 @@ def test_serve_stats_persisted_for_info(tmp_path):
 
 
 def test_bench_emits_serving_row():
+    """The bench drives K >= 4 concurrent clients at a sustained
+    offered rate and reports streaming-histogram percentiles WITH their
+    error bounds plus an SLO verdict (ISSUE 12 acceptance)."""
     from paralleljohnson_tpu import benchmarks
 
     recs = benchmarks.run(["serve_queries"], backend="numpy",
@@ -321,8 +324,14 @@ def test_bench_emits_serving_row():
     assert len(recs) == 1
     detail = recs[0].detail
     assert "failed" not in detail, detail
-    for key in ("queries_per_s", "p50_ms", "p99_ms"):
+    for key in ("queries_per_s", "p50_ms", "p99_ms", "offered_per_s"):
         assert key in detail and detail[key] > 0, (key, detail)
+    assert detail["clients"] >= 4
+    # The streaming estimates carry their one-bucket error bound.
+    for key in ("p50_err_ms", "p99_err_ms"):
+        assert key in detail and detail[key] >= 0
+    assert detail["slo"]["verdict"] in ("ok", "burn")
+    assert detail["slo"]["p99_target_ms"] > 0
     assert 0.0 < detail["hit_rate"] <= 1.0
 
 
@@ -367,3 +376,183 @@ def test_cli_serve_malformed_line_exit_code(tmp_path, capsys):
     assert len(lines) == 2
     assert "distance" in lines[0]
     assert "error" in lines[1]
+
+
+# -- concurrency + live metrics (ISSUE 12) -----------------------------------
+
+
+def test_concurrent_query_engine_exact_and_lossless_counters(tmp_path):
+    """Acceptance: hammer ONE engine from many threads against a solved
+    checkpoint dir — every answer bitwise-exact, counters add up (no
+    lost increments), and under contention each aggregated miss batch
+    schedules exactly one solve."""
+    import threading
+
+    g = erdos_renyi(48, 0.1, seed=21)
+    exact = _exact_matrix(g)
+    # Pre-solve HALF the sources into the checkpoint; the rest miss.
+    cfg = _cfg(source_batch_size=8, checkpoint_dir=str(tmp_path))
+    ParallelJohnsonSolver(cfg).solve(g, sources=np.arange(24))
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg(),
+                         stats_interval_s=0)
+    n_threads, per_thread = 8, 6
+    rng = np.random.default_rng(3)
+    plans = [
+        [(int(s), int(t)) for s, t in rng.integers(0, 48, size=(per_thread, 2))]
+        for _ in range(n_threads)
+    ]
+    failures: list = []
+    barrier = threading.Barrier(n_threads)
+
+    def hammer(k: int) -> None:
+        try:
+            barrier.wait()
+            reqs = [{"id": i, "source": s, "dst": t}
+                    for i, (s, t) in enumerate(plans[k])]
+            for resp, (s, t) in zip(engine.query_batch(reqs), plans[k]):
+                assert resp["exact"] is True
+                assert resp["distance"] == float(exact[s, t]), (s, t)
+        except BaseException as e:  # noqa: BLE001
+            failures.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert failures == []
+    # No lost increments: totals are exactly the work submitted.
+    assert engine.stats.queries_total == n_threads * per_thread
+    assert engine.stats.exact_answers == n_threads * per_thread
+    assert engine.stats.hist.count == n_threads * per_thread
+    assert engine.metrics.counter("pjtpu_queries").total == (
+        n_threads * per_thread
+    )
+    assert sum(engine.stats.hits_by_tier.values()) == (
+        n_threads * per_thread
+    )
+    # One scheduled solve per aggregated batch that actually missed —
+    # never more (a racing double-solve would double-count sources).
+    missed_batches = sum(
+        1 for plan in plans if any(s >= 24 for s, _ in plan)
+    )
+    assert engine.stats.batches_scheduled <= missed_batches
+    assert engine.stats.solved_sources <= 24
+
+
+def test_serve_stats_rewritten_periodically_while_serving(tmp_path):
+    """Satellite: serve_stats.json is atomically rewritten DURING
+    operation — readable mid-serve with current counters, no close()
+    required."""
+    import time as _time
+
+    g = erdos_renyi(24, 0.15, seed=22)
+    store = TileStore(tmp_path, g)
+    engine = QueryEngine(g, store, config=_cfg(),
+                         stats_interval_s=0.05)
+    engine.query(1, 2)
+    stats_file = store.ckpt.dir / SERVE_STATS_FILENAME
+    deadline = _time.time() + 10
+    while not stats_file.exists() and _time.time() < deadline:
+        _time.sleep(0.02)
+    assert stats_file.exists(), "periodic writer never published"
+    payload = json.loads(stats_file.read_text())
+    assert payload["engine"]["queries_total"] >= 1
+    assert "ts" in payload and "live" in payload
+    engine.query(3, 4)
+    deadline = _time.time() + 10
+    while _time.time() < deadline:
+        payload = json.loads(stats_file.read_text())
+        if payload["engine"]["queries_total"] >= 2:
+            break
+        _time.sleep(0.02)
+    assert payload["engine"]["queries_total"] >= 2
+    engine.close()
+
+
+_SERVE_KILL_CHILD = """
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from paralleljohnson_tpu import SolverConfig
+from paralleljohnson_tpu.graphs import erdos_renyi
+from paralleljohnson_tpu.serve import QueryEngine, TileStore
+
+g = erdos_renyi(24, 0.15, seed=22)
+store = TileStore(sys.argv[1], g)
+engine = QueryEngine(g, store, config=SolverConfig(backend="numpy"),
+                     stats_interval_s=0.05)
+engine.query(0, 1)
+print("SERVING", store.ckpt.dir, flush=True)
+s = 1
+while True:  # serve until killed — no close(), no unwind
+    engine.query(s % 24, (s + 1) % 24)
+    s += 1
+    time.sleep(0.01)
+"""
+
+
+def test_serve_stats_readable_after_sigkill(tmp_path):
+    """Satellite acceptance (mirrors the flight-recorder kill test): a
+    serve process SIGKILLed mid-operation leaves a parseable
+    serve_stats.json with the counters as of the last periodic publish
+    — no torn file, no close() required."""
+    import os
+    import signal
+    import subprocess
+    import sys as _sys
+    import time as _time
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    proc = subprocess.Popen(
+        [_sys.executable, "-c", _SERVE_KILL_CHILD, str(tmp_path)],
+        cwd=repo, stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        line = proc.stdout.readline().split()
+        assert line and line[0] == "SERVING", line
+        graph_dir = Path(line[1])
+        stats_file = graph_dir / SERVE_STATS_FILENAME
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            if stats_file.exists():
+                payload = json.loads(stats_file.read_text())
+                if payload["engine"]["queries_total"] >= 3:
+                    break
+            _time.sleep(0.05)
+        os.kill(proc.pid, signal.SIGKILL)  # no atexit, no finally
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    payload = json.loads(stats_file.read_text())  # parses — atomic writes
+    assert payload["engine"]["queries_total"] >= 3
+    assert payload["engine"]["p50_ms"] > 0
+    assert payload["live"]["histograms"]["pjtpu_query_latency_ms"][
+        "count"] >= 3
+    assert "ts" in payload  # the age stamp `pjtpu top` flags stale by
+
+
+def test_serve_prom_histogram_and_burn_gauge(tmp_path):
+    """The latency export is a real Prometheus histogram (cumulative
+    _bucket/_sum/_count, format self-checked) with the p50/p99 gauges
+    kept for compatibility and the labeled SLO burn gauge beside them."""
+    from paralleljohnson_tpu.utils.telemetry import validate_prom_text
+
+    g = erdos_renyi(16, 0.2, seed=23)
+    engine = QueryEngine(g, TileStore(tmp_path / "store", g),
+                         config=_cfg(), stats_interval_s=0)
+    for s in range(4):
+        engine.query(s, (s + 1) % 16)
+    out = engine.write_metrics(tmp_path / "serve.prom",
+                               labels={"command": "serve"})
+    text = out.read_text()
+    validate_prom_text(text)
+    assert 'pjtpu_query_latency_ms_count{command="serve"} 4.0' in text
+    assert 'le="+Inf"} 4.0' in text
+    assert "pjtpu_query_latency_ms_sum" in text
+    assert "pjtpu_query_latency_p50_ms" in text  # compat gauges stay
+    assert "pjtpu_query_latency_p99_ms" in text
+    assert 'pjtpu_slo_burn_rate{command="serve",slo="serve"}' in text

@@ -1,0 +1,133 @@
+"""``pjtpu top`` tests (ISSUE 12) — the fleet-wide operations console.
+
+Acceptance under test: ``pjtpu top --once --json`` against a live
+in-process fleet + serve run returns ONE document joining serve
+throughput/latency/SLO state, the coordinator lease table, worker
+heartbeats/ETAs, and repair status; snapshots age into a ``stale`` flag
+(the SIGKILLed-producer side of that contract lives in
+``test_live_metrics.py::test_sigkilled_snapshotter_leaves_readable_stale_flagged_snapshot``).
+"""
+
+import json
+
+import pytest
+
+from paralleljohnson_tpu import SolverConfig, cli
+from paralleljohnson_tpu.graphs import erdos_renyi
+from paralleljohnson_tpu.incremental.status import write_repair_status
+from paralleljohnson_tpu.observe.top import gather_ops, render_ops
+from paralleljohnson_tpu.serve import QueryEngine, TileStore
+
+
+@pytest.fixture(scope="module")
+def ops_world(tmp_path_factory):
+    """One serve store (stats + repair marker) and one finished
+    in-process fleet, shared by the gather/render/CLI tests."""
+    root = tmp_path_factory.mktemp("ops")
+    store_dir = root / "store"
+    coord_dir = root / "coord"
+
+    g = erdos_renyi(32, 0.12, seed=3)
+    store = TileStore(store_dir, g)
+    engine = QueryEngine(g, store, config=SolverConfig(backend="numpy"),
+                        stats_interval_s=0)
+    for s in range(5):
+        engine.query(s, (s + 1) % 32)
+    engine.close()  # publishes serve_stats.json (with ts + live view)
+    write_repair_status(
+        store.ckpt.dir, status="repairing", new_digest="feed",
+        affected=[1, 2, 3], total_sources=32, dirty_parts=1, parts_total=4,
+    )
+
+    from paralleljohnson_tpu.distributed import plan_fleet
+    from paralleljohnson_tpu.distributed.launch import run_in_process_fleet
+
+    coord = plan_fleet(coord_dir, "er:n=48,p=0.1,seed=1", n_workers=2,
+                       backend="numpy")
+    report = run_in_process_fleet(coord, 2)
+    assert report.ok
+    return {"store": store_dir, "coord": coord_dir}
+
+
+def test_gather_joins_all_four_surfaces(ops_world):
+    doc = gather_ops(serve_store=ops_world["store"],
+                     coordinator_dir=ops_world["coord"])
+    # serve: throughput + bounded latency + SLO state.
+    assert len(doc["serve"]) == 1
+    s = doc["serve"][0]["serve"]
+    assert s["queries_total"] == 5
+    assert s["p99_ms"] > 0 and s["p99_err_ms"] >= 0
+    assert s["stale"] is False
+    assert s["live"]["slos"]["serve"]["burning"] is False
+    assert "rate_60s" in s["live"]["rates"]["pjtpu_queries"]
+    # fleet: lease table + per-worker heartbeats/metrics with ETAs.
+    fleet = doc["fleet"]
+    assert fleet["done"] is True
+    assert fleet["leases"]["committed"] == fleet["leases_total"]
+    assert set(fleet["workers"]) == {"w0", "w1"}
+    w0 = fleet["workers"]["w0"]
+    assert w0["leases_committed"] >= 1
+    assert "eta_s" in w0
+    assert w0["metrics"]["histograms"]["pjtpu_lease_wall_ms"]["count"] >= 1
+    # repair status rides along.
+    assert doc["repairs"][0]["status"] == "repairing"
+    assert doc["repairs"][0]["dirty_parts"] == 1
+    assert doc["repairs"][0]["affected"] == 3
+
+
+def test_snapshots_flagged_stale_by_age(ops_world):
+    """The same world read with a zero stale threshold: every snapshot
+    is still READABLE but now flagged stale — the dead-producer view."""
+    doc = gather_ops(serve_store=ops_world["store"],
+                     coordinator_dir=ops_world["coord"],
+                     stale_after_s=0.0)
+    assert doc["serve"][0]["serve"]["stale"] is True
+    assert doc["serve"][0]["serve"]["queries_total"] == 5  # readable
+    for w in doc["fleet"]["workers"].values():
+        assert w["stale"] is True
+    assert doc["repairs"][0]["stale"] is True
+
+
+def test_cli_top_once_json_single_document(ops_world, capsys):
+    rc = cli.main([
+        "top", "--once", "--json",
+        "--serve-store", str(ops_world["store"]),
+        "--coordinator-dir", str(ops_world["coord"]),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1  # ONE joined document
+    doc = json.loads(out[0])
+    assert doc["serve"][0]["serve"]["queries_total"] == 5
+    assert doc["fleet"]["leases"]["committed"] >= 1
+    assert doc["repairs"][0]["new_digest"] == "feed"
+
+
+def test_cli_top_ascii_render(ops_world, capsys):
+    rc = cli.main([
+        "top", "--once",
+        "--serve-store", str(ops_world["store"]),
+        "--coordinator-dir", str(ops_world["coord"]),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for needle in ("pjtpu top", "SERVE", "FLEET", "REPAIR", "SLO serve",
+                   "w0", "dirty parts 1/4"):
+        assert needle in out, f"missing {needle!r} in render:\n{out}"
+
+
+def test_cli_top_requires_a_target(capsys):
+    assert cli.main(["top", "--once"]) == 1
+    assert "needs --serve-store" in capsys.readouterr().err
+
+
+def test_top_tolerates_missing_sources(tmp_path):
+    """Absent serve stats / a dir that is not a coordinator: the
+    console reports what it can instead of crashing (an ops tool must
+    work mid-incident, when files are half-missing)."""
+    doc = gather_ops(serve_store=tmp_path / "nope",
+                     coordinator_dir=tmp_path / "empty")
+    assert doc["serve"] == [] and doc["repairs"] == []
+    assert "error" in doc["fleet"]
+    text = render_ops(doc)
+    assert "FLEET" in text
