@@ -283,6 +283,43 @@ class JaxDeviceGraph:
             self._by_dst_cache[key] = w_diag
         return {**struct, "w_diag": w_diag}
 
+    def dw_layout(self, vb: int) -> dict | None:
+        """Device-resident dirty-window layout
+        (``ops.relax.build_dw_layout``): per-source-block padded
+        out-edge tiles, weight-independent structure cached across
+        reweight in ``_struct_cache``; the tile weights are gathered
+        from the CURRENT device weights (the shared layout idiom).
+        Also carries the dst-sorted COO triple the kernel's overflow
+        full-sweep fallback consumes. None when V is 0."""
+        if self.num_nodes == 0:
+            return None
+        key = ("dw", vb)
+        struct = self._struct_cache.get(key)
+        if struct is None:
+            indices = (
+                self.host_graph.indices if self.host_graph is not None
+                else np.asarray(self.dst)
+            )
+            host = relax.build_dw_layout(
+                self.indptr, indices, self.num_nodes, vb=vb
+            )
+            struct = {
+                "e_src": jnp.asarray(host["e_src"], jnp.int32),
+                "e_dst": jnp.asarray(host["e_dst"], jnp.int32),
+                "edge_order": jnp.asarray(host["edge_order"], jnp.int32),
+                "blk_of_v": jnp.asarray(host["blk_of_v"], jnp.int32),
+                "real_ck_host": host["real_ck"],
+                "vb": host["vb"],
+                "nb": host["nb"],
+                "em": host["em"],
+            }
+            self._struct_cache[key] = struct
+        w_tile = self._by_dst_cache.get(key)
+        if w_tile is None:
+            w_tile = self._gather_weights_with_holes(struct["edge_order"])
+            self._by_dst_cache[key] = w_tile
+        return {**struct, "w_tile": w_tile}
+
     def gs_layout(self, vb: int) -> dict | None:
         """Device-resident blocked Gauss-Seidel layout (RCM relabeling +
         dst-block edge buckets — ``ops.gauss_seidel.build_gs_layout``).
@@ -315,6 +352,7 @@ class JaxDeviceGraph:
                 "vb": host["vb"],
                 "v_pad": host["v_pad"],
                 "halo": host["halo"],
+                "in_adj": jnp.asarray(host["in_adj"]),
             }
             self._struct_cache[key] = struct
         w_blk = self._by_dst_cache.get(key)
@@ -495,7 +533,7 @@ def _bucket_kernel(
     static_argnames=("vb", "halo", "max_outer", "inner_cap", "traj_cap"),
 )
 def _gs_kernel(
-    dist0, src_blk, dstl_blk, w_blk, rank, *,
+    dist0, src_blk, dstl_blk, w_blk, rank, in_adj=None, *,
     vb: int, halo: int, max_outer: int, inner_cap: int,
     traj_cap: int | None = None,
 ):
@@ -507,7 +545,7 @@ def _gs_kernel(
     out = sssp_gs_blocks(
         dist0, src_blk, dstl_blk, w_blk,
         vb=vb, halo=halo, max_outer=max_outer, inner_cap=inner_cap,
-        traj_cap=traj_cap,
+        traj_cap=traj_cap, in_adj=in_adj,
     )
     dist, rounds, improving, iters_blk = out[:4]
     return (dist[rank], rounds, improving, iters_blk, *out[4:])
@@ -520,7 +558,7 @@ def _gs_kernel(
     ),
 )
 def _gs_fanout_kernel(
-    sources, src_blk, dstl_blk, w_blk, rank, *,
+    sources, src_blk, dstl_blk, w_blk, rank, in_adj=None, *,
     v_pad: int, vb: int, halo: int, max_outer: int, inner_cap: int,
     traj_cap: int | None = None,
 ):
@@ -532,7 +570,7 @@ def _gs_fanout_kernel(
     return fanout_gs_body(
         sources, src_blk, dstl_blk, w_blk, rank,
         v_pad=v_pad, vb=vb, halo=halo, max_outer=max_outer,
-        inner_cap=inner_cap, traj_cap=traj_cap,
+        inner_cap=inner_cap, traj_cap=traj_cap, in_adj=in_adj,
     )
 
 
@@ -642,6 +680,37 @@ def _fanout_vm_kernel(
         dist0, src_bd, dst_bd, w_bd, max_iter=max_iter, edge_chunk=edge_chunk
     )
     return dist.T, iters, improving
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_nodes", "vb", "capacity", "max_iter", "num_real_edges",
+        "edge_chunk", "traj_cap",
+    ),
+)
+def _dw_fanout_kernel(
+    sources, e_src, e_dst, w_tile, blk_of_v, src_bd, dst_bd, w_bd, *,
+    num_nodes: int, vb: int, capacity: int, max_iter: int,
+    num_real_edges: int, edge_chunk: int, traj_cap: int | None = None,
+):
+    """Dirty-window compacted fan-out (ISSUE 13 tentpole, route
+    ``vm-blocked+dw``): per-destination-block activity bitmaps in the
+    while_loop carry, compacted dirty-block out-edge tiles per round,
+    full-sweep overflow fallback — ``ops.relax.bellman_ford_sweeps_dw``.
+    Returns (dist [B, V], rounds, still_improving, ex_hi, ex_lo,
+    full_rounds[, traj buffers]); the split examined counter is in edge
+    SLOTS (multiply by B host-side)."""
+    b = sources.shape[0]
+    dist0 = jnp.full((num_nodes, b), jnp.inf, w_bd.dtype)
+    dist0 = dist0.at[sources, jnp.arange(b)].set(0.0)
+    out = relax.bellman_ford_sweeps_dw(
+        dist0, e_src, e_dst, w_tile, blk_of_v, src_bd, dst_bd, w_bd,
+        vb=vb, capacity=capacity, max_iter=max_iter,
+        num_real_edges=num_real_edges, edge_chunk=edge_chunk,
+        traj_cap=traj_cap,
+    )
+    return (out[0].T, *out[1:])
 
 
 _reweight_kernel = jax.jit(relax.reweight_weights)
@@ -885,12 +954,22 @@ class JaxBackend(Backend):
             iters = res.iterations if iterations is None else iterations
             traj = conv.decode_trajectory(counts, resid, iters)
             res.trajectory = traj
+            # Size-biased mean degree (cached per structure): corrects
+            # the JFR-skippable estimator's uniform-degree skew on
+            # power-law graphs (ISSUE 13 satellite).
+            bias = dgraph._by_dst_cache.get("degree_bias", "unset")
+            if bias == "unset":
+                bias = conv.degree_bias_from_degrees(
+                    np.diff(dgraph.indptr)
+                )
+                dgraph._by_dst_cache["degree_bias"] = bias
             res.convergence = conv.summarize_trajectory(
                 traj,
                 num_nodes=dgraph.num_nodes,
                 batch=batch,
                 num_edges=dgraph.num_real_edges,
                 iterations=iters,
+                degree_bias=bias,
             )
         except Exception:  # noqa: BLE001 — observability is never fatal
             pass
@@ -1186,6 +1265,119 @@ class JaxBackend(Backend):
             and self._low_degree_family(dgraph)
             and self.dia_bundle(dgraph) is None
         )
+
+    def _use_dw(self, dgraph: JaxDeviceGraph, batch: int) -> bool:
+        """Dirty-window compacted fan-out route (ISSUE 13, route
+        ``vm-blocked+dw``). True forces; False disables; ``"auto"``
+        NEVER engages blindly: it requires a profile store whose
+        trajectory records for this graph's shape bucket show a
+        collapsing frontier worth the schedule
+        (``observe.convergence.dw_decision`` — the first concrete step
+        of the priced dispatch registry, ROADMAP item 2), refined by
+        the CostModel when it has calibrations for both the dw and the
+        plain batched route. A graph with no recorded collapse (or a
+        flat trajectory) stays on plain vm / vm-blocked."""
+        flag = getattr(self.config, "dirty_window", "auto")
+        if flag is False or getattr(self, "_dw_disabled", False):
+            return False
+        if dgraph.num_nodes == 0:
+            return False
+        if flag is True:
+            return True
+        if dgraph.num_real_edges >= relax.FRONTIER_ADDEND_MAX:
+            # The split examined counter's full-sweep addend would wrap.
+            return False
+        decision = self._dw_decision(dgraph, batch)
+        return bool(decision.get("engage"))
+
+    def _dw_decision(self, dgraph: JaxDeviceGraph, batch: int) -> dict:
+        """The trajectory-record dispatch decision for this graph
+        (cached per dgraph + pow2 batch bucket): read the configured
+        profile store's ``kind: "trajectory"`` records, match this
+        graph's shape bucket, and apply the collapse thresholds; when
+        the store's CostModel prices BOTH ``vm-blocked+dw`` and the
+        plain batched route for this platform, the cheaper prediction
+        wins (priced dispatch, never blind)."""
+        from paralleljohnson_tpu.observe.convergence import dw_decision
+
+        bucket = max(1, int(batch) - 1).bit_length()
+        key = ("dw_decision", bucket)
+        cached = dgraph._by_dst_cache.get(key)
+        if cached is not None:
+            return cached
+        from paralleljohnson_tpu.observe.costs import resolve_profile_dir
+
+        store_dir = resolve_profile_dir(self.config.profile_store)
+        if store_dir is None:
+            decision = {
+                "engage": False,
+                "reason": "no profile store configured (auto engages "
+                          "only from recorded trajectory evidence)",
+            }
+        else:
+            try:
+                from paralleljohnson_tpu.observe.store import (
+                    CostModel,
+                    ProfileStore,
+                )
+
+                records = ProfileStore(store_dir).records()
+                decision = dw_decision(
+                    records,
+                    num_nodes=dgraph.num_nodes,
+                    num_edges=dgraph.num_real_edges,
+                    platform=jax.default_backend(),
+                )
+                if decision.get("engage"):
+                    # Priced refinement: only veto when the model can
+                    # price BOTH routes — an unpriced route must read
+                    # as unpriced, not as free or as infinite.
+                    model = CostModel.fit(records)
+                    platform = jax.default_backend()
+                    dw_p = model.predict(
+                        "vm-blocked+dw", num_edges=dgraph.num_real_edges,
+                        batch=batch, platform=platform,
+                    )
+                    plain = None
+                    for route in ("vm-blocked", "vm", "sweep-sm"):
+                        plain = model.predict(
+                            route, num_edges=dgraph.num_real_edges,
+                            batch=batch, platform=platform,
+                        )
+                        if plain is not None:
+                            break
+                    if (
+                        dw_p is not None and plain is not None
+                        and dw_p["predicted_s"] > plain["predicted_s"]
+                    ):
+                        decision = {
+                            "engage": False,
+                            "reason": (
+                                "cost model prices dw at "
+                                f"{dw_p['predicted_s']:.4g}s vs plain "
+                                f"{plain['predicted_s']:.4g}s"
+                            ),
+                        }
+            except Exception as e:  # noqa: BLE001 — a torn store must not crash dispatch
+                decision = {
+                    "engage": False,
+                    "reason": f"profile store unreadable: "
+                              f"{type(e).__name__}: {e}",
+                }
+        dgraph._by_dst_cache[key] = decision
+        return decision
+
+    def _dw_capacity(self, nb: int, em: int, batch: int) -> int:
+        """Tier-2 dirty-buffer capacity: nb/4 floored at 1024 — measured
+        on the scrambled 96x96 grid (two-tier kernel, CPU): nb/8 costs
+        overflow full-sweeps at batch width while nb/2 bills quiet
+        rounds at flood-tile cost; nb/4 held 2.3-3.1x plain at B=1..8.
+        ``dw_capacity_clamp`` applies the counter/memory bounds."""
+        if self.config.frontier_capacity is not None:
+            cap = int(self.config.frontier_capacity)
+        else:
+            cap = max(1024, nb // 4)
+        return relax.dw_capacity_clamp(cap, nb, em, batch)
 
     def _bucket_delta(self, dgraph: JaxDeviceGraph) -> float:
         """Resolved bucket width: SolverConfig.delta, or the auto-tune
@@ -1530,10 +1722,18 @@ class JaxBackend(Backend):
                     inner_cap=self.config.gs_inner_cap,
                     traj_cap=self._traj_cap(),
                 )
+                # Dirty-window extension (ISSUE 13): exact block
+                # in-adjacency gating instead of the halo window —
+                # value-exact either way, tighter skips; route "gs+dw".
+                gs_in_adj = (
+                    bundle["in_adj"] if self._use_dw(dgraph, 1) else None
+                )
+                gs_route = "gs+dw" if gs_in_adj is not None else "gs"
                 dist, rounds, improving, iters_blk, *traj_bufs = (
                     _gs_kernel(
                         dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
-                        bundle["w_blk"], bundle["rank"], **gs_kwargs,
+                        bundle["w_blk"], bundle["rank"], gs_in_adj,
+                        **gs_kwargs,
                     )
                 )
                 iters = int(rounds)
@@ -1547,11 +1747,11 @@ class JaxBackend(Backend):
                         iters_blk, bundle["real_edges_host"], 1,
                         rounds=iters, inner_cap=self.config.gs_inner_cap,
                     ),
-                    route="gs",
+                    route=gs_route,
                     cost=self._observe_cost(
-                        "gs", _gs_kernel,
+                        gs_route, _gs_kernel,
                         (dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
-                         bundle["w_blk"], bundle["rank"]),
+                         bundle["w_blk"], bundle["rank"], gs_in_adj),
                         gs_kwargs,
                         dgraph,
                     ),
@@ -2093,11 +2293,17 @@ class JaxBackend(Backend):
                         inner_cap=self.config.gs_inner_cap,
                         traj_cap=self._traj_cap(),
                     )
+                    gs_in_adj = (
+                        bundle["in_adj"]
+                        if self._use_dw(dgraph, int(sources.shape[0]))
+                        else None
+                    )
+                    gs_route = "gs+dw" if gs_in_adj is not None else "gs"
                     dist, rounds, improving, iters_blk, *traj_bufs = (
                         _gs_fanout_kernel(
                             sources, bundle["src_blk"],
                             bundle["dstl_blk"], bundle["w_blk"],
-                            bundle["rank"], **gs_kwargs,
+                            bundle["rank"], gs_in_adj, **gs_kwargs,
                         )
                     )
                     examined = _gs_examined_exact(
@@ -2106,11 +2312,10 @@ class JaxBackend(Backend):
                         rounds=int(rounds),
                         inner_cap=self.config.gs_inner_cap,
                     )
-                    gs_route = "gs"
                     gs_cost = self._observe_cost(
-                        "gs", _gs_fanout_kernel,
+                        gs_route, _gs_fanout_kernel,
                         (sources, bundle["src_blk"], bundle["dstl_blk"],
-                         bundle["w_blk"], bundle["rank"]),
+                         bundle["w_blk"], bundle["rank"], gs_in_adj),
                         gs_kwargs,
                         dgraph, batch=int(sources.shape[0]),
                     )
@@ -2175,6 +2380,30 @@ class JaxBackend(Backend):
                     "platform; falling back to the dense/sparse routes "
                     "for this backend instance",
                     forced=self.config.fw is True,
+                )
+        if (
+            "edges" not in mesh.axis_names
+            and mesh.devices.size == 1
+            and not self._use_dense(dgraph)
+            and self._use_dw(dgraph, int(sources.shape[0]))
+        ):
+            # Dirty-window compacted fan-out (ISSUE 13 tentpole):
+            # block-activity-gated relaxation at batch width — examined
+            # work tracks the measured collapsing frontier instead of
+            # rounds x E. Auto engages ONLY from trajectory-record
+            # evidence (_use_dw); degrade-don't-crash like every auto
+            # route; a forced dirty_window=True propagates failures.
+            try:
+                res = self._dw_multi_source(dgraph, sources, max_iter)
+                if res is not None:
+                    return res
+            except Exception:
+                self._auto_route_failed(
+                    "_dw_disabled",
+                    "dirty-window fan-out failed on this platform; "
+                    "falling back to the sweep routes for this backend "
+                    "instance",
+                    forced=self.config.dirty_window is True,
                 )
         traj_bufs = None
         if "edges" in mesh.axis_names:
@@ -2463,6 +2692,98 @@ class JaxBackend(Backend):
             self._attach_trajectory(
                 res, *traj_bufs, dgraph, batch=int(sources.shape[0])
             )
+        return res
+
+    def _dw_multi_source(
+        self, dgraph: JaxDeviceGraph, sources, max_iter: int
+    ) -> KernelResult | None:
+        """One dirty-window fan-out call (route ``vm-blocked+dw``).
+        Returns None when the layout is unavailable so the caller falls
+        through to the sweep chain."""
+        v = dgraph.num_nodes
+        b = int(sources.shape[0])
+        vb = max(1, int(getattr(self.config, "dw_block", None) or
+                        relax.DW_BLOCK))
+        lay = dgraph.dw_layout(vb)
+        if lay is None:
+            return None
+        capacity = self._dw_capacity(lay["nb"], lay["em"], b)
+        src_bd, dst_bd, w_bd = dgraph.by_dst()
+        chunk = _edge_chunk_for(b, dgraph.src.shape[0])
+        cap = self._traj_cap()
+        dw_args = (
+            sources, lay["e_src"], lay["e_dst"], lay["w_tile"],
+            lay["blk_of_v"], src_bd, dst_bd, w_bd,
+        )
+        dw_kwargs = dict(
+            num_nodes=v, vb=lay["vb"], capacity=capacity,
+            max_iter=max_iter, num_real_edges=dgraph.num_real_edges,
+            edge_chunk=chunk, traj_cap=cap,
+        )
+        dist, rounds, improving, ex_hi, ex_lo, fulls, *traj_bufs = (
+            _dw_fanout_kernel(*dw_args, **dw_kwargs)
+        )
+        rounds = int(rounds)
+        # Exact counters (Python ints): the split device counter is in
+        # edge SLOTS — scale by the batch width host-side, and form the
+        # skipped complement against what the plain batched schedule
+        # would have examined over the same rounds. The per-round-curve
+        # resolution (trajectory) is int32 wrap-guarded below.
+        examined_slots = relax.examined_exact(ex_hi, ex_lo)
+        examined = examined_slots * b
+        from paralleljohnson_tpu.utils.metrics import (
+            warn_if_counter_wrapped,
+        )
+
+        warn_if_counter_wrapped(
+            max(1, rounds - int(self._traj_cap() or rounds) + 1),
+            capacity * lay["em"], where="dw",
+        )
+        res = KernelResult(
+            dist=dist,
+            converged=not bool(improving),
+            iterations=rounds,
+            edges_relaxed=examined,
+            route="vm-blocked+dw",
+            cost=self._observe_analytic(
+                "vm-blocked+dw",
+                relax.dw_analytic_cost(
+                    examined_slots, b, jnp.dtype(self._dtype).itemsize
+                ),
+                dgraph, batch=b,
+            ),
+        )
+        if traj_bufs:
+            counts, resid, dirty_ct = traj_bufs
+            self._attach_trajectory(res, counts, resid, dgraph, batch=b)
+            # The dirty-block trajectory (the dw-specific curve the
+            # convergence observatory records): per-round dirty-block
+            # counts, downsampled the same way as the frontier curve.
+            try:
+                from paralleljohnson_tpu.observe.convergence import (
+                    frontier_curve,
+                )
+
+                curve = np.asarray(dirty_ct)[: min(
+                    rounds, dirty_ct.shape[0]
+                )].astype(np.int64)
+                if res.convergence is not None:
+                    res.convergence["dirty_blocks_total"] = int(
+                        curve.sum()
+                    )
+                    res.convergence["dirty_block_curve"] = frontier_curve(
+                        np.stack([curve, curve, curve], axis=1)
+                    )
+                    res.convergence["num_blocks"] = int(lay["nb"])
+                    res.convergence["full_sweep_rounds"] = int(fulls)
+                    res.convergence["examined_edge_slots"] = int(
+                        examined_slots
+                    )
+                    res.convergence["skipped_edge_slots"] = int(
+                        rounds * dgraph.num_real_edges - examined_slots
+                    )
+            except Exception:  # noqa: BLE001 — observability never fatal
+                pass
         return res
 
     def reweight(self, dgraph: JaxDeviceGraph, potentials) -> JaxDeviceGraph:

@@ -86,6 +86,9 @@ _SIZES = {
                           sources=32,  mini_sources=64,  full_sources=128),
     "batch_small":   dict(count=32,    mini_count=512,   full_count=10000),
     "dense_apsp_fw": dict(n=96,        mini_n=384,       full_n=2048),
+    "dirty_window": dict(rows=24,      mini_rows=48,     full_rows=96,
+                          sources=2,   mini_sources=4,   full_sources=4,
+                          rscale=8,    mini_rscale=9,    full_rscale=12),
     "serve_queries": dict(n=256,       mini_n=1024,      full_n=4096,
                           queries=200, mini_queries=2000, full_queries=20000,
                           clients=4,   mini_clients=4,   full_clients=8),
@@ -523,6 +526,116 @@ def bench_dense_apsp_fw(backend: str, preset: str) -> BenchRecord:
     )
 
 
+def bench_dirty_window(backend: str, preset: str) -> BenchRecord:
+    """Config 10 (ISSUE 13 tentpole): dirty-window compacted relaxation
+    vs the plain batched route on the SAME graphs — the bench that
+    converts the convergence observatory's measured skippable fraction
+    into recorded wall-clock. Two workloads:
+
+    - the scrambled road grid (the convergence-evidence shape) at batch
+      width: the dw route (forced) vs the plain dispatch (dw disabled),
+      BITWISE-checked, with the exact examined/skipped edge counters
+      (examined from the kernel's split counter; skipped = the plain
+      run's exact examined total minus dw's) and the speedup;
+    - the rmat power-law preset: the same comparison where the
+      trajectory is flat-ish — the workload the dispatch must DECLINE.
+
+    The detail also records the trajectory-driven dispatch loop end to
+    end: an instrumented plain solve writes its trajectory into a
+    throwaway profile store, and ``dw_decision`` over that store must
+    engage for the grid and decline for rmat — the "never blindly"
+    acceptance, exercised on real records."""
+    import tempfile
+
+    from paralleljohnson_tpu.graphs import grid2d, permute_labels, rmat
+
+    rows = _sz("dirty_window", "rows", preset)
+    n_sources = _sz("dirty_window", "sources", preset)
+    rscale = _sz("dirty_window", "rscale", preset)
+    g = permute_labels(
+        grid2d(rows, rows, negative_fraction=0.0, seed=7), seed=11
+    )
+    rng = np.random.default_rng(0)
+    sources = np.sort(
+        rng.choice(g.num_nodes, size=min(n_sources, g.num_nodes),
+                   replace=False)
+    )
+
+    def timed(graph, srcs, **cfg):
+        solver = _solver(backend, mesh_shape=(1,), **cfg)
+        solver.multi_source(graph, srcs)  # warm compile caches
+        t0 = time.perf_counter()
+        res = solver.multi_source(graph, srcs)
+        return res, time.perf_counter() - t0
+
+    res, wall = timed(g, sources, dirty_window=True)
+    pres, plain_wall = timed(g, sources, dirty_window=False)
+    examined = res.stats.edges_relaxed
+    plain_examined = pres.stats.edges_relaxed
+    detail = {
+        "nodes": g.num_nodes, "edges": g.num_real_edges,
+        "sources": len(sources),
+        "plain_wall_s": round(plain_wall, 6),
+        "dw_speedup": round(plain_wall / max(wall, 1e-9), 3),
+        "examined_edges": int(examined),
+        "plain_examined_edges": int(plain_examined),
+        "skipped_edges": int(plain_examined - examined),
+        "skip_frac": round(
+            1.0 - examined / max(plain_examined, 1), 4
+        ),
+        **_routes(res),
+    }
+    if not np.array_equal(np.asarray(res.dist), np.asarray(pres.dist)):
+        detail["failed"] = "dw rows != plain rows (bitwise)"
+
+    # R-MAT companion: the workload whose trajectory must DECLINE dw.
+    gr = rmat(rscale, 16, seed=3)
+    rsources = np.sort(
+        rng.choice(gr.num_nodes, size=min(n_sources, gr.num_nodes),
+                   replace=False)
+    )
+    rres, rwall = timed(gr, rsources, dirty_window=True)
+    rpres, rplain_wall = timed(gr, rsources, dirty_window=False)
+    detail["rmat"] = {
+        "nodes": gr.num_nodes, "edges": gr.num_real_edges,
+        "dw_wall_s": round(rwall, 6),
+        "plain_wall_s": round(rplain_wall, 6),
+        "dw_speedup": round(rplain_wall / max(rwall, 1e-9), 3),
+        "skip_frac": round(
+            1.0 - rres.stats.edges_relaxed
+            / max(rpres.stats.edges_relaxed, 1), 4
+        ),
+    }
+    if not np.array_equal(np.asarray(rres.dist), np.asarray(rpres.dist)):
+        detail["failed"] = "rmat dw rows != plain rows (bitwise)"
+
+    # Trajectory-driven dispatch, end to end on real records (jax only:
+    # host backends record no trajectories).
+    if backend == "jax":
+        from paralleljohnson_tpu.backends import get_backend
+        from paralleljohnson_tpu.config import SolverConfig
+
+        with tempfile.TemporaryDirectory() as d:
+            for graph, srcs in ((g, sources), (gr, rsources)):
+                _solver(
+                    backend, dirty_window=False, convergence=True,
+                    profile_store=d, mesh_shape=(1,),
+                ).multi_source(graph, srcs)
+            be = get_backend("jax", SolverConfig(
+                profile_store=d, mesh_shape=(1,)
+            ))
+            detail["dispatch"] = {
+                "grid": be._dw_decision(be.upload(g), len(sources)),
+                "rmat": be._dw_decision(be.upload(gr), len(rsources)),
+            }
+    return BenchRecord(
+        "dirty_window", backend, preset, wall,
+        res.stats.edges_relaxed,
+        res.stats.edges_relaxed / max(wall, 1e-9), _n_chips(),
+        detail,
+    )
+
+
 def bench_serve_queries(backend: str, preset: str) -> BenchRecord:
     """Config 6 (round-11 tentpole, concurrent since ISSUE 12): the
     query-serving layer measured as a TRAFFIC-BEARING SERVICE — K >= 4
@@ -902,6 +1015,7 @@ CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "rmat_apsp_pipelined": bench_rmat_apsp_pipelined,
     "batch_small": bench_batch_small,
     "dense_apsp_fw": bench_dense_apsp_fw,
+    "dirty_window": bench_dirty_window,
     "serve_queries": bench_serve_queries,
     "distributed_fleet": bench_distributed_fleet,
     "incremental_update": bench_incremental_update,

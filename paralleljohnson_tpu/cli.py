@@ -96,6 +96,21 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--partition-parts", type=int, default=None,
                    help="partition count of the condensed route "
                         "(default: auto-size from V)")
+    p.add_argument("--dirty-window", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="dirty-window compacted relaxation (README "
+                        "'Dirty-window compaction'): per-destination-"
+                        "block activity bitmaps gate the fan-out's "
+                        "relaxation work — only dirty blocks' out-edge "
+                        "tiles relax each round, bitwise-identical "
+                        "distances, route tag vm-blocked+dw (gs+dw for "
+                        "the Gauss-Seidel outer rounds). auto engages "
+                        "ONLY when the profile store's trajectory "
+                        "record for this graph shape shows a "
+                        "collapsing frontier (never blindly)")
+    p.add_argument("--dw-block", type=int, default=None,
+                   help="vertices per dirty-window activity bit "
+                        "(default: the measured-best fine granularity)")
     p.add_argument("--gs-block-size", type=int, default=8192,
                    help="vertices per Gauss-Seidel block")
     p.add_argument("--gs-inner-cap", type=int, default=64,
@@ -244,6 +259,8 @@ def _config(args) -> "SolverConfig":
         fw_tile=args.fw_tile,
         partitioned=tristate[args.partitioned],
         partition_parts=args.partition_parts,
+        dirty_window=tristate[args.dirty_window],
+        dw_block=args.dw_block,
         gs_block_size=args.gs_block_size,
         gs_inner_cap=args.gs_inner_cap,
         pred_extraction=tristate[args.pred_extraction],
@@ -918,8 +935,8 @@ def main(argv: list[str] | None = None) -> int:
                     ),
                 },
                 "instrumented_routes": [
-                    "sweep", "sweep-sm", "vm", "vm-blocked", "gs",
-                    "dia", "bucket",
+                    "sweep", "sweep-sm", "vm", "vm-blocked",
+                    "vm-blocked+dw", "gs", "dia", "bucket",
                 ],
                 "per_iteration": [
                     "frontier_size (vertices whose distance improved)",
@@ -939,6 +956,34 @@ def main(argv: list[str] | None = None) -> int:
                     "--convergence",
                 ],
                 "evidence": "bench_artifacts/convergence_evidence.md",
+            },
+            # Dirty-window compaction (README "Dirty-window
+            # compaction"): the route that COLLECTS the measured
+            # skippable work the convergence observatory records.
+            "dirty_window": {
+                "flags": {
+                    "--dirty-window": (
+                        "auto (engage only when a profile-store "
+                        "trajectory record for this graph shape shows "
+                        "a collapsing frontier) / true / false"
+                    ),
+                    "--dw-block": "vertices per activity bit",
+                },
+                "route_tags": ["vm-blocked+dw", "gs+dw"],
+                "counters": (
+                    "exact examined vs skipped edge slots per solve "
+                    "(split int32, wrap-guarded); skipped = rounds x E "
+                    "- examined"
+                ),
+                "dispatch": (
+                    "auto consults observe.convergence.dw_decision over "
+                    "the profile store's kind=trajectory records "
+                    "(skew-corrected jfr_skippable_edge_frac >= "
+                    "0.75 and >= 8 iterations), refined by the "
+                    "CostModel when both routes are priced — never "
+                    "engages blindly"
+                ),
+                "evidence": "bench_artifacts/dw_offchip_validation.md",
             },
         }
         # Priced route table from the persisted calibration — the
@@ -1013,7 +1058,9 @@ def main(argv: list[str] | None = None) -> int:
             from paralleljohnson_tpu.config import SolverConfig
 
             g = load_graph(args.graph)
-            be = get_backend("jax", SolverConfig())
+            be = get_backend(
+                "jax", SolverConfig(profile_store=args.profile_store)
+            )
             dg = be.upload(g)
             dia_lay = be.dia_bundle(dg)
             info["graph"] = {
@@ -1030,6 +1077,9 @@ def main(argv: list[str] | None = None) -> int:
                     "dia": bool(be._use_dia(dg)),
                     "bucket": bool(be._use_bucket(dg)),
                     "gauss_seidel": bool(be._use_gs(dg)),
+                    "dirty_window": bool(
+                        be._use_dw(dg, min(128, max(g.num_nodes, 1)))
+                    ),
                     "frontier": bool(be._use_frontier(dg)),
                     "edge_shard": bool(be._use_edge_shard(dg)),
                     # A --predecessors solve takes the SAME route above
@@ -1044,6 +1094,9 @@ def main(argv: list[str] | None = None) -> int:
                     list(dia_lay["offsets"]) if dia_lay is not None else None
                 ),
                 "low_degree_family": bool(be._low_degree_family(dg)),
+                "dw_decision": be._dw_decision(
+                    dg, min(128, max(g.num_nodes, 1))
+                ),
             }
             from paralleljohnson_tpu.solver import ParallelJohnsonSolver
 

@@ -157,6 +157,31 @@ class SolverConfig:
         cycle).
       partition_parts: partition count of the ``partitioned`` route;
         None auto-sizes from V (~sqrt(V)/8, clamped to [2, 32]).
+      dirty_window: dirty-window compacted relaxation (ISSUE 13, route
+        tag ``vm-blocked+dw``; README "Dirty-window compaction"): the
+        fan-out carries per-destination-block activity bitmaps in the
+        while_loop carry, compacts the dirty-block index every round,
+        and relaxes ONLY the dirty blocks' out-edge tiles — examined
+        work tracks the measured collapsing frontier instead of
+        rounds x E, with a full-sweep fallback on overflow rounds, and
+        distances stay BITWISE-identical to the plain batched routes.
+        Also gates the Gauss-Seidel outer rounds onto the exact
+        block-to-block in-adjacency mask (route ``gs+dw``) and the
+        partitioned route's sparse expansion onto reachable part pairs.
+        ``"auto"`` engages ONLY from evidence: a configured profile
+        store must hold a ``kind: "trajectory"`` record for this
+        graph's shape bucket whose frontier collapse clears the
+        ``observe.convergence.dw_decision`` thresholds (refined by the
+        CostModel when it prices both routes) — no record, or a flat
+        trajectory, stays on plain vm / vm-blocked. True forces; False
+        disables everywhere.
+      dw_block: vertices per dirty-window activity bit (block height).
+        None = the measured default (``ops.relax.DW_BLOCK`` = 1):
+        coarse blocks were measured to collect only 35-80% of the
+        skippable work on the scrambled road grid (the active
+        wavefront is a thin ring that crosses many coarse blocks — see
+        the ``ops/relax.py`` dead-end note), while per-vertex bits
+        approach the exact JFR bound.
       pred_extraction: post-fixpoint tight-edge predecessor extraction
         (``ops.pred``): ``--predecessors`` solves run the SAME auto route
         as plain solves (vm-blocked / gs / dia / bucket / dense /
@@ -283,6 +308,8 @@ class SolverConfig:
     fw_tile: int = 512
     partitioned: bool | str = "auto"
     partition_parts: int | None = None
+    dirty_window: bool | str = "auto"
+    dw_block: int | None = None
     pred_extraction: bool | str = "auto"
     edge_shard: bool | str = "auto"
     checkpoint_dir: str | None = None
@@ -386,6 +413,15 @@ class SolverConfig:
         if self.gs_inner_cap < 1:
             raise ValueError(
                 f"gs_inner_cap must be >= 1, got {self.gs_inner_cap}"
+            )
+        if self.dirty_window not in (True, False, "auto"):
+            raise ValueError(
+                "dirty_window must be True/False/'auto', "
+                f"got {self.dirty_window!r}"
+            )
+        if self.dw_block is not None and self.dw_block < 1:
+            raise ValueError(
+                f"dw_block must be >= 1 (or None = auto), got {self.dw_block}"
             )
         if self.pred_extraction not in (True, False, "auto"):
             raise ValueError(

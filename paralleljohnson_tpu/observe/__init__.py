@@ -47,6 +47,8 @@ import sys
 
 from paralleljohnson_tpu.observe.convergence import (  # noqa: F401
     DEFAULT_TRAJ_CAP,
+    degree_bias_from_degrees,
+    dw_decision,
     estimate_eta,
     frontier_curve,
     summarize_trajectory,
@@ -118,6 +120,7 @@ def finalize_solve(
     num_nodes: int = 0,
     num_edges: int = 0,
     batch: int = 1,
+    degree_bias: float | None = None,
 ) -> dict | None:
     """Post-solve observatory hook (called by the solver for every
     completed solve): roofline-attribute ``stats``, publish the bound
@@ -174,6 +177,7 @@ def finalize_solve(
                     num_nodes=num_nodes,
                     num_edges=num_edges,
                     batch=batch,
+                    degree_bias=degree_bias,
                 )
             )
     return roof

@@ -178,6 +178,7 @@ def summarize_trajectory(
     batch: int = 1,
     num_edges: int | None = None,
     iterations: int | None = None,
+    degree_bias: float | None = None,
 ) -> dict:
     """The ``SolverStats.convergence`` summary of one decoded trajectory.
 
@@ -191,12 +192,23 @@ def summarize_trajectory(
                          there relax E edges to improve < 1% of vertices
     jfr_skippable_edge_frac
                          estimated fraction of full-sweep examined edges
-                         a frontier-compacted schedule would skip:
-                         1 - sum(frontier_i) / (iterations x V), i.e.
-                         out-edges of non-frontier vertices under a
-                         uniform-degree estimate (exact counters from the
-                         real frontier/bucket kernels are the ground
-                         truth this estimate is validated against —
+                         a frontier-compacted schedule would skip. With
+                         ``degree_bias`` (the size-biased mean
+                         out-degree E[d^2]/E[d], from the caller's
+                         degree array): 1 - sum(min(E, frontier_i x
+                         degree_bias)) / (iterations x E) — frontier
+                         membership correlates with degree on power-law
+                         graphs (hubs are reached early and re-improved
+                         often), so pricing frontier mass at the
+                         UNIFORM mean degree overweighted hub collapse:
+                         rmat_s12 measured 60.0% skippable vs 81.6%
+                         uniform-estimated (ISSUE 13 satellite; the
+                         regression test pins the recorded fixture).
+                         Without ``degree_bias`` the uniform estimate
+                         1 - sum(frontier_i) / (iterations x V) stands
+                         (identical when degrees are uniform; exact
+                         counters from the real frontier/bucket/dw
+                         kernels remain the ground truth —
                          scripts/convergence_report.py --evidence)
     relaxations_total /  exact totals (Python ints / float)
       residual_mass_total
@@ -236,13 +248,25 @@ def summarize_trajectory(
     tail_mask = frontier < TAIL_FRONTIER_FRAC * max(int(num_nodes), 1)
     out["tail_iterations"] = int(tail_mask.sum())
     out["tail_fraction"] = float(tail_mask.sum() / rows)
-    # Uniform-degree estimate of the JFR win over full sweeps. The
-    # truncated tail accumulates into the last row, so sum(frontier)
-    # stays the exact total frontier-visit count even past the cap.
-    denom = float(iters) * max(int(num_nodes), 1)
-    out["jfr_skippable_edge_frac"] = float(
-        max(0.0, 1.0 - frontier.sum() / denom)
-    )
+    # JFR-win estimate over full sweeps. The truncated tail accumulates
+    # into the last row, so sum(frontier) stays the exact total
+    # frontier-visit count even past the cap. With a degree_bias the
+    # frontier mass is priced at the size-biased mean degree (capped at
+    # E per iteration — a sweep cannot examine more); without one, the
+    # uniform-degree estimate (bias = mean degree) stands.
+    if degree_bias is not None and num_edges:
+        per_iter = np.minimum(
+            float(num_edges), frontier * float(degree_bias)
+        )
+        out["jfr_skippable_edge_frac"] = float(
+            max(0.0, 1.0 - per_iter.sum() / (float(iters) * num_edges))
+        )
+        out["degree_bias"] = float(degree_bias)
+    else:
+        denom = float(iters) * max(int(num_nodes), 1)
+        out["jfr_skippable_edge_frac"] = float(
+            max(0.0, 1.0 - frontier.sum() / denom)
+        )
     if num_edges:
         out["num_edges"] = int(num_edges)
     out["relaxations_total"] = int(traj[:, 1].sum())
@@ -300,6 +324,119 @@ def estimate_eta(
     return float(remaining) * (float(elapsed_s) / float(done))
 
 
+# -- dirty-window dispatch decision (ISSUE 13) -------------------------------
+#
+# The first concrete step of the priced dispatch registry (ROADMAP item
+# 2): route selection consults MEASURED trajectory evidence instead of a
+# static heuristic. Thresholds: the dw schedule's overhead (bitmap
+# maintenance, compaction, tile padding) was measured to eat roughly a
+# quarter of the skippable fraction at block granularity, so it pays
+# when the recorded collapse leaves a comfortable margin.
+
+# Minimum recorded jfr_skippable_edge_frac for dw to engage: the
+# scrambled road grid measures 0.963 (engages), rmat_s12 measures 0.600
+# (declines) — 0.75 splits the measured workloads with margin both ways.
+DW_MIN_SKIPPABLE_FRAC = 0.75
+
+# Below this many iterations a solve has no tail to collect — the fixed
+# per-round costs dominate whatever the bitmap skips.
+DW_MIN_ITERATIONS = 8
+
+
+def degree_bias_from_degrees(degrees) -> float | None:
+    """Size-biased mean out-degree E[d^2]/E[d] — the expected degree of
+    a vertex sampled proportionally to its degree, which is what
+    frontier membership approximates on skewed graphs. None for
+    edgeless graphs. Uniform-degree graphs return the plain mean, so
+    the corrected estimator reduces to the uniform one there."""
+    import numpy as np
+
+    d = np.asarray(degrees, np.float64)
+    total = d.sum()
+    if total <= 0:
+        return None
+    return float((d * d).sum() / total)
+
+
+def dw_decision(
+    records,
+    *,
+    num_nodes: int,
+    num_edges: int,
+    platform: str | None = None,
+) -> dict:
+    """Should the dirty-window route serve a (num_nodes, num_edges)
+    graph? Scans ``kind: "trajectory"`` profile-store records for the
+    graph's pow2 shape bucket (the ``observe.costs.shape_bucket``
+    keying) and applies the collapse thresholds. Platform-matching
+    records are preferred but any-platform evidence counts — frontier
+    collapse is a property of the graph and schedule, not the chip.
+
+    Returns ``{"engage": bool, "reason": str, "summary": dict | None}``
+    — never engages without evidence (the acceptance contract: a graph
+    with no recorded collapse, or a flat trajectory, routes to plain
+    vm / vm-blocked)."""
+    from paralleljohnson_tpu.observe.costs import shape_bucket
+
+    want = shape_bucket(num_nodes, num_edges, 1)[:2]
+    best = None
+    best_rank = -1
+    for r in records:
+        if r.get("kind") != "trajectory":
+            continue
+        nodes = r.get("nodes") or 0
+        edges = r.get("edges") or 0
+        if shape_bucket(nodes, edges, 1)[:2] != want:
+            continue
+        summ = r.get("summary") or {}
+        if not summ:
+            continue
+        # Prefer same-platform, then recency (records are appended in
+        # time order, so the last qualifying one wins its rank tier).
+        rank = 1 if (platform and r.get("platform") == platform) else 0
+        if rank >= best_rank:
+            best, best_rank = r, rank
+    if best is None:
+        return {
+            "engage": False,
+            "reason": (
+                "no trajectory record for shape bucket "
+                f"(V~2^{max(want[0], 1).bit_length() - 1}, "
+                f"E~2^{max(want[1], 1).bit_length() - 1})"
+            ),
+            "summary": None,
+        }
+    summ = best.get("summary") or {}
+    iters = int(summ.get("iterations", 0) or 0)
+    skippable = float(summ.get("jfr_skippable_edge_frac", 0.0) or 0.0)
+    half_life = summ.get("frontier_half_life")
+    if iters < DW_MIN_ITERATIONS:
+        return {
+            "engage": False,
+            "reason": f"recorded solve converges in {iters} iterations "
+                      f"(< {DW_MIN_ITERATIONS}) — no tail to collect",
+            "summary": summ,
+        }
+    if skippable < DW_MIN_SKIPPABLE_FRAC:
+        return {
+            "engage": False,
+            "reason": (
+                f"recorded jfr_skippable_edge_frac {skippable:.3f} < "
+                f"{DW_MIN_SKIPPABLE_FRAC} (flat trajectory — the "
+                "schedule overhead would eat the skip)"
+            ),
+            "summary": summ,
+        }
+    return {
+        "engage": True,
+        "reason": (
+            f"trajectory records {skippable:.1%} skippable over "
+            f"{iters} iterations (half-life {half_life})"
+        ),
+        "summary": summ,
+    }
+
+
 def trajectory_record(
     traj,
     *,
@@ -312,11 +449,14 @@ def trajectory_record(
     num_edges: int,
     batch: int,
     summary: dict | None = None,
+    degree_bias: float | None = None,
 ) -> dict:
     """The per-solve-stage profile-store record (``kind:
     "trajectory"``): the full per-iteration curve plus its summary,
     keyed like solve records so ``scripts/convergence_report.py`` and
-    the cost model join on (route, platform)."""
+    the cost model join on (route, platform). ``degree_bias`` feeds the
+    skew-corrected JFR estimator (see :func:`summarize_trajectory`) —
+    the number the dirty-window dispatch decision reads."""
     import time
 
     import numpy as np
@@ -334,7 +474,8 @@ def trajectory_record(
         "edges": int(num_edges),
         "batch": int(batch),
         "summary": summary or summarize_trajectory(
-            traj, num_nodes=num_nodes, batch=batch, num_edges=num_edges
+            traj, num_nodes=num_nodes, batch=batch, num_edges=num_edges,
+            degree_bias=degree_bias,
         ),
         # Columns: frontier_size, relaxations_applied, residual_mass.
         "trajectory": [

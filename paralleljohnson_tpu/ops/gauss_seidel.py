@@ -62,7 +62,7 @@ INF = jnp.inf
 def _gs_engine(
     dist0, src_blk, dstl_blk, w_blk, *,
     vb: int, halo: int, max_outer: int, inner_cap: int,
-    traj_cap: int | None = None,
+    traj_cap: int | None = None, in_adj=None,
 ):
     """Shared fixpoint engine. dist0 is [NB*vb] (SSSP) or [NB*vb, B]
     (vertex-major fan-out); see the module docstring for the schedule.
@@ -75,6 +75,19 @@ def _gs_engine(
     round's implementation detail, like chunk order in the sweeps).
     None (the default) compiles the EXACT pre-observatory loop — a
     distinct Python branch, so the disabled jaxpr cannot drift.
+
+    ``in_adj`` (ISSUE 13, the dirty-window extension): an optional
+    bool[NB, >=NB] block-to-block in-adjacency mask — ``in_adj[j, i]``
+    True iff some edge runs from block i into block j (including
+    ``j, j`` when the block has internal edges). When given, the dirty
+    decision tests exactly the in-neighbor blocks instead of the
+    conservative ``[j - halo, j + halo]`` bandwidth window — the same
+    exactness argument (the union of ``changed_prev | changed_cur``
+    covers every change since the block's last fix; a block none of
+    whose in-source blocks changed provably cannot improve), tighter
+    skips wherever the RCM bandwidth bound is loose. None (the
+    default) compiles the EXACT pre-dirty-window window-slice loop —
+    a Python branch, so the disabled jaxpr cannot drift.
 
     Returns (dist, outer_rounds, still_improving, iters_blk) where
     ``iters_blk`` is int32[NB] — each block's total inner iterations
@@ -100,6 +113,10 @@ def _gs_engine(
     # (2*halo + 1) slice always exists.
     win = 2 * halo + 1
     flags_len = max(nb, win)
+    if in_adj is not None and in_adj.shape[1] < flags_len:
+        in_adj = jnp.pad(
+            in_adj, ((0, 0), (0, flags_len - in_adj.shape[1]))
+        )
 
     def block_fix(dist, j):
         """Iterate block j's incoming edges to local fixpoint (capped).
@@ -137,12 +154,17 @@ def _gs_engine(
 
     def half_round(carry, j):
         dist, c_prev, c_cur, iters_blk = carry
-        start = jnp.clip(j - halo, 0, flags_len - win)
-        window = (
-            lax.dynamic_slice(c_prev, (start,), (win,))
-            | lax.dynamic_slice(c_cur, (start,), (win,))
-        )
-        dirty = jnp.any(window)
+        if in_adj is None:
+            start = jnp.clip(j - halo, 0, flags_len - win)
+            window = (
+                lax.dynamic_slice(c_prev, (start,), (win,))
+                | lax.dynamic_slice(c_cur, (start,), (win,))
+            )
+            dirty = jnp.any(window)
+        else:
+            # Exact in-neighbor test (dirty-window extension): the mask
+            # row is padded to flags_len so the flag vectors index as-is.
+            dirty = jnp.any(in_adj[j] & (c_prev | c_cur))
 
         def fix(dist):
             d, iters, changed = block_fix(dist, j)
@@ -215,7 +237,7 @@ def _gs_engine(
 def sssp_gs_blocks(
     dist0, src_blk, dstl_blk, w_blk, *,
     vb: int, halo: int, max_outer: int, inner_cap: int = 64,
-    traj_cap: int | None = None,
+    traj_cap: int | None = None, in_adj=None,
 ):
     """Blocked Gauss-Seidel SSSP on a bandwidth-reduced, block-bucketed
     edge layout (build with :func:`build_gs_layout`).
@@ -239,14 +261,14 @@ def sssp_gs_blocks(
     return _gs_engine(
         dist0, src_blk, dstl_blk, w_blk,
         vb=vb, halo=halo, max_outer=max_outer, inner_cap=inner_cap,
-        traj_cap=traj_cap,
+        traj_cap=traj_cap, in_adj=in_adj,
     )
 
 
 def fanout_gs_blocks(
     dist0_vm, src_blk, dstl_blk, w_blk, *,
     vb: int, halo: int, max_outer: int, inner_cap: int = 64,
-    traj_cap: int | None = None,
+    traj_cap: int | None = None, in_adj=None,
 ):
     """Multi-source variant of :func:`sssp_gs_blocks`: dist [NB*vb, B]
     vertex-major, same blocked layout. This is the fan-out answer to the
@@ -263,14 +285,14 @@ def fanout_gs_blocks(
     return _gs_engine(
         dist0_vm, src_blk, dstl_blk, w_blk,
         vb=vb, halo=halo, max_outer=max_outer, inner_cap=inner_cap,
-        traj_cap=traj_cap,
+        traj_cap=traj_cap, in_adj=in_adj,
     )
 
 
 def fanout_gs_body(
     srcs, src_blk, dstl_blk, w_blk, rank, *,
     v_pad: int, vb: int, halo: int, max_outer: int, inner_cap: int,
-    traj_cap: int | None = None,
+    traj_cap: int | None = None, in_adj=None,
 ):
     """Per-device fan-out body shared by the single-device jit kernel
     (``jax_backend._gs_fanout_kernel``) and the shard_map'ed sharded
@@ -286,7 +308,7 @@ def fanout_gs_body(
     out = fanout_gs_blocks(
         dist0, src_blk, dstl_blk, w_blk,
         vb=vb, halo=halo, max_outer=max_outer, inner_cap=inner_cap,
-        traj_cap=traj_cap,
+        traj_cap=traj_cap, in_adj=in_adj,
     )
     dist, rounds, improving, iters_blk = out[:4]
     return (dist[rank, :].T, rounds, improving, iters_blk, *out[4:])
@@ -344,6 +366,14 @@ def build_gs_layout(
     nb = max(1, -(-v // vb))
     v_pad = nb * vb
     halo = int(np.abs(src_n // vb - dst_n // vb).max()) if e else 0
+    # Exact block-to-block in-adjacency (ISSUE 13 dirty-window
+    # extension): in_adj[j, i] True iff an edge runs from block i into
+    # block j. A strict subset of the halo window wherever the RCM
+    # bandwidth bound is loose; bool[NB, NB] is tiny next to the edge
+    # buckets.
+    in_adj = np.zeros((nb, nb), bool)
+    if e:
+        in_adj[dst_n // vb, src_n // vb] = True
     order, counts = bucket_edges_by_dst_block(dst_n, vb, nb)
     src_n, dst_n = src_n[order], dst_n[order]
     em = int(max(counts.max(), 1))
@@ -370,6 +400,7 @@ def build_gs_layout(
         "vb": vb,
         "v_pad": v_pad,
         "halo": halo,
+        "in_adj": in_adj,
     }
     if weights is not None:
         # The same gather the device-side path applies to edge_order.

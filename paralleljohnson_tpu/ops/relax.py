@@ -632,6 +632,278 @@ def examined_exact(ex_hi, ex_lo) -> int:
     return (int(ex_hi) << 20) + int(ex_lo)
 
 
+# -- dirty-window compacted relaxation (batch width) -------------------------
+#
+# ISSUE 13 tentpole (ROADMAP item 3): the convergence observatory measured
+# that 96.3% of sweep-examined edges on the scrambled road grid are
+# provably skippable (bench_artifacts/convergence_evidence.md), yet the
+# fast batched routes (vm / vm-blocked / GS) relax every edge every
+# iteration — the B=1 frontier kernel collects the skip but loses on
+# per-round fixed costs and cannot serve a fan-out. This route carries
+# per-destination-block ACTIVITY BITMAPS (bool[NB], one bit per block of
+# ``vb`` consecutive vertices) in the while_loop carry: a block is dirty
+# iff any of its vertices' distances changed last round, the dirty-block
+# index is compacted (``jnp.nonzero`` with a static capacity) every
+# round, and ONLY the dirty blocks' out-edge tiles are gathered/relaxed
+# — a [capacity x Em, B] batched tile instead of the full [E, B] sweep.
+# Rounds whose dirty count overflows the capacity fall back to one full
+# chunked sweep (the ``bellman_ford_frontier`` contract), so round r
+# always subsumes Jacobi round r and "still active after max_iter >= V
+# rounds" keeps the negative-cycle certificate.
+#
+# Exactness of the skip (the Jacobi argument): a block is skipped at
+# round r only when none of its vertices changed at round r-1 — then
+# every out-edge u->w of the block was last relaxed with u's CURRENT
+# value, so re-relaxing it cannot improve anything. Value-exact, not
+# heuristic; distances at the fixpoint are bitwise-identical to plain
+# vm-blocked (every converged label is the min over path sums evaluated
+# left-to-right in f32, and min is an exact f32 reduction in any order).
+#
+# Measured granularity tradeoff (2026-08-04 numpy schedule simulation on
+# the scrambled 96x96 grid, B=1..32 — don't re-try coarse blocks): at
+# vb=64..256 (with or without RCM / landmark-Morton relabeling, with or
+# without inner fixpoints or delta windows) block gating collects only
+# 35..80% of the skippable work because the active wavefront is a thin
+# geometric ring that intersects MANY coarse blocks; at vb=1..2 the
+# activity bitmap approaches the per-vertex JFR bound (98.2% at B=1,
+# 93.4% at B=4, 88.7% at B=8 on the grid). Default vb is therefore
+# DW_BLOCK = 1: the "block" machinery stays general, the granularity is
+# what the measurement says pays.
+
+# Default dirty-block height (vertices per activity bit) — see the
+# measured-tradeoff note above.
+DW_BLOCK = 1
+
+
+def build_dw_layout(indptr: np.ndarray, indices: np.ndarray,
+                    num_nodes: int, *, vb: int = DW_BLOCK):
+    """Host preprocessing for the dirty-window route (numpy, once per
+    graph STRUCTURE): per-SOURCE-block padded out-edge tiles. CSR order
+    keeps each block's out-edges contiguous, so the build is a reshape
+    with per-block padding, not a sort.
+
+    Weight-independent (the ``build_vm_blocked_layout`` contract):
+    ``edge_order`` holds the original CSR edge position per slot (-1 =
+    pad) so callers gather CURRENT device weights per solve and the
+    layout survives Johnson reweighting.
+
+    Returns dict with
+      e_src      int32[NB+1, Em] global source id per slot (0 at pads)
+      e_dst      int32[NB+1, Em] destination id (``NB*vb`` = pad
+                 sentinel: >= V, dropped by the scatter)
+      edge_order int32[NB+1, Em] original CSR edge index, -1 = pad
+      real_ck    int32[NB+1]    real out-edges per block (0 sentinel)
+      blk_of_v   int32[V]       vertex -> block id
+      vb, nb, em
+    Row NB is the all-pad sentinel the compacted index's fill value
+    selects, so an under-full dirty buffer gathers no-op slots.
+    """
+    import numpy as _np
+
+    v = num_nodes
+    vb = max(1, int(vb))
+    nb = max(1, -(-v // vb))
+    e = int(indptr[-1])
+    bounds = indptr[_np.minimum(_np.arange(nb + 1) * vb, v)].astype(_np.int64)
+    counts = _np.diff(bounds)
+    em = 1 << int(max(int(counts.max(initial=1)), 1) - 1).bit_length()
+    edge_order = _np.full(((nb + 1) * em,), -1, _np.int32)
+    src = _np.repeat(_np.arange(v, dtype=_np.int32), _np.diff(indptr))
+    eidx = _np.arange(e, dtype=_np.int64)
+    blk = (src.astype(_np.int64)) // vb
+    pos = blk * em + (eidx - bounds[blk])
+    edge_order[pos] = eidx.astype(_np.int32)
+    edge_order = edge_order.reshape(nb + 1, em)
+    e_src = _np.where(edge_order >= 0, src[_np.maximum(edge_order, 0)], 0)
+    e_dst = _np.where(
+        edge_order >= 0,
+        indices[:e].astype(_np.int32)[_np.maximum(edge_order, 0)],
+        _np.int32(nb * vb),
+    )
+    real_ck = _np.concatenate([counts, [0]]).astype(_np.int32)
+    blk_of_v = (_np.arange(v, dtype=_np.int32) // vb).astype(_np.int32)
+    return {
+        "e_src": e_src.astype(_np.int32),
+        "e_dst": e_dst.astype(_np.int32),
+        "edge_order": edge_order,
+        "real_ck": real_ck,
+        "blk_of_v": blk_of_v,
+        "vb": vb,
+        "nb": nb,
+        "em": em,
+    }
+
+
+def dw_capacity_clamp(capacity: int, nb: int, em: int, batch: int) -> int:
+    """The dirty-buffer capacity actually used: clamped so (a) one
+    frontier round's examined addend capacity x Em stays below the split
+    counter's no-overflow bound (a pure perf degrade — smaller buffers
+    overflow into full sweeps more often, never a correctness change)
+    and (b) the gathered [capacity x Em, B] candidate tile stays within
+    a fixed element budget (2^24 elements, 64 MB at f32)."""
+    capacity = int(min(capacity, nb))
+    if em > 0:
+        capacity = min(capacity, (FRONTIER_ADDEND_MAX - 1) // em)
+        capacity = min(capacity, max(1, (1 << 24) // (em * max(batch, 1))))
+    return max(1, capacity)
+
+
+def bellman_ford_sweeps_dw(
+    dist0_vm, e_src, e_dst, w_tile, blk_of_v, src_bd, dst_bd, w_bd, *,
+    vb: int, capacity: int, max_iter: int, num_real_edges: int,
+    edge_chunk: int = 1 << 20, traj_cap: int | None = None,
+):
+    """Dirty-window compacted fixpoint at batch width (see the section
+    note above). dist0_vm is [V, B] vertex-major; ``e_src``/``e_dst``/
+    ``w_tile`` the [NB+1, Em] per-source-block out-edge tiles from
+    :func:`build_dw_layout` (weights regathered per solve);
+    ``src_bd``/``dst_bd``/``w_bd`` the dst-sorted COO triple for the
+    overflow full-sweep fallback; ``capacity`` must already be clamped
+    (:func:`dw_capacity_clamp`).
+
+    Returns ``(dist_vm, rounds, still_improving, ex_hi, ex_lo,
+    full_rounds)`` (+ ``(counts, resid, dirty_ct)`` when ``traj_cap``
+    is set — ``dirty_ct`` is the per-round dirty-block count, the
+    trajectory the convergence observatory records for this route).
+    ``ex_hi``/``ex_lo`` is the exact split int32 counter of edge SLOTS
+    examined (decode with :func:`examined_exact`; multiply by B
+    host-side) — skipped per round is E minus the round's addend.
+    """
+    v, b = dist0_vm.shape
+    nbp1, em = e_src.shape
+    nb = nbp1 - 1
+    if num_real_edges >= FRONTIER_ADDEND_MAX:
+        raise ValueError(
+            f"bellman_ford_sweeps_dw: E={num_real_edges} >= 2^31 - 2^20 "
+            "breaks the split examined counter's full-sweep addend; use "
+            "the plain sweep routes"
+        )
+    capacity = dw_capacity_clamp(capacity, nb, em, b)
+    # Two compacted tiers (plus the full-sweep fallback): the gathered
+    # tile is a STATIC shape, so one capacity sized for the flood rounds
+    # would bill every quiet round at flood cost — measured on the
+    # scrambled 96x96 grid (B=4): single-tier cap=2304 ran 1.70x plain
+    # while the same schedule under a quarter-size quiet tier runs the
+    # median round at ~1/4 the tile cost. Tier 2 is ``capacity``; tier 1
+    # a quarter of it; rounds above tier 2 fall back to one full sweep.
+    cap_small = max(1, min(capacity, max(64, capacity // 4)))
+    n_edges = jnp.int32(num_real_edges)
+    blk_ext = jnp.asarray(blk_of_v, jnp.int32)
+
+    def _frontier_branch(d, changed, cap):
+        (ids,) = jnp.nonzero(changed, size=cap, fill_value=nb)
+        s = e_src[ids].reshape(-1)
+        t = e_dst[ids].reshape(-1)
+        wt = w_tile[ids].reshape(-1)
+        cand = d[s, :] + wt[:, None]               # [cap*Em, B]
+        t_clip = jnp.minimum(t, v - 1)             # pads masked by wt=inf
+        old = d[t_clip, :]
+        # In-place on the while_loop carry (XLA aliases it): O(cap*Em*B)
+        # writes, never a [V, B] copy. Pad slots (t >= V) are dropped.
+        nd = d.at[t].min(cand, mode="drop")
+        new = nd[t_clip, :]
+        # Winner slots: strictly improved their destination in some row
+        # AND achieved the post-scatter minimum — their dst blocks form
+        # the next dirty bitmap (scatter-or; duplicates are free).
+        winner = (cand < old) & (cand == new)
+        win_any = jnp.any(winner, axis=1)
+        tb = jnp.where(t >= v, nb, blk_ext[t_clip])
+        changed_next = jnp.zeros(nb + 1, bool).at[tb].max(win_any)[:nb]
+        ex = jnp.sum((wt < INF).astype(jnp.int32))
+        return nd, changed_next, ex, jnp.int32(0)
+
+    def full_branch(d, _changed):
+        nd = relax_sweep_vm(d, src_bd, dst_bd, w_bd, edge_chunk=edge_chunk)
+        improved = jnp.any(nd < d, axis=1)         # [V]
+        changed_next = jnp.zeros(nb + 1, bool).at[blk_ext].max(
+            improved
+        )[:nb]
+        return nd, changed_next, n_edges, jnp.int32(1)
+
+    def step(d, changed):
+        count = jnp.sum(changed)
+        branch = (count > cap_small).astype(jnp.int32) + (
+            count > capacity
+        ).astype(jnp.int32)
+        return count, *lax.switch(
+            branch,
+            [
+                lambda d, c: _frontier_branch(d, c, cap_small),
+                lambda d, c: _frontier_branch(d, c, capacity),
+                full_branch,
+            ],
+            d, changed,
+        )
+
+    def cond(state):
+        changed, i = state[1], state[2]
+        return jnp.any(changed) & (i < max_iter)
+
+    # Initial bitmap: blocks holding the finite entries (the sources).
+    finite0 = jnp.any(jnp.isfinite(dist0_vm), axis=1)
+    changed0 = jnp.zeros(nb + 1, bool).at[blk_ext].max(finite0)[:nb]
+
+    if traj_cap is None:
+        def body(state):
+            d, changed, i, ex_hi, ex_lo, fulls = state
+            _, nd, changed_next, ex, fl = step(d, changed)
+            ex_lo = ex_lo + ex
+            ex_hi = ex_hi + (ex_lo >> 20)
+            ex_lo = ex_lo & ((1 << 20) - 1)
+            return nd, changed_next, i + 1, ex_hi, ex_lo, fulls + fl
+
+        dist, changed, rounds, ex_hi, ex_lo, fulls = lax.while_loop(
+            cond, body,
+            (dist0_vm, changed0, jnp.int32(0), jnp.int32(0), jnp.int32(0),
+             jnp.int32(0)),
+        )
+        return dist, rounds, jnp.any(changed), ex_hi, ex_lo, fulls
+
+    from paralleljohnson_tpu.observe.convergence import (
+        traj_init,
+        traj_record,
+    )
+
+    def body_traj(state):
+        d, changed, i, ex_hi, ex_lo, fulls, counts, resid, dirty_ct = state
+        count, nd, changed_next, ex, fl = step(d, changed)
+        ex_lo = ex_lo + ex
+        ex_hi = ex_hi + (ex_lo >> 20)
+        ex_lo = ex_lo & ((1 << 20) - 1)
+        counts, resid = traj_record(counts, resid, i, d, nd, batch_axis=1)
+        row = jnp.minimum(i, dirty_ct.shape[0] - 1)
+        dirty_ct = dirty_ct.at[row].add(count.astype(jnp.int32))
+        return (nd, changed_next, i + 1, ex_hi, ex_lo, fulls + fl,
+                counts, resid, dirty_ct)
+
+    counts0, resid0 = traj_init(traj_cap)
+    dirty0 = jnp.zeros((int(traj_cap),), jnp.int32)
+    (dist, changed, rounds, ex_hi, ex_lo, fulls, counts, resid,
+     dirty_ct) = lax.while_loop(
+        cond, body_traj,
+        (dist0_vm, changed0, jnp.int32(0), jnp.int32(0), jnp.int32(0),
+         jnp.int32(0), counts0, resid0, dirty0),
+    )
+    return (dist, rounds, jnp.any(changed), ex_hi, ex_lo, fulls,
+            counts, resid, dirty_ct)
+
+
+def dw_analytic_cost(examined_slots: int, batch: int, itemsize: int) -> dict:
+    """Model-priced analytic cost of a dirty-window solve — EXAMINED
+    work only, which is the route's whole point (XLA's static cost table
+    prices the executable as if every round ran at full capacity, which
+    misstates a schedule whose work is data-dependent — the
+    ``fw_analytic_cost`` precedent). Per examined slot x batch row: one
+    add + one min (2 flops) and three f32 touches (source-row gather,
+    destination read, scatter-min write)."""
+    cand = float(examined_slots) * float(max(batch, 1))
+    return {
+        "flops": 2.0 * cand,
+        "bytes_accessed": 3.0 * float(itemsize) * cand,
+        "transcendentals": 0.0,
+    }
+
+
 def multi_source_init(sources, num_nodes: int, dtype=jnp.float32):
     """dist0[B, V]: +inf everywhere, 0 at each row's source."""
     b = sources.shape[0]

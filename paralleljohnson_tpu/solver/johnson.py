@@ -669,6 +669,9 @@ class ParallelJohnsonSolver:
         is swallowed."""
         try:
             from paralleljohnson_tpu import observe
+            from paralleljohnson_tpu.observe.convergence import (
+                degree_bias_from_degrees,
+            )
 
             observe.finalize_solve(
                 stats,
@@ -678,6 +681,9 @@ class ParallelJohnsonSolver:
                 num_nodes=graph.num_nodes,
                 num_edges=graph.num_real_edges,
                 batch=batch,
+                degree_bias=degree_bias_from_degrees(
+                    np.diff(graph.indptr)
+                ),
             )
         except Exception:  # noqa: BLE001 — observability is never fatal
             pass

@@ -281,6 +281,17 @@ def solve_condensed(
     k_steps += ks
 
     # Expansion: one batched min-plus fan-out per source partition.
+    # Dirty-window frontier schedule for the sparse phase (ISSUE 13;
+    # the dense FW tiles above are untouched): a (source part P ->
+    # target part Q) product can only contribute when some source
+    # reaches Q's boundary through the core — when the s2core slice for
+    # Q is entirely +inf the product is a min with +inf (the identity)
+    # and is skipped EXACTLY, not heuristically. Counted per skip so
+    # the work accounting stays honest. ``config.dirty_window=False``
+    # disables the gate (the pre-ISSUE schedule).
+    dw_gate = getattr(config, "dirty_window", "auto") is not False
+    expand_skipped = 0
+    macs_skipped = 0
     dist = np.full((sources.size, v), np.inf, dtype=graph.dtype)
     src_rows_seen: dict[int, list[int]] = {}
     for i, s in enumerate(sources):
@@ -302,9 +313,18 @@ def solve_condensed(
         for qi, (q, verts_q) in enumerate(zip(part_ids, parts)):
             if blocal[qi].size == 0:
                 continue  # no way into q from outside
-            upd = _mp(
-                s2core[:, bcore[qi]], locals_closed[qi][blocal[qi]]
-            )
+            entry = s2core[:, bcore[qi]]
+            if dw_gate and not np.isfinite(entry).any():
+                # No source of this batch reaches Q's boundary: the
+                # whole [rows, Q] product is +inf and cannot lower
+                # anything. Exact skip (disconnected / unreachable
+                # part pairs never pay dense expansion work).
+                expand_skipped += 1
+                macs_skipped += _mp_macs(
+                    rows.size, blocal[qi].size, verts_q.size
+                )
+                continue
+            upd = _mp(entry, locals_closed[qi][blocal[qi]])
             macs += _mp_macs(rows.size, blocal[qi].size, verts_q.size)
             dist[np.ix_(rows, verts_q)] = np.minimum(
                 dist[np.ix_(rows, verts_q)], upd
@@ -328,6 +348,11 @@ def solve_condensed(
         "core_size": int(nc),
         "part_sizes": [int(p.size) for p in parts],
         "pred_ok": pred_ok,
+        # Dirty-window expansion gating (exact counters): part-pair
+        # products proven all-inf and skipped, and the padded MACs they
+        # would have cost.
+        "expand_products_skipped": int(expand_skipped),
+        "expand_macs_skipped": int(macs_skipped),
     }
     return dist, pred, info
 

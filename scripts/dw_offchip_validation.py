@@ -1,0 +1,227 @@
+"""Off-chip validation of the dirty-window bet (ISSUE 13 tentpole;
+ROADMAP item 3): does block-activity-gated relaxation actually convert
+the convergence observatory's measured skippable fraction
+(bench_artifacts/convergence_evidence.md: 96.3% on the scrambled road
+grid) into wall-clock, and do the kernel's own exact counters agree
+with the trajectory-predicted skip fraction?
+
+Per config (the two evidence shapes — the scrambled 96x96 grid and
+rmat_s12):
+
+  1. an instrumented plain solve records the trajectory and its
+     skew-corrected ``jfr_skippable_edge_frac`` estimate (the number
+     the dispatch decision reads);
+  2. the dw route (forced) and the plain batched route solve the SAME
+     graph at batch width — walls, exact examined counters (split
+     int32, duplicates-free by bitmap dedupe), BITWISE cross-check;
+  3. the measured skip fraction ``1 - dw_examined / plain_examined``
+     is compared against the trajectory estimate — the
+     ``convergence_report.py --evidence`` idiom, now closing the loop
+     from estimate to collected wall-clock.
+
+Also records the measured granularity dead end (why ``dw_block``
+defaults to 1): the same solve at coarse blocks, whose counters show
+the thin-wavefront geometry eating the skip.
+
+Run (CPU forced; works while the tunnel is wedged):
+  python scripts/dw_offchip_validation.py
+Emits a markdown analysis block (stdout + bench_artifacts/).
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# Force, not setdefault: the session presets JAX_PLATFORMS=axon, and the
+# axon plugin dials the (possibly wedged) tunnel at init.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+os.environ.setdefault(
+    "PJ_PROFILE_DIR",
+    str(Path(__file__).resolve().parent.parent
+        / "bench_artifacts" / "profiles"),
+)
+
+from paralleljohnson_tpu.utils.platform import honor_cpu_platform_request
+
+honor_cpu_platform_request()
+
+import numpy as np
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.graphs import grid2d, permute_labels, rmat
+
+OUT = Path(__file__).resolve().parent.parent / "bench_artifacts"
+BATCH = 4  # the batch width under test (the "at batch width" clause)
+
+
+def _solver(**kw):
+    kw.setdefault("backend", "jax")
+    kw.setdefault("mesh_shape", (1,))
+    return ParallelJohnsonSolver(SolverConfig(**kw))
+
+
+def _timed_multi(graph, srcs, **cfg):
+    solver = _solver(**cfg)
+    solver.multi_source(graph, srcs)  # warm compile caches
+    t0 = time.perf_counter()
+    res = solver.multi_source(graph, srcs)
+    return res, time.perf_counter() - t0
+
+
+def measure(name: str, g, note: str) -> dict:
+    rng = np.random.default_rng(1)
+    srcs = np.sort(rng.choice(g.num_nodes, size=BATCH, replace=False))
+
+    # 1) trajectory estimate from an instrumented plain solve.
+    inst = _solver(dirty_window=False, convergence=True)
+    ires = inst.multi_source(g, srcs)
+    summ = (ires.stats.convergence or {}).get("fanout", {})
+    estimate = summ.get("jfr_skippable_edge_frac")
+
+    # 2) dw vs plain at batch width, bitwise-checked.
+    dres, dw_wall = _timed_multi(g, srcs, dirty_window=True)
+    pres, plain_wall = _timed_multi(g, srcs, dirty_window=False)
+    assert np.array_equal(np.asarray(dres.dist), np.asarray(pres.dist)), (
+        f"{name}: dw distances diverge from plain (bitwise)"
+    )
+    dw_ex = int(dres.stats.edges_relaxed)
+    plain_ex = int(pres.stats.edges_relaxed)
+    measured_skip = 1.0 - dw_ex / max(plain_ex, 1)
+
+    # 3) the coarse-block dead end, on the record.
+    coarse = {}
+    for vb in (16, 64):
+        cres, c_wall = _timed_multi(
+            g, srcs, dirty_window=True, dw_block=vb
+        )
+        assert np.array_equal(
+            np.asarray(cres.dist), np.asarray(pres.dist)
+        )
+        coarse[vb] = {
+            "wall_s": c_wall,
+            "skip_frac": 1.0 - int(cres.stats.edges_relaxed)
+            / max(plain_ex, 1),
+        }
+
+    return {
+        "config": name,
+        "note": note,
+        "nodes": g.num_nodes,
+        "edges": g.num_real_edges,
+        "batch": BATCH,
+        "trajectory_estimate_skippable": estimate,
+        "dw_examined_edges": dw_ex,
+        "plain_examined_edges": plain_ex,
+        "measured_skip_frac": measured_skip,
+        "dw_wall_s": dw_wall,
+        "plain_wall_s": plain_wall,
+        "speedup": plain_wall / max(dw_wall, 1e-9),
+        "iterations_dw": dres.stats.iterations_by_phase.get("fanout"),
+        "iterations_plain": pres.stats.iterations_by_phase.get("fanout"),
+        "route": (dres.stats.routes_by_phase or {}).get("fanout"),
+        "coarse_blocks": coarse,
+    }
+
+
+def main() -> int:
+    results = [
+        measure(
+            "dimacs_ny_scrambled_96",
+            permute_labels(
+                grid2d(96, 96, negative_fraction=0.0, seed=7), seed=11
+            ),
+            "the convergence-evidence road-grid shape (scrambled labels)",
+        ),
+        measure(
+            "rmat_s12",
+            rmat(12, 16, seed=42),
+            "power-law contrast case — the shape dispatch must decline",
+        ),
+    ]
+    lines = [
+        "# Dirty-window off-chip validation — the measured skip, "
+        "collected (ISSUE 13)",
+        "",
+        f"CPU-measured ({time.strftime('%Y-%m-%d')}), batch width "
+        f"B={BATCH}; dw route (`vm-blocked+dw`, forced) vs the plain "
+        "batched dispatch on the SAME graph, distances cross-checked "
+        "BITWISE. `measured skip` = 1 - dw_examined / plain_examined, "
+        "both from exact counters (dw: split-int32 slot counter x B; "
+        "plain: rounds x E x B). The trajectory estimate is the "
+        "skew-corrected `jfr_skippable_edge_frac` the dispatch "
+        "decision (`observe.convergence.dw_decision`) reads.",
+        "",
+    ]
+    for r in results:
+        lines += [
+            f"## {r['config']} — {r['note']}",
+            "",
+            f"| metric | value |",
+            f"|---|---|",
+            f"| nodes / edges | {r['nodes']:,} / {r['edges']:,} |",
+            f"| trajectory-estimated skippable | "
+            f"{r['trajectory_estimate_skippable']:.1%} |",
+            f"| plain examined edges (exact) | "
+            f"{r['plain_examined_edges']:,} |",
+            f"| dw examined edges (exact) | "
+            f"{r['dw_examined_edges']:,} |",
+            f"| **measured skip, collected** | "
+            f"**{r['measured_skip_frac']:.1%}** |",
+            f"| dw wall | {r['dw_wall_s'] * 1e3:.1f} ms |",
+            f"| plain wall | {r['plain_wall_s'] * 1e3:.1f} ms |",
+            f"| **speedup** | **{r['speedup']:.2f}x** |",
+            f"| rounds (dw / plain) | {r['iterations_dw']} / "
+            f"{r['iterations_plain']} |",
+            "",
+            "coarse-block dead end (why `dw_block` defaults to 1 — "
+            "the active wavefront is a thin ring that crosses many "
+            "coarse blocks):",
+            "",
+        ]
+        for vb, c in r["coarse_blocks"].items():
+            lines.append(
+                f"- `dw_block={vb}`: skip {c['skip_frac']:.1%}, wall "
+                f"{c['wall_s'] * 1e3:.1f} ms"
+            )
+        lines.append("")
+    grid = results[0]
+    gap = abs(
+        grid["measured_skip_frac"]
+        - grid["trajectory_estimate_skippable"]
+    )
+    lines += [
+        "## Verdict",
+        "",
+        f"- On the road-grid shape the dw route collects "
+        f"{grid['measured_skip_frac']:.1%} of the plain schedule's "
+        f"edge examinations ({grid['speedup']:.2f}x wall on CPU), "
+        f"within {gap:.1%} of the trajectory-predicted skippable "
+        "fraction — the estimate the dispatch decision engages on is "
+        "validated by the kernel's own exact counters.",
+        f"- rmat_s12 measures {results[1]['measured_skip_frac']:.1%} "
+        f"skip at {results[1]['speedup']:.2f}x wall — the flat-ish "
+        "trajectory workload where the schedule does NOT pay, which "
+        "is exactly why `dirty_window=auto` requires recorded "
+        "collapse evidence before engaging (and declines here).",
+        "",
+        "Raw records:",
+        "",
+        "```json",
+        json.dumps(results, indent=1, default=float),
+        "```",
+    ]
+    text = "\n".join(lines) + "\n"
+    print(text)
+    OUT.mkdir(parents=True, exist_ok=True)
+    (OUT / "dw_offchip_validation.md").write_text(text, encoding="utf-8")
+    print(f"wrote {OUT / 'dw_offchip_validation.md'}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -243,6 +243,14 @@ run 1200 jax-fleet-bench python -m paralleljohnson_tpu.cli bench distributed_fle
 #     link failures) against a cold re-solve
 run 1200 jax-incremental-bench python -m paralleljohnson_tpu.cli bench incremental_update --backend jax --preset full --update-baseline BASELINE.md
 
+# 4l) dirty-window bench row (ISSUE 13 tentpole): block-activity-gated
+#     relaxation vs the plain batched route on the scrambled grid +
+#     rmat, BITWISE-checked; detail carries the exact examined/skipped
+#     counters, the speedup, and the trajectory-driven dispatch verdict
+#     (grid engages, rmat declines) — the row that converts the
+#     measured 96.3% skippable into recorded wall-clock
+run 1200 jax-dirty-window python -m paralleljohnson_tpu.cli bench dirty_window --backend jax --preset full --update-baseline BASELINE.md
+
 # 5) driver metric (should reflect the blocked kernel now)
 run 1200 bench.py python bench.py
 

@@ -22,9 +22,13 @@ def test_info_graph_route_diagnosis(capsys):
     assert gi["nodes"] == 81 and gi["dia_qualifies"]
     assert gi["dia_offsets"] == [-9, -1, 1, 9]
     assert set(gi["routes"]) == {
-        "dense", "fw", "dia", "bucket", "gauss_seidel", "frontier",
-        "edge_shard", "pred", "partitioned",
+        "dense", "fw", "dia", "bucket", "gauss_seidel", "dirty_window",
+        "frontier", "edge_shard", "pred", "partitioned",
     }
+    # No profile store in this invocation: the dirty-window auto gate
+    # has no trajectory evidence and must decline (never blindly).
+    assert gi["routes"]["dirty_window"] is False
+    assert "no profile store" in gi["dw_decision"]["reason"]
     # The 81-vertex lattice is neither dense enough for the FW closure
     # nor TPU-resident for the condensed auto gate.
     assert gi["routes"]["fw"] is False

@@ -1,0 +1,573 @@
+"""Dirty-window compaction (ISSUE 13): block-activity-gated relaxation
+at batch width. Contracts under test:
+
+- bitwise-identical distances dw-on vs dw-off for every extended route
+  (vm fan-out, Gauss-Seidel outer rounds, partitioned expansion),
+  including negative weights (through the Johnson phases), disconnected
+  graphs, and predecessor extraction riding on top;
+- the block-adjacency machinery is exact (GS ``in_adj`` mask, dw layout
+  tiles and counters);
+- the examined/skipped counters are exact against a numpy oracle that
+  replays the schedule (prev-round gating, full-sweep overflow
+  fallback);
+- dispatch engages dw ONLY from trajectory-record evidence — no record,
+  a flat trajectory, or a cost-model veto routes to plain vm;
+- injected OOM mid-solve degrades through the ordinary resilience
+  machinery without corrupting results (bitmap state is per kernel
+  call, so a retried batch recomputes exactly);
+- the skew-corrected JFR estimator (degree-biased frontier mass) is
+  pinned to the recorded rmat_s12 fixture.
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.backends import get_backend
+from paralleljohnson_tpu.graphs import (
+    CSRGraph,
+    erdos_renyi,
+    grid2d,
+    permute_labels,
+)
+from paralleljohnson_tpu.observe import convergence as conv
+from paralleljohnson_tpu.ops import relax
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = pathlib.Path(__file__).resolve().parent / "fixtures"
+
+
+def _grid(rows=16, *, neg=0.0, seed=7):
+    g = grid2d(rows, rows, negative_fraction=neg, seed=seed)
+    return permute_labels(g, seed=11)
+
+
+def _solver(**kw):
+    kw.setdefault("backend", "jax")
+    kw.setdefault("mesh_shape", (1,))
+    return ParallelJohnsonSolver(SolverConfig(**kw))
+
+
+def _sources(g, b, seed=0):
+    rng = np.random.default_rng(seed)
+    return np.sort(rng.choice(g.num_nodes, size=b, replace=False))
+
+
+# -- config surface -----------------------------------------------------------
+
+
+def test_config_validation():
+    assert SolverConfig(dirty_window=True).dirty_window is True
+    assert SolverConfig(dw_block=4).dw_block == 4
+    with pytest.raises(ValueError, match="dirty_window"):
+        SolverConfig(dirty_window="yes")
+    with pytest.raises(ValueError, match="dw_block"):
+        SolverConfig(dw_block=0)
+
+
+# -- bitwise equivalence per route --------------------------------------------
+
+
+@pytest.mark.parametrize("b", [1, 4])
+def test_dw_bitwise_vm_fanout(b):
+    g = _grid(12)
+    srcs = _sources(g, b)
+    on = _solver(dirty_window=True).multi_source(g, srcs)
+    off = _solver(dirty_window=False).multi_source(g, srcs)
+    assert on.stats.routes_by_phase["fanout"] == "vm-blocked+dw"
+    assert "dw" not in off.stats.routes_by_phase["fanout"]
+    assert np.array_equal(np.asarray(on.dist), np.asarray(off.dist))
+
+
+@pytest.mark.slow  # distinct vb=4 compile; vb>1 stays covered by the
+# tier-1 oracle test's vb=2 case
+def test_dw_bitwise_coarse_block():
+    g = _grid(12)
+    srcs = _sources(g, 4)
+    on = _solver(dirty_window=True, dw_block=4).multi_source(g, srcs)
+    off = _solver(dirty_window=False).multi_source(g, srcs)
+    assert np.array_equal(np.asarray(on.dist), np.asarray(off.dist))
+
+
+def test_dw_bitwise_negative_weights_solve():
+    # Negative weights: the fan-out runs on the reweighted graph, so the
+    # dw route serves the Johnson phase-2 exactly like plain routes.
+    g = _grid(12, neg=0.2, seed=3)
+    srcs = np.arange(4)
+    on = _solver(dirty_window=True).solve(g, sources=srcs)
+    off = _solver(dirty_window=False).solve(g, sources=srcs)
+    assert on.stats.routes_by_phase["fanout"] == "vm-blocked+dw"
+    assert np.array_equal(np.asarray(on.dist), np.asarray(off.dist))
+
+
+def test_dw_bitwise_disconnected():
+    # Two grid islands + isolated vertices: unreachable rows stay +inf
+    # and the activity bitmap never floods the dead component.
+    a = grid2d(6, 6, seed=1)
+    n = a.num_nodes
+    src = np.concatenate([a.src, a.src + n])
+    dst = np.concatenate([a.indices, a.indices + n])
+    w = np.concatenate([a.weights, a.weights])
+    g = CSRGraph.from_edges(src, dst, w, 2 * n + 3)  # +3 isolated
+    srcs = np.array([0, n + 1, 2 * n + 2])
+    on = _solver(dirty_window=True).multi_source(g, srcs)
+    off = _solver(dirty_window=False).multi_source(g, srcs)
+    assert np.array_equal(np.asarray(on.dist), np.asarray(off.dist))
+    assert not np.isfinite(np.asarray(on.dist)[2]).sum() > 1  # isolated row
+
+
+def test_dw_pred_rides_on_top():
+    g = _grid(12)
+    srcs = _sources(g, 4)
+    on = _solver(dirty_window=True).multi_source(
+        g, srcs, predecessors=True
+    )
+    off = _solver(dirty_window=False).multi_source(
+        g, srcs, predecessors=True
+    )
+    assert on.stats.routes_by_phase["fanout"] == "vm-blocked+dw+pred"
+    assert np.array_equal(np.asarray(on.dist), np.asarray(off.dist))
+    assert np.array_equal(
+        np.asarray(on.predecessors), np.asarray(off.predecessors)
+    )
+
+
+def test_gs_dirty_window_bitwise():
+    # The GS outer rounds under the exact in-adjacency mask: same
+    # distances, route tag gs+dw; both the B=1 and the fan-out entry.
+    g = _grid(12)
+    on = _solver(gauss_seidel=True, dirty_window=True, frontier=False)
+    off = _solver(gauss_seidel=True, dirty_window=False, frontier=False)
+    r_on = on.sssp(g, 0)
+    r_off = off.sssp(g, 0)
+    assert r_on.stats.routes_by_phase["bellman_ford"] == "gs+dw"
+    assert r_off.stats.routes_by_phase["bellman_ford"] == "gs"
+    assert np.array_equal(np.asarray(r_on.dist), np.asarray(r_off.dist))
+    srcs = _sources(g, 4)
+    f_on = on.multi_source(g, srcs)
+    f_off = off.multi_source(g, srcs)
+    assert f_on.stats.routes_by_phase["fanout"] == "gs+dw"
+    assert np.array_equal(np.asarray(f_on.dist), np.asarray(f_off.dist))
+
+
+@pytest.mark.slow  # ~3 s: two dense condensed solves (suite budget)
+def test_partitioned_expansion_skip_bitwise():
+    # Two disconnected ER components: cross-component part pairs are
+    # provably unreachable, so the dirty-window expansion gate must
+    # skip their products — and the distances must stay bitwise equal.
+    a = erdos_renyi(96, 0.08, seed=5)
+    rng = np.random.default_rng(6)
+    a = a.with_weights(
+        rng.integers(1, 9, a.num_real_edges).astype(np.float32)
+    )
+    n = a.num_nodes
+    g = CSRGraph.from_edges(
+        np.concatenate([a.src, a.src + n]),
+        np.concatenate([a.indices, a.indices + n]),
+        np.concatenate([a.weights, a.weights]),
+        2 * n,
+    )
+    from paralleljohnson_tpu.solver.partitioned import solve_condensed
+
+    d_on, _, info_on = solve_condensed(
+        g, config=SolverConfig(
+            partitioned=True, partition_parts=8, dirty_window="auto"
+        )
+    )
+    d_off, _, info_off = solve_condensed(
+        g, config=SolverConfig(
+            partitioned=True, partition_parts=8, dirty_window=False
+        )
+    )
+    assert info_on["expand_products_skipped"] > 0
+    assert info_on["expand_macs_skipped"] > 0
+    assert info_off["expand_products_skipped"] == 0
+    assert np.array_equal(d_on, d_off)
+    # The gate is exact: skipped work is accounted, not performed.
+    assert (
+        info_on["macs"] + info_on["expand_macs_skipped"]
+        >= info_off["macs"]
+    )
+
+
+# -- layout + mask correctness ------------------------------------------------
+
+
+def test_gs_layout_in_adj_exact():
+    from paralleljohnson_tpu.ops.gauss_seidel import build_gs_layout
+
+    g = _grid(10)
+    lay = build_gs_layout(g.indptr, g.indices, None, g.num_nodes, vb=16)
+    e = g.num_real_edges
+    rank = lay["rank"]
+    src_b = rank[g.src[:e]] // lay["vb"]
+    dst_b = rank[g.indices[:e]] // lay["vb"]
+    nb = lay["v_pad"] // lay["vb"]
+    expect = np.zeros((nb, nb), bool)
+    expect[dst_b, src_b] = True
+    assert np.array_equal(lay["in_adj"], expect)
+    # The mask is a subset of the halo window (the bandwidth bound).
+    j, i = np.nonzero(lay["in_adj"])
+    assert (np.abs(j - i) <= lay["halo"]).all()
+
+
+@pytest.mark.parametrize("vb", [1, 4])
+def test_dw_layout_tiles(vb):
+    g = _grid(8)
+    e = g.num_real_edges
+    lay = relax.build_dw_layout(g.indptr, g.indices, g.num_nodes, vb=vb)
+    nb, em = lay["nb"], lay["em"]
+    assert lay["e_src"].shape == (nb + 1, em)
+    # Sentinel row is all pads; real slots reproduce the CSR edges.
+    assert (lay["edge_order"][nb] == -1).all()
+    order = lay["edge_order"]
+    real = order >= 0
+    assert real.sum() == e
+    assert sorted(order[real].tolist()) == list(range(e))
+    src = np.repeat(np.arange(g.num_nodes), np.diff(g.indptr))
+    assert (lay["e_src"][real] == src[order[real]]).all()
+    assert (lay["e_dst"][real] == g.indices[:e][order[real]]).all()
+    assert (lay["e_dst"][~real] == nb * vb).all()
+    assert lay["real_ck"].sum() == e
+    # Every edge sits in its source's block row.
+    assert (lay["e_src"][real] // vb == np.nonzero(real)[0]).all()
+
+
+# -- exact counters vs numpy oracle -------------------------------------------
+
+
+def _oracle_dw(g, sources, vb, capacity):
+    """Replay the dirty-window schedule host-side: prev-round block
+    gating, simultaneous (gather-then-scatter) relaxation of active
+    blocks' out-edges, full-sweep fallback past ``capacity``. Returns
+    (dist, rounds, examined_slots, full_rounds)."""
+    v, e = g.num_nodes, g.num_real_edges
+    src = g.src[:e].astype(np.int64)
+    dst = g.indices[:e].astype(np.int64)
+    w = g.weights[:e].astype(np.float32)
+    nb = -(-v // vb)
+    blk_of = np.arange(v) // vb
+    out_edges = [np.flatnonzero(src // vb == j) for j in range(nb)]
+    B = len(sources)
+    dist = np.full((v, B), np.inf, np.float32)
+    dist[np.asarray(sources), np.arange(B)] = 0.0
+    changed = np.zeros(nb, bool)
+    changed[blk_of[np.asarray(sources)]] = True
+    examined = 0
+    fulls = 0
+    rounds = 0
+    while changed.any():
+        rounds += 1
+        if changed.sum() > capacity:
+            fulls += 1
+            examined += e
+            sel = np.arange(e)
+        else:
+            sel = np.concatenate(
+                [out_edges[j] for j in np.flatnonzero(changed)]
+                or [np.array([], np.int64)]
+            ).astype(np.int64)
+            examined += sel.size
+        nd = dist.copy()
+        np.minimum.at(nd, dst[sel], dist[src[sel]] + w[sel][:, None])
+        improved = (nd < dist).any(axis=1)
+        changed = np.zeros(nb, bool)
+        changed[np.unique(blk_of[np.flatnonzero(improved)])] = True
+        dist = nd
+    return dist, rounds, examined, fulls
+
+
+@pytest.mark.parametrize("vb,cap", [(1, 10_000), (2, 10_000), (1, 8)])
+def test_dw_counters_exact_vs_oracle(vb, cap):
+    # cap=8 forces overflow full-sweep rounds; the counter must count E
+    # for those rounds and the per-block out-edges otherwise.
+    g = _grid(8)
+    srcs = _sources(g, 4)
+    solver = _solver(
+        dirty_window=True, dw_block=vb, frontier_capacity=cap,
+    )
+    res = solver.multi_source(g, srcs)
+    b = len(srcs)
+    lay = relax.build_dw_layout(g.indptr, g.indices, g.num_nodes, vb=vb)
+    eff_cap = relax.dw_capacity_clamp(cap, lay["nb"], lay["em"], b)
+    dist, rounds, examined, fulls = _oracle_dw(g, srcs, vb, eff_cap)
+    assert np.array_equal(np.asarray(res.dist), dist.T)
+    assert res.stats.iterations_by_phase["fanout"] == rounds
+    assert res.stats.edges_relaxed == examined * b
+    # Skipped complement is exact too (what the bench reports).
+    assert (
+        rounds * g.num_real_edges * b - res.stats.edges_relaxed
+        == (rounds * g.num_real_edges - examined) * b
+    )
+
+
+# -- dispatch: never blindly --------------------------------------------------
+
+
+def _traj_record_for(g, *, skippable, iterations, half_life=None):
+    n = int(iterations)
+    frontier = np.full(n, max(1.0 - skippable, 0.0) * g.num_nodes)
+    traj = np.stack(
+        [frontier, frontier, np.zeros(n)], axis=1
+    )
+    rec = conv.trajectory_record(
+        traj, label="t", phase="fanout", index=0, route="sweep-sm",
+        platform="cpu", num_nodes=g.num_nodes,
+        num_edges=g.num_real_edges, batch=1,
+    )
+    if half_life is not None:
+        rec["summary"]["frontier_half_life"] = half_life
+    rec["summary"]["jfr_skippable_edge_frac"] = float(skippable)
+    rec["summary"]["iterations"] = n
+    return rec
+
+
+def _write_store(tmp_path, records):
+    from paralleljohnson_tpu.observe.store import ProfileStore
+
+    store = ProfileStore(tmp_path)
+    for r in records:
+        store.append(r)
+    return str(tmp_path)
+
+
+def test_dispatch_requires_evidence(tmp_path):
+    g = _grid(12)
+    srcs = _sources(g, 4)
+    # No profile store: auto must stay on the plain route.
+    res = _solver(dirty_window="auto").multi_source(g, srcs)
+    assert "dw" not in res.stats.routes_by_phase["fanout"]
+    # A collapsing trajectory record for this shape bucket: engage.
+    store = _write_store(
+        tmp_path / "collapse",
+        [_traj_record_for(g, skippable=0.95, iterations=120)],
+    )
+    srcs = _sources(g, 4)
+    res2 = _solver(
+        dirty_window="auto", profile_store=store, convergence=False,
+    ).multi_source(g, srcs)
+    assert res2.stats.routes_by_phase["fanout"] == "vm-blocked+dw"
+    res_off = _solver(dirty_window=False).multi_source(g, srcs)
+    assert np.array_equal(np.asarray(res2.dist), np.asarray(res_off.dist))
+
+
+def test_dispatch_declines_flat_trajectory(tmp_path):
+    g = _grid(12)
+    srcs = _sources(g, 4)
+    # Flat trajectory (low skippable): plain route.
+    store = _write_store(
+        tmp_path / "flat",
+        [_traj_record_for(g, skippable=0.30, iterations=120)],
+    )
+    res = _solver(
+        dirty_window="auto", profile_store=store, convergence=False,
+    ).multi_source(g, srcs)
+    assert "dw" not in res.stats.routes_by_phase["fanout"]
+    # Too few iterations (no tail): plain route.
+    store2 = _write_store(
+        tmp_path / "short",
+        [_traj_record_for(g, skippable=0.95, iterations=4)],
+    )
+    res2 = _solver(
+        dirty_window="auto", profile_store=store2, convergence=False,
+    ).multi_source(g, srcs)
+    assert "dw" not in res2.stats.routes_by_phase["fanout"]
+    # A record for a DIFFERENT shape bucket is not evidence.
+    other = _grid(32)
+    store3 = _write_store(
+        tmp_path / "other",
+        [_traj_record_for(other, skippable=0.95, iterations=120)],
+    )
+    res3 = _solver(
+        dirty_window="auto", profile_store=store3, convergence=False,
+    ).multi_source(g, srcs)
+    assert "dw" not in res3.stats.routes_by_phase["fanout"]
+
+
+def test_dispatch_cost_model_veto(tmp_path):
+    # Trajectory says engage, but the CostModel prices dw SLOWER than
+    # the plain route at this shape: the priced comparison must veto.
+    g = _grid(12)
+    srcs = _sources(g, 4)
+    records = [_traj_record_for(g, skippable=0.95, iterations=120)]
+
+    def solve_rec(route, compute_s):
+        return {
+            "kind": "solve", "label": "t", "route": route,
+            "platform": "cpu", "nodes": g.num_nodes,
+            "edges": g.num_real_edges, "batch": len(srcs),
+            "measured": {"wall_s": compute_s, "compute_s": compute_s},
+            "edges_relaxed": 1, "iterations": 0, "cost": {},
+        }
+
+    records.append(solve_rec("vm-blocked+dw", 100.0))
+    records.append(solve_rec("vm", 0.001))
+    store = _write_store(tmp_path / "veto", records)
+    res = _solver(
+        dirty_window="auto", profile_store=store, convergence=False,
+    ).multi_source(g, srcs)
+    assert "dw" not in res.stats.routes_by_phase["fanout"]
+    be = get_backend("jax", SolverConfig(profile_store=store))
+    decision = be._dw_decision(be.upload(g), len(srcs))
+    assert not decision["engage"]
+    assert "prices dw" in decision["reason"]
+
+
+def test_dw_decision_reports_reason():
+    g = _grid(10)
+    be = get_backend("jax", SolverConfig(profile_store=None))
+    decision = be._dw_decision(be.upload(g), 4)
+    assert not decision["engage"]
+    assert "no profile store" in decision["reason"]
+
+
+# -- resilience ---------------------------------------------------------------
+
+
+def test_dw_oom_degrades_without_corruption():
+    from paralleljohnson_tpu.utils.faults import Fault, FaultPlan
+
+    g = _grid(12)
+    srcs = _sources(g, 8)
+    clean = _solver(dirty_window=True, source_batch_size=4).multi_source(
+        g, srcs
+    )
+    plan = FaultPlan([Fault(stage="fanout", kind="oom", attempt=1,
+                            batch=1)])
+    faulted = _solver(
+        dirty_window=True, source_batch_size=4, fault_plan=plan,
+        pipeline_depth=1, min_source_batch=1,
+    ).multi_source(g, srcs)
+    assert faulted.stats.oom_degradations >= 1
+    assert np.array_equal(
+        np.asarray(clean.dist), np.asarray(faulted.dist)
+    )
+    assert "vm-blocked+dw" in faulted.stats.routes_by_phase["fanout"]
+
+
+# -- convergence-observatory integration --------------------------------------
+
+
+def test_dw_trajectory_twin_records_dirty_blocks(tmp_path):
+    g = _grid(12)
+    srcs = _sources(g, 4)
+    plain = _solver(dirty_window=True).multi_source(g, srcs)
+    inst = _solver(
+        dirty_window=True, convergence=True, profile_store=str(tmp_path),
+    ).multi_source(g, srcs)
+    assert np.array_equal(np.asarray(plain.dist), np.asarray(inst.dist))
+    summ = inst.stats.convergence["fanout"]
+    assert summ["dirty_blocks_total"] > 0
+    assert summ["num_blocks"] == g.num_nodes  # vb=1 default
+    assert len(summ["dirty_block_curve"]) > 0
+    assert summ["examined_edge_slots"] > 0
+    assert summ["skipped_edge_slots"] > 0
+    exact = inst.stats.edges_relaxed
+    assert summ["examined_edge_slots"] * len(srcs) == exact
+    # The profile store got trajectory records keyed by the dw route.
+    from paralleljohnson_tpu.observe.store import ProfileStore
+
+    kinds = [
+        r for r in ProfileStore(str(tmp_path)).records()
+        if r.get("kind") == "trajectory"
+    ]
+    assert any(r.get("route") == "vm-blocked+dw" for r in kinds)
+
+
+# -- the skew-corrected JFR estimator -----------------------------------------
+
+
+def test_jfr_estimator_fixture_rmat_s12():
+    """Regression pin (ISSUE 13 satellite): the uniform-degree
+    estimator read 81.6% skippable on rmat_s12 where the exact counters
+    measured 60.0% — hub collapse overweighted. The degree-biased
+    estimator must land within 8 points of the measured value, and the
+    recorded skew must stay visible in the uniform path (so the fixture
+    guards both directions)."""
+    fix = json.loads(
+        (FIXTURES / "rmat_s12_trajectory.json").read_text()
+    )
+    traj = np.asarray(fix["trajectory"], np.float64)
+    measured = fix["measured_skippable_frac"]
+    uniform = conv.summarize_trajectory(
+        traj, num_nodes=fix["nodes"], num_edges=fix["edges"]
+    )["jfr_skippable_edge_frac"]
+    corrected = conv.summarize_trajectory(
+        traj, num_nodes=fix["nodes"], num_edges=fix["edges"],
+        degree_bias=fix["degree_bias"],
+    )["jfr_skippable_edge_frac"]
+    assert uniform == pytest.approx(fix["uniform_estimate"], abs=1e-9)
+    assert uniform - measured > 0.15          # the recorded skew
+    assert abs(corrected - measured) < 0.08   # the fix
+    assert abs(corrected - fix["degree_weighted_estimate"]) < 1e-9
+
+
+def test_jfr_estimator_uniform_degree_unchanged():
+    # On a uniform-degree graph the biased estimator reduces to the
+    # uniform one (bias == mean degree, the cap never binds).
+    g = grid2d(8, 8, seed=2)
+    traj = np.stack(
+        [np.linspace(40, 1, 20), np.linspace(40, 1, 20), np.zeros(20)],
+        axis=1,
+    )
+    bias = conv.degree_bias_from_degrees(np.diff(g.indptr))
+    uniform = conv.summarize_trajectory(
+        traj, num_nodes=g.num_nodes, num_edges=g.num_real_edges
+    )["jfr_skippable_edge_frac"]
+    corrected = conv.summarize_trajectory(
+        traj, num_nodes=g.num_nodes, num_edges=g.num_real_edges,
+        degree_bias=bias,
+    )["jfr_skippable_edge_frac"]
+    # grid2d degrees are 2..4, so the bias is close to (not exactly)
+    # the mean; the estimates must agree to the bias/mean gap.
+    assert abs(corrected - uniform) < 0.06
+
+
+def test_degree_bias_values():
+    assert conv.degree_bias_from_degrees([0, 0]) is None
+    assert conv.degree_bias_from_degrees([4, 4, 4]) == pytest.approx(4.0)
+    # Size-biased mean exceeds the plain mean on skewed degrees.
+    assert conv.degree_bias_from_degrees([1, 1, 98]) > 90.0
+
+
+# -- bench + regress hygiene --------------------------------------------------
+
+
+@pytest.mark.slow  # ~3.5 s: four timed solves + dispatch loop (budget)
+def test_dirty_window_bench_smoke():
+    from paralleljohnson_tpu import benchmarks
+
+    rec = benchmarks.bench_dirty_window("jax", "smoke")
+    d = rec.detail
+    assert "failed" not in d
+    assert d["skip_frac"] > 0.5
+    assert d["skipped_edges"] == (
+        d["plain_examined_edges"] - d["examined_edges"]
+    )
+    assert d["dispatch"]["grid"]["engage"] is True
+    assert d["dispatch"]["rmat"]["engage"] is False
+    assert "route" in d and "vm-blocked+dw" in d["route"]
+
+
+def test_bench_regress_ingests_dirty_window_row(tmp_path):
+    from paralleljohnson_tpu.observe.regress import (
+        BenchHistory,
+        normalize_record,
+    )
+
+    row = {
+        "config": "dirty_window", "backend": "jax", "preset": "full",
+        "wall_s": 0.19, "edges_relaxed": 1464052,
+        "edges_relaxed_per_sec": 7.5e6, "n_chips": 1,
+        "detail": {"platform": "cpu", "skip_frac": 0.9423,
+                   "iterations": 174},
+    }
+    rows = normalize_record(row, source="pjtpu-bench")
+    assert len(rows) == 1 and rows[0]["bench"] == "dirty_window"
+    hist = BenchHistory(tmp_path)
+    assert hist.append(rows[0]) is True
+    assert hist.append(rows[0]) is False  # idempotent re-ingest
+    assert len(hist.rows()) == 1
