@@ -70,6 +70,13 @@ class KernelResult:
     """
 
     dist: Any  # np.ndarray or a device array (see docstring)
+    # Planner decision record (ISSUE 14, ``paralleljohnson_tpu.planner``):
+    # {chosen, reason, candidates (with explicit ``unpriced`` markers),
+    # built/degraded, params (resolved auto-tuned values)} for dispatch
+    # sites that route through the registry. None for ladder-coded or
+    # third-party backends; folds into ``SolverStats.plan`` and the
+    # profile store's ``kind: "plan"`` records.
+    plan: dict | None = None
     negative_cycle: bool = False
     iterations: int = 0
     edges_relaxed: int = 0

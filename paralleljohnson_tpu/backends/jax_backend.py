@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paralleljohnson_tpu import planner
 from paralleljohnson_tpu.backends.base import Backend, KernelResult, register_backend
 from paralleljohnson_tpu.graphs import CSRGraph
 from paralleljohnson_tpu.ops import relax
@@ -1067,7 +1068,7 @@ class JaxBackend(Backend):
         v = max(dgraph.num_nodes, 1)
         itemsize = jnp.dtype(self._dtype).itemsize
         blocks = 9 if with_pred else 6
-        carry_slots = max(0, int(self.config.pipeline_depth) - 1)
+        carry_slots = max(0, self._pipeline_depth(dgraph) - 1)
         blocks += carry_slots * (2 if with_pred else 1)
         # Per-DEVICE budget: row blocks shard over the "sources" axis only
         # (on a 2-D mesh they replicate over "edges"), so the global B is
@@ -1124,7 +1125,7 @@ class JaxBackend(Backend):
             return False
         from paralleljohnson_tpu.ops import fw as fw_ops
 
-        tile = fw_ops.effective_tile(v, self.config.fw_tile)
+        tile = fw_ops.effective_tile(v, self._fw_tile(dgraph)[0])
         fw_macs = fw_ops.fw_mac_count(fw_ops.pad_tiles(v, tile), tile)
         return fw_macs < relax.squaring_steps(v) * per_iter
 
@@ -1389,6 +1390,23 @@ class JaxBackend(Backend):
             return float(self.config.delta)
         cached = dgraph._by_dst_cache.get("bucket_delta")
         if cached is None:
+            # Profile-tuned width first (ISSUE 14 auto-tuning): a
+            # recorded plan whose explicit delta measured faster on
+            # this (platform, shape bucket) becomes the auto value;
+            # the mean-weight heuristic stays the no-profile fallback.
+            from paralleljohnson_tpu.observe.tuning import resolve_param
+
+            tuned, source = resolve_param(
+                "delta", None, None,
+                config=self.config, platform=jax.default_backend(),
+                num_nodes=dgraph.num_nodes,
+                num_edges=dgraph.num_real_edges,
+                validate=lambda d: isinstance(d, (int, float)) and d > 0,
+            )
+            if source == "profile-tuned":
+                cached = float(tuned)
+                dgraph._by_dst_cache["bucket_delta"] = cached
+                return cached
             from paralleljohnson_tpu.ops.bucket import auto_delta
 
             finite = jnp.isfinite(dgraph.weights)
@@ -1621,6 +1639,14 @@ class JaxBackend(Backend):
                 from paralleljohnson_tpu.ops.bucket import auto_capacity
 
                 delta = self._bucket_delta(dgraph)
+                # Minimal plan note so kind:"plan" records carry the
+                # resolved bucket width — the sample the delta
+                # auto-tuner compares (observe.tuning).
+                bucket_plan = {
+                    "chosen": "bucket",
+                    "reason": "B=1 chain (bucket route)",
+                    "params": {"delta": float(delta)},
+                }
                 # Generous step budget: converging solves use ~hop-
                 # diameter steps << V; the bucket schedule does NOT
                 # subsume Jacobi rounds, so exhausting it is handed to
@@ -1674,6 +1700,7 @@ class JaxBackend(Backend):
                         route="bucket+sweep",
                         cost=bucket_cost,
                     )
+                    res.plan = bucket_plan
                     if traj_bufs:
                         # The trajectory covers the bucketed steps only
                         # (the finishing sweep is the uninstrumented
@@ -1694,6 +1721,7 @@ class JaxBackend(Backend):
                     route="bucket",
                     cost=bucket_cost,
                 )
+                res.plan = bucket_plan
                 if traj_bufs:
                     self._attach_trajectory(res, *traj_bufs, dgraph)
                 return res
@@ -2123,575 +2151,698 @@ class JaxBackend(Backend):
         layout = self.config.fanout_layout
         return "vertex_major" if layout == "auto" else layout
 
-    def multi_source(self, dgraph: JaxDeviceGraph, sources: np.ndarray) -> KernelResult:
-        v = dgraph.num_nodes
-        sources = jnp.asarray(sources, jnp.int32)
-        max_iter = self.config.max_iterations or v
-        mesh = self._mesh()
-        layout = self._resolve_layout()
-        if "edges" in mesh.axis_names and self.config.gauss_seidel is True:
-            # The GS layout is not edge-sharded: its sequential block
-            # schedule needs the whole edge list per device. Sources-only
-            # sharding composes (below); an edges axis does not.
-            raise NotImplementedError(
-                "gauss_seidel=True fan-out shards sources only; use a "
-                "1-D mesh_shape=(n,) (or leave gauss_seidel='auto' to "
-                "use the 2-D sharded sweep path on this mesh)"
-            )
-        if "edges" in mesh.axis_names and self.config.dia is True:
-            # Same contract as gauss_seidel=True: the stencil needs
-            # every diagonal per device, so an edges axis cannot carry
-            # it — "True forces" must fail loud, not silently route a
-            # gather kernel.
-            raise NotImplementedError(
-                "dia=True fan-out shards sources only; use a 1-D "
-                "mesh_shape=(n,) (or leave dia='auto' to use the 2-D "
-                "sharded sweep path on this mesh)"
-            )
-        if self.config.fw is True and (
-            "edges" in mesh.axis_names or mesh.devices.size > 1
-        ):
-            # Same contract as the dense path's single-chip note, made
-            # loud for a forced flag: the FW closure holds the whole
-            # [Vp, Vp] matrix on one chip; "True forces" must fail
-            # rather than silently route a sharded sweep.
-            raise NotImplementedError(
-                "fw=True is a single-chip dense route; use mesh_shape=(1,)"
-            )
-        if "edges" not in mesh.axis_names and self._use_dia(dgraph):
-            # DIA stencil fan-out, tried ahead of every gather route:
-            # on a lattice labeling each sweep is K contiguous [B, V]
-            # roll+add+min passes — pure bandwidth, no per-row gather —
-            # so it wins wherever the B=1 dia route does, at any batch
-            # width. Rows are independent, so a >1-device sources mesh
-            # composes with the replicated [K, V] diagonal weights and
-            # zero per-round collectives (parallel.sharded_dia_fanout);
-            # an "edges" axis does not (the stencil needs every
-            # diagonal per device). Degrade-don't-crash like every
-            # auto route.
-            try:
-                lay = self.dia_bundle(dgraph)
-                traj_bufs = None
-                if mesh.devices.size > 1:
-                    from paralleljohnson_tpu.parallel import (
-                        sharded_dia_fanout,
-                    )
+    def _planner_model(self):
+        """The fitted ``CostModel`` priced dispatch consults, or None
+        (pure declared-priority ladder — identical to the pre-registry
+        dispatch). Enabled when ``config.planner`` is not False and a
+        profile store is configured; the fit is cached against the
+        store file's identity (the tuning module's mtime-keyed record
+        cache), so a multi-batch fan-out re-reads the store at most
+        once per solve."""
+        if getattr(self.config, "planner", "auto") is False:
+            return None
+        from paralleljohnson_tpu.observe.costs import resolve_profile_dir
+        from paralleljohnson_tpu.observe.tuning import cached_records
 
-                    dist, iters, improving, examined = sharded_dia_fanout(
-                        mesh, sources, lay["w_diag"], num_nodes=v,
-                        offsets=lay["offsets"], max_iter=max_iter,
-                        num_entries=lay["num_entries"],
-                        fault_hook=self._shard_fault_hook(),
-                        telemetry=self._telemetry,
-                    )
-                    dia_route = "dia-sharded"
-                    dia_cost = self._observe_unavailable(
-                        "dia-sharded",
-                        "sharded collective executables are not "
-                        "cost-instrumented", dgraph,
-                        batch=int(sources.shape[0]),
-                    )
-                else:
-                    from paralleljohnson_tpu.ops.dia import dia_fixpoint
+        store_dir = resolve_profile_dir(self.config.profile_store)
+        if store_dir is None:
+            return None
+        try:
+            records = cached_records(store_dir)
+        except Exception:  # noqa: BLE001 — a torn store must not crash dispatch
+            return None
+        if not records:
+            return None
+        cached = getattr(self, "_planner_model_cache", None)
+        if cached is not None and cached[0] is records:
+            return cached[1]
+        from paralleljohnson_tpu.observe.store import CostModel
 
-                    dist0_bv = jnp.full((sources.shape[0], v), jnp.inf,
-                                        self._dtype)
-                    dist0_bv = dist0_bv.at[
-                        jnp.arange(sources.shape[0]), sources
-                    ].set(0.0)
-                    cap = self._traj_cap()
-                    if cap is not None:
-                        dist, iters, improving, *traj_bufs = (
-                            _dia_fixpoint_traj(
-                                dist0_bv, lay["w_diag"],
-                                offsets=lay["offsets"], max_iter=max_iter,
-                                traj_cap=cap,
-                            )
-                        )
-                        dia_fn, dia_kwargs = _dia_fixpoint_traj, dict(
-                            offsets=lay["offsets"], max_iter=max_iter,
-                            traj_cap=cap,
-                        )
-                    else:
-                        dist, iters, improving = dia_fixpoint(
-                            dist0_bv, lay["w_diag"],
-                            offsets=lay["offsets"], max_iter=max_iter,
-                        )
-                        dia_fn, dia_kwargs = dia_fixpoint, dict(
-                            offsets=lay["offsets"], max_iter=max_iter,
-                        )
-                    examined = (
-                        int(iters) * lay["num_entries"]
-                        * int(sources.shape[0])
-                    )
-                    dia_route = "dia"
-                    dia_cost = self._observe_cost(
-                        "dia", dia_fn, (dist0_bv, lay["w_diag"]),
-                        dia_kwargs,
-                        dgraph, batch=int(sources.shape[0]),
-                    )
-                res = KernelResult(
-                    dist=dist,
-                    converged=not bool(improving),
-                    iterations=int(iters),
-                    edges_relaxed=examined,
-                    route=dia_route,
-                    cost=dia_cost,
-                )
-                if traj_bufs:
-                    self._attach_trajectory(
-                        res, *traj_bufs, dgraph,
-                        batch=int(sources.shape[0]),
-                    )
-                return res
-            except Exception:
-                self._auto_route_failed(
-                    "_dia_disabled",
-                    "dia stencil fan-out failed on this platform; "
-                    "falling back to the gather routes for this "
-                    "backend instance",
-                    forced=self.config.dia is True,
-                )
-        if "edges" not in mesh.axis_names and self._use_gs(dgraph):
-            # Both GS fan-out routes, tried ahead of the sweep chain:
-            # single-device blocked GS, or GS composed with source
-            # sharding (layout replicated, batch split, sequential block
-            # schedule per device, no per-round collectives —
-            # parallel.mesh.sharded_gs_fanout). "auto" falls back to the
-            # sweep routes below if the kernel fails (e.g. a Mosaic
-            # rejection of the nested-loop engine on a platform CI can't
-            # cover); a forced flag propagates the error.
-            try:
-                bundle = dgraph.gs_layout(self.config.gs_block_size)
-                traj_bufs = None
-                if mesh.devices.size > 1:
-                    from paralleljohnson_tpu.parallel import (
-                        sharded_gs_fanout,
-                    )
+        model = CostModel.fit(records)
+        self._planner_model_cache = (records, model)
+        return model
 
-                    dist, rounds, improving, examined = sharded_gs_fanout(
-                        mesh, sources, bundle["src_blk"],
-                        bundle["dstl_blk"], bundle["w_blk"],
-                        bundle["rank"], v_pad=bundle["v_pad"],
-                        vb=bundle["vb"], halo=bundle["halo"],
-                        max_outer=max_iter, inner_cap=self.config.gs_inner_cap,
-                        real_edges_host=bundle["real_edges_host"],
-                        fault_hook=self._shard_fault_hook(),
-                        telemetry=self._telemetry,
-                    )
-                    gs_route = "gs-sharded"
-                    gs_cost = self._observe_unavailable(
-                        "gs-sharded",
-                        "sharded collective executables are not "
-                        "cost-instrumented", dgraph,
-                        batch=int(sources.shape[0]),
-                    )
-                else:
-                    gs_kwargs = dict(
-                        v_pad=bundle["v_pad"], vb=bundle["vb"],
-                        halo=bundle["halo"], max_outer=max_iter,
-                        inner_cap=self.config.gs_inner_cap,
-                        traj_cap=self._traj_cap(),
-                    )
-                    gs_in_adj = (
-                        bundle["in_adj"]
-                        if self._use_dw(dgraph, int(sources.shape[0]))
-                        else None
-                    )
-                    gs_route = "gs+dw" if gs_in_adj is not None else "gs"
-                    dist, rounds, improving, iters_blk, *traj_bufs = (
-                        _gs_fanout_kernel(
-                            sources, bundle["src_blk"],
-                            bundle["dstl_blk"], bundle["w_blk"],
-                            bundle["rank"], gs_in_adj, **gs_kwargs,
-                        )
-                    )
-                    examined = _gs_examined_exact(
-                        iters_blk, bundle["real_edges_host"],
-                        int(sources.shape[0]),
-                        rounds=int(rounds),
-                        inner_cap=self.config.gs_inner_cap,
-                    )
-                    gs_cost = self._observe_cost(
-                        gs_route, _gs_fanout_kernel,
-                        (sources, bundle["src_blk"], bundle["dstl_blk"],
-                         bundle["w_blk"], bundle["rank"], gs_in_adj),
-                        gs_kwargs,
-                        dgraph, batch=int(sources.shape[0]),
-                    )
-                res = KernelResult(
-                    dist=dist,
-                    converged=not bool(improving),
-                    iterations=int(rounds),
-                    edges_relaxed=examined,
-                    route=gs_route,
-                    cost=gs_cost,
-                )
-                if traj_bufs:
-                    self._attach_trajectory(
-                        res, *traj_bufs, dgraph,
-                        batch=int(sources.shape[0]),
-                    )
-                return res
-            except Exception:
-                self._gs_auto_failed(dgraph)  # re-raises when forced
-        if (
-            "edges" not in mesh.axis_names
-            and mesh.devices.size == 1
-            and self._use_fw(dgraph, int(sources.shape[0]))
-        ):
-            # Blocked min-plus Floyd-Warshall (ops.fw, ROADMAP item 3):
-            # the B=V dense route — replaces min-plus squaring wherever
-            # the exact MAC counters say the O(V^3) closure beats the
-            # O(V^3 log V) squaring. Single-chip (like the dense path);
-            # degrade-don't-crash on auto, propagate when forced.
-            try:
-                from paralleljohnson_tpu.ops import fw as fw_ops
+    def _pipeline_depth(self, dgraph: JaxDeviceGraph) -> int:
+        """The resolved fan-out pipeline depth for memory budgeting:
+        explicit ``config.pipeline_depth`` wins, else the profile-tuned
+        value for this (platform, shape bucket), else the hand-tuned
+        double-buffering default of 2 (``observe.tuning``). The solver
+        resolves the SAME function for its in-flight window, so the
+        budget and the window can never disagree."""
+        from paralleljohnson_tpu.observe.tuning import (
+            DEFAULT_PIPELINE_DEPTH,
+            resolve_param,
+        )
 
-                tile = fw_ops.effective_tile(v, self.config.fw_tile)
-                vp = fw_ops.pad_tiles(v, tile)
-                dist, neg = _fw_apsp_kernel(
-                    sources, dgraph.src, dgraph.dst, dgraph.weights,
-                    num_nodes=v, tile=tile, k_block=fw_ops.FW_KBLOCK,
-                )
-                neg = bool(neg)
-                fw_route = "fw" if vp == tile else "fw-tile"
-                return KernelResult(
-                    dist=dist,
-                    negative_cycle=neg,
-                    converged=not neg,
-                    iterations=vp // tile,
-                    # Exact tropical MACs of the closure (host int) —
-                    # ~squaring/log2(V) on the same padded scale.
-                    edges_relaxed=fw_ops.fw_mac_count(vp, tile),
-                    route=fw_route,
-                    cost=self._observe_analytic(
-                        fw_route,
-                        fw_ops.fw_analytic_cost(
-                            vp, tile, jnp.dtype(self._dtype).itemsize
-                        ),
-                        dgraph, batch=int(sources.shape[0]),
-                    ),
-                )
-            except Exception:
-                self._auto_route_failed(
-                    "_fw_disabled",
-                    "blocked Floyd-Warshall route failed on this "
-                    "platform; falling back to the dense/sparse routes "
-                    "for this backend instance",
-                    forced=self.config.fw is True,
-                )
-        if (
-            "edges" not in mesh.axis_names
-            and mesh.devices.size == 1
-            and not self._use_dense(dgraph)
-            and self._use_dw(dgraph, int(sources.shape[0]))
-        ):
-            # Dirty-window compacted fan-out (ISSUE 13 tentpole):
-            # block-activity-gated relaxation at batch width — examined
-            # work tracks the measured collapsing frontier instead of
-            # rounds x E. Auto engages ONLY from trajectory-record
-            # evidence (_use_dw); degrade-don't-crash like every auto
-            # route; a forced dirty_window=True propagates failures.
-            try:
-                res = self._dw_multi_source(dgraph, sources, max_iter)
-                if res is not None:
-                    return res
-            except Exception:
-                self._auto_route_failed(
-                    "_dw_disabled",
-                    "dirty-window fan-out failed on this platform; "
-                    "falling back to the sweep routes for this backend "
-                    "instance",
-                    forced=self.config.dirty_window is True,
-                )
-        traj_bufs = None
-        if "edges" in mesh.axis_names:
-            # 2-D ("sources", "edges") mesh: rows AND edge slices sharded.
-            from paralleljohnson_tpu.parallel import sharded_fanout_2d
+        value, _ = resolve_param(
+            "pipeline_depth", self.config.pipeline_depth,
+            DEFAULT_PIPELINE_DEPTH,
+            config=self.config, platform=jax.default_backend(),
+            num_nodes=dgraph.num_nodes,
+            num_edges=dgraph.num_real_edges,
+            validate=lambda d: isinstance(d, int) and d >= 1,
+        )
+        return max(1, int(value))
 
-            ns = int(mesh.shape["sources"])
-            ne = int(mesh.shape["edges"])
-            chunk = _edge_chunk_for(
-                -(-sources.shape[0] // ns),
-                -(-dgraph.src.shape[0] // ne),
+    def _fw_tile(self, dgraph: JaxDeviceGraph) -> tuple[int, str]:
+        """The resolved FW tile ``(value, source)``: an explicit
+        ``config.fw_tile`` wins, else the profile-tuned value for this
+        (platform, shape bucket), else the hand-tuned 512 default
+        (``observe.tuning`` — ISSUE 14 auto-tuning). Cached per device
+        graph so `_use_fw` and the build agree."""
+        cached = dgraph._by_dst_cache.get("fw_tile_resolved")
+        if cached is None:
+            from paralleljohnson_tpu.observe.tuning import (
+                DEFAULT_FW_TILE,
+                resolve_param,
             )
-            edges = (
-                dgraph.by_dst() if layout == "vertex_major"
-                else (dgraph.src, dgraph.dst, dgraph.weights)
-            )
-            try:
-                dist, iters, improving, row_sweeps = sharded_fanout_2d(
-                    mesh, sources, *edges,
-                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
-                    layout=layout, with_row_sweeps=True,
-                    fault_hook=self._shard_fault_hook(),
-                    telemetry=self._telemetry,
-                )
-            except Exception as e:
-                return self._sharded_fallback(e, dgraph, sources)
-            route = "sharded-2d"
-            cost = self._observe_unavailable(
-                "sharded-2d",
-                "sharded collective executables are not "
-                "cost-instrumented", dgraph, batch=int(sources.shape[0]),
-            )
-        elif mesh.devices.size > 1:
-            from paralleljohnson_tpu.parallel import sharded_fanout
 
-            # Ceil: sharded_fanout pads the batch up to a mesh multiple, so
-            # each shard solves ceil(B / n) rows — floor would undersize the
-            # memory budget by up to 2x.
-            chunk = _edge_chunk_for(
-                -(-sources.shape[0] // mesh.devices.size),
-                dgraph.src.shape[0],
-            )
-            edges = (
-                dgraph.by_dst() if layout == "vertex_major"
-                else (dgraph.src, dgraph.dst, dgraph.weights)
-            )
-            try:
-                dist, iters, improving, row_sweeps = sharded_fanout(
-                    mesh, sources, *edges,
-                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
-                    layout=layout, with_row_sweeps=True,
-                    fault_hook=self._shard_fault_hook(),
-                    telemetry=self._telemetry,
-                )
-            except Exception as e:
-                return self._sharded_fallback(e, dgraph, sources)
-            route = "sharded-1d"
-            cost = self._observe_unavailable(
-                "sharded-1d",
-                "sharded collective executables are not "
-                "cost-instrumented", dgraph, batch=int(sources.shape[0]),
-            )
-        elif self._use_dense(dgraph):
-            use_pallas, interpret = self._pallas_mode()
-            dist, iters, improving = _dense_fanout_kernel(
-                sources, dgraph.src, dgraph.dst, dgraph.weights,
-                num_nodes=v, max_iter=max_iter,
-                use_pallas=use_pallas, interpret=interpret,
-            )
-            # Honest work accounting for the dense regimes (BASELINE.md
-            # convention note): candidate min-plus operations, NOT E edge
-            # scans — per-iteration cost from the kernel's own regime
-            # decision so the two can never drift.
-            regime, work_per_iter = relax.dense_fanout_regime(
-                v, int(sources.shape[0])
-            )
-            dense_route = (
-                f"dense-{regime}" + ("-pallas" if use_pallas else "")
-            )
-            return KernelResult(
-                dist=dist,
-                converged=not bool(improving),
-                iterations=int(iters),
-                edges_relaxed=int(iters) * work_per_iter,
-                route=dense_route,
-                cost=self._observe_cost(
-                    dense_route, _dense_fanout_kernel,
-                    (sources, dgraph.src, dgraph.dst, dgraph.weights),
-                    dict(num_nodes=v, max_iter=max_iter,
-                         use_pallas=use_pallas, interpret=interpret),
-                    dgraph, batch=int(sources.shape[0]),
+            value, source = resolve_param(
+                "fw_tile", self.config.fw_tile, DEFAULT_FW_TILE,
+                config=self.config, platform=jax.default_backend(),
+                num_nodes=dgraph.num_nodes,
+                num_edges=dgraph.num_real_edges,
+                validate=lambda t: (
+                    isinstance(t, int) and t >= 128 and t % 128 == 0
                 ),
             )
-        elif layout == "vertex_major":
-            use_pallas, interpret = self._pallas_mode()
-            play = (
-                dgraph.pallas_sweep_layout(_pallas_vb(v), PALLAS_EC)
-                if use_pallas else None
+            cached = (int(value), source)
+            dgraph._by_dst_cache["fw_tile_resolved"] = cached
+        return cached
+
+    def plan_preview(self, dgraph: JaxDeviceGraph, batch: int) -> dict:
+        """The planner decision for a prospective fan-out at ``batch``
+        width, WITHOUT building anything — what ``cli info --graph``
+        prints (chosen plan + why-line + candidate table with explicit
+        ``unpriced`` markers)."""
+        from paralleljohnson_tpu import planner as _planner
+
+        ctx = _FanoutCtx(
+            backend=self,
+            dgraph=dgraph,
+            sources=jnp.zeros((max(1, batch),), jnp.int32),
+            batch=max(1, int(batch)),
+            max_iter=self.config.max_iterations or dgraph.num_nodes,
+            mesh=self._mesh(),
+            layout=self._resolve_layout(),
+        )
+        decision = _planner.select(
+            FANOUT_PLANS, ctx,
+            model=self._planner_model(),
+            platform=jax.default_backend(),
+            num_edges=dgraph.num_real_edges,
+            batch=ctx.batch,
+            config=self.config,
+        )
+        decision.params.update(ctx.params)
+        decision.params.setdefault("fw_tile", self._fw_tile(dgraph)[0])
+        return decision.as_dict()
+
+    def multi_source(self, dgraph: JaxDeviceGraph, sources: np.ndarray) -> KernelResult:
+        """Batched fan-out dispatch through the priced planner registry
+        (ISSUE 14 tentpole; the pre-registry if/else ladder is gone):
+        ``planner.select`` evaluates every plan's contract (the loud
+        forced-flag NotImplementedErrors), qualification, and — when
+        the profile store prices both the priority incumbent and a
+        challenger — promotes the cheaper plan. With nothing priced the
+        ranking IS the old ladder order, so dispatch (and therefore
+        distances) is bit-for-bit what the ladder produced. The loop
+        then walks the ranking degrade-don't-crash: an auto plan that
+        raises warns once + disables itself for this backend instance
+        and the next qualified plan serves the batch; a forced plan
+        propagates. The decision (chosen plan, why-line, candidates
+        with explicit ``unpriced`` markers, resolved tuned parameters)
+        rides on ``KernelResult.plan`` into ``SolverStats.plan`` and
+        the profile store's ``kind: "plan"`` records."""
+        from paralleljohnson_tpu import planner as _planner
+
+        sources = jnp.asarray(sources, jnp.int32)
+        ctx = _FanoutCtx(
+            backend=self,
+            dgraph=dgraph,
+            sources=sources,
+            batch=int(sources.shape[0]),
+            max_iter=self.config.max_iterations or dgraph.num_nodes,
+            mesh=self._mesh(),
+            layout=self._resolve_layout(),
+        )
+        decision = _planner.select(
+            FANOUT_PLANS, ctx,
+            model=self._planner_model(),
+            platform=jax.default_backend(),
+            num_edges=dgraph.num_real_edges,
+            batch=ctx.batch,
+            config=self.config,
+        )
+        self.last_plan_decision = decision
+        for cand in decision.ranking:
+            try:
+                res = cand.plan.build(ctx)
+            except Exception:
+                if cand.plan.failure is None:
+                    raise
+                # Called from this active except block so a forced
+                # flag's bare ``raise`` propagates the original error.
+                cand.plan.failure(self, ctx)
+                continue
+            if res is None:
+                continue  # required layout unavailable — degrade
+            decision.params.update(ctx.params)
+            res.plan = decision.as_dict(built=cand.plan.name)
+            return res
+        raise RuntimeError(
+            "planner: every qualified fan-out plan failed (the sweep "
+            "plans are unconditional — this is a bug)"
+        )
+
+    # -- fan-out plan builds (the registry's build hooks; each is the
+    #    body its ladder branch used to hold, verbatim kernels) --------
+
+    def _plan_build_dia(self, ctx) -> KernelResult:
+        """DIA stencil fan-out: on a lattice labeling each sweep is K
+        contiguous [B, V] roll+add+min passes — pure bandwidth, no
+        per-row gather. Rows are independent, so a >1-device sources
+        mesh composes with the replicated [K, V] diagonal weights and
+        zero per-round collectives; an "edges" axis does not (the
+        qualification gate)."""
+        dgraph, sources, max_iter = ctx.dgraph, ctx.sources, ctx.max_iter
+        v = dgraph.num_nodes
+        lay = self.dia_bundle(dgraph)
+        traj_bufs = None
+        if ctx.mesh.devices.size > 1:
+            from paralleljohnson_tpu.parallel import sharded_dia_fanout
+
+            dist, iters, improving, examined = sharded_dia_fanout(
+                ctx.mesh, sources, lay["w_diag"], num_nodes=v,
+                offsets=lay["offsets"], max_iter=max_iter,
+                num_entries=lay["num_entries"],
+                fault_hook=self._shard_fault_hook(),
+                telemetry=self._telemetry,
             )
-            if play is not None:
-                # The kernel's VMEM block specs are sized for B=128
-                # (three [vb, B] f32 blocks must fit ~16 MB/core), so
-                # wider batches run as 128-wide slices; the last slice
-                # pads to a 128 multiple with duplicate sources[0] rows
-                # (trimmed below). Interpret-mode CI keeps tiny batches.
-                b_real = int(sources.shape[0])
-                bk = PALLAS_BATCH_SLICE
-                dists, iters_list, improving = [], [], False
-                row_sweeps = 0
-                for lo in range(0, b_real, bk):
-                    sl = sources[lo: lo + bk]
-                    b_sl = int(sl.shape[0])
-                    pad = 0 if interpret else (-b_sl) % bk
-                    if pad:
-                        sl = jnp.concatenate(
-                            [sl, jnp.full(pad, sl[0], jnp.int32)]
-                        )
-                    d, it, imp = _fanout_pallas_kernel(
-                        sl, play["srcl_ck"], play["dstl_ck"],
-                        play["w_ck"], play["runend_ck"], play["sb_ids"],
-                        play["db_ids"], play["first_ck"], num_nodes=v,
-                        v_pad=play["v_pad"], vb=play["vb"],
-                        max_iter=max_iter, interpret=interpret,
-                    )
-                    dists.append(d[:b_sl])
-                    iters_list.append(int(it))
-                    improving = improving or bool(imp)
-                    row_sweeps += int(it) * b_sl
-                dist = dists[0] if len(dists) == 1 else jnp.concatenate(dists)
-                iters = max(iters_list)
-                route = "pallas-vm"
-                cost = self._observe_unavailable(
-                    "pallas-vm",
-                    "the sliced Pallas sweep has no single "
-                    "cost-instrumented executable", dgraph, batch=b_real,
-                )
-            else:
-                chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
-                # The layout's chunk size is derived from the batch size
-                # ROUNDED UP to a power of two, so ragged final batches
-                # (e.g. 104 of 128) reuse the canonical layout instead of
-                # triggering an O(E) host rebuild + duplicate device upload.
-                lay_chunk = _edge_chunk_for(
-                    1 << max(0, int(sources.shape[0]) - 1).bit_length(),
-                    dgraph.src.shape[0],
-                )
-                route = None
-                if v > VM_BLOCK and not getattr(
-                    self, "_vmb_disabled", False
-                ):
-                    # Large graphs: dst-blocked sweep — per-chunk segment
-                    # writes are [vb, B], not [V, B] (see ops.relax
-                    # notes). Degrade-don't-crash (size-gated default CI
-                    # cannot run on the real platform): the layout
-                    # build, the kernel, AND the output materialization
-                    # (dispatch is async — a device-time failure only
-                    # surfaces at the int()) all sit inside the try.
-                    try:
-                        lay = dgraph.vm_blocked_layout(VM_BLOCK, lay_chunk)
-                        if lay is not None:
-                            cap = self._traj_cap()
-                            if cap is not None:
-                                dist, iters, improving, *traj_bufs = (
-                                    _fanout_vm_blocked_kernel_traj(
-                                        sources, lay["src_ck"],
-                                        lay["dstl_ck"], lay["w_ck"],
-                                        lay["base_ck"], num_nodes=v,
-                                        v_pad=lay["v_pad"], vb=lay["vb"],
-                                        max_iter=max_iter, traj_cap=cap,
-                                    )
-                                )
-                                vmb_fn = _fanout_vm_blocked_kernel_traj
-                                vmb_kwargs = dict(
-                                    num_nodes=v, v_pad=lay["v_pad"],
-                                    vb=lay["vb"], max_iter=max_iter,
-                                    traj_cap=cap,
-                                )
-                            else:
-                                dist, iters, improving = (
-                                    _fanout_vm_blocked_kernel(
-                                        sources, lay["src_ck"],
-                                        lay["dstl_ck"], lay["w_ck"],
-                                        lay["base_ck"], num_nodes=v,
-                                        v_pad=lay["v_pad"], vb=lay["vb"],
-                                        max_iter=max_iter,
-                                    )
-                                )
-                                vmb_fn = _fanout_vm_blocked_kernel
-                                vmb_kwargs = dict(
-                                    num_nodes=v, v_pad=lay["v_pad"],
-                                    vb=lay["vb"], max_iter=max_iter,
-                                )
-                            iters = int(iters)
-                            route = "vm-blocked"
-                            cost = self._observe_cost(
-                                "vm-blocked", vmb_fn,
-                                (sources, lay["src_ck"], lay["dstl_ck"],
-                                 lay["w_ck"], lay["base_ck"]),
-                                vmb_kwargs,
-                                dgraph, batch=int(sources.shape[0]),
-                            )
-                    except Exception:
-                        traj_bufs = None  # a dead route's buffers must
-                        # never attach to the fallback's result
-                        self._auto_route_failed(
-                            "_vmb_disabled",
-                            "dst-blocked vm fan-out failed on this "
-                            "platform; falling back to the plain vm "
-                            "sweep for this backend instance",
-                            forced=False,
-                        )
-                if route is None:
-                    src_bd, dst_bd, w_bd = dgraph.by_dst()
-                    cap = self._traj_cap()
-                    if cap is not None:
-                        dist, iters, improving, *traj_bufs = (
-                            _fanout_vm_kernel_traj(
-                                sources, src_bd, dst_bd, w_bd,
-                                num_nodes=v, max_iter=max_iter,
-                                edge_chunk=chunk, traj_cap=cap,
-                            )
-                        )
-                        vm_fn, vm_kwargs = _fanout_vm_kernel_traj, dict(
-                            num_nodes=v, max_iter=max_iter,
-                            edge_chunk=chunk, traj_cap=cap,
-                        )
-                    else:
-                        dist, iters, improving = _fanout_vm_kernel(
-                            sources, src_bd, dst_bd, w_bd,
-                            num_nodes=v, max_iter=max_iter,
-                            edge_chunk=chunk,
-                        )
-                        vm_fn, vm_kwargs = _fanout_vm_kernel, dict(
-                            num_nodes=v, max_iter=max_iter,
-                            edge_chunk=chunk,
-                        )
-                    route = "vm"
-                    cost = self._observe_cost(
-                        "vm", vm_fn,
-                        (sources, src_bd, dst_bd, w_bd),
-                        vm_kwargs,
-                        dgraph, batch=int(sources.shape[0]),
-                    )
-                row_sweeps = int(iters) * int(sources.shape[0])
+            dia_route = "dia-sharded"
+            dia_cost = self._observe_unavailable(
+                "dia-sharded",
+                "sharded collective executables are not "
+                "cost-instrumented", dgraph,
+                batch=ctx.batch,
+            )
         else:
-            chunk = _edge_chunk_for(sources.shape[0], dgraph.src.shape[0])
+            from paralleljohnson_tpu.ops.dia import dia_fixpoint
+
+            dist0_bv = jnp.full((sources.shape[0], v), jnp.inf,
+                                self._dtype)
+            dist0_bv = dist0_bv.at[
+                jnp.arange(sources.shape[0]), sources
+            ].set(0.0)
             cap = self._traj_cap()
             if cap is not None:
-                dist, iters, improving, *traj_bufs = _fanout_kernel_traj(
-                    sources, dgraph.src, dgraph.dst, dgraph.weights,
-                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
-                    traj_cap=cap,
+                dist, iters, improving, *traj_bufs = (
+                    _dia_fixpoint_traj(
+                        dist0_bv, lay["w_diag"],
+                        offsets=lay["offsets"], max_iter=max_iter,
+                        traj_cap=cap,
+                    )
                 )
-                sm_fn, sm_kwargs = _fanout_kernel_traj, dict(
-                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                dia_fn, dia_kwargs = _dia_fixpoint_traj, dict(
+                    offsets=lay["offsets"], max_iter=max_iter,
                     traj_cap=cap,
                 )
             else:
-                dist, iters, improving = _fanout_kernel(
-                    sources, dgraph.src, dgraph.dst, dgraph.weights,
-                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                dist, iters, improving = dia_fixpoint(
+                    dist0_bv, lay["w_diag"],
+                    offsets=lay["offsets"], max_iter=max_iter,
                 )
-                sm_fn, sm_kwargs = _fanout_kernel, dict(
-                    num_nodes=v, max_iter=max_iter, edge_chunk=chunk,
+                dia_fn, dia_kwargs = dia_fixpoint, dict(
+                    offsets=lay["offsets"], max_iter=max_iter,
                 )
-            row_sweeps = int(iters) * int(sources.shape[0])
-            route = "sweep-sm"
-            cost = self._observe_cost(
-                "sweep-sm", sm_fn,
-                (sources, dgraph.src, dgraph.dst, dgraph.weights),
-                sm_kwargs,
-                dgraph, batch=int(sources.shape[0]),
+            examined = (
+                int(iters) * lay["num_entries"] * int(sources.shape[0])
             )
-        iters = int(iters)
-        # Single-chip kernels iterate every row together, so iters x B is
-        # exact; the sharded path reports the psum'd per-shard total.
+            dia_route = "dia"
+            dia_cost = self._observe_cost(
+                "dia", dia_fn, (dist0_bv, lay["w_diag"]),
+                dia_kwargs,
+                dgraph, batch=ctx.batch,
+            )
         res = KernelResult(
             dist=dist,
             converged=not bool(improving),
-            iterations=iters,
+            iterations=int(iters),
+            edges_relaxed=examined,
+            route=dia_route,
+            cost=dia_cost,
+        )
+        if traj_bufs:
+            self._attach_trajectory(
+                res, *traj_bufs, dgraph, batch=ctx.batch
+            )
+        return res
+
+    def _plan_build_gs(self, ctx) -> KernelResult:
+        """Blocked Gauss-Seidel fan-out: single-device blocked GS, or
+        GS composed with source sharding (layout replicated, batch
+        split, sequential block schedule per device, no per-round
+        collectives)."""
+        dgraph, sources, max_iter = ctx.dgraph, ctx.sources, ctx.max_iter
+        bundle = dgraph.gs_layout(self.config.gs_block_size)
+        traj_bufs = None
+        if ctx.mesh.devices.size > 1:
+            from paralleljohnson_tpu.parallel import sharded_gs_fanout
+
+            dist, rounds, improving, examined = sharded_gs_fanout(
+                ctx.mesh, sources, bundle["src_blk"],
+                bundle["dstl_blk"], bundle["w_blk"],
+                bundle["rank"], v_pad=bundle["v_pad"],
+                vb=bundle["vb"], halo=bundle["halo"],
+                max_outer=max_iter, inner_cap=self.config.gs_inner_cap,
+                real_edges_host=bundle["real_edges_host"],
+                fault_hook=self._shard_fault_hook(),
+                telemetry=self._telemetry,
+            )
+            gs_route = "gs-sharded"
+            gs_cost = self._observe_unavailable(
+                "gs-sharded",
+                "sharded collective executables are not "
+                "cost-instrumented", dgraph,
+                batch=ctx.batch,
+            )
+        else:
+            gs_kwargs = dict(
+                v_pad=bundle["v_pad"], vb=bundle["vb"],
+                halo=bundle["halo"], max_outer=max_iter,
+                inner_cap=self.config.gs_inner_cap,
+                traj_cap=self._traj_cap(),
+            )
+            gs_in_adj = (
+                bundle["in_adj"]
+                if self._use_dw(dgraph, ctx.batch)
+                else None
+            )
+            gs_route = "gs+dw" if gs_in_adj is not None else "gs"
+            dist, rounds, improving, iters_blk, *traj_bufs = (
+                _gs_fanout_kernel(
+                    sources, bundle["src_blk"],
+                    bundle["dstl_blk"], bundle["w_blk"],
+                    bundle["rank"], gs_in_adj, **gs_kwargs,
+                )
+            )
+            examined = _gs_examined_exact(
+                iters_blk, bundle["real_edges_host"],
+                ctx.batch,
+                rounds=int(rounds),
+                inner_cap=self.config.gs_inner_cap,
+            )
+            gs_cost = self._observe_cost(
+                gs_route, _gs_fanout_kernel,
+                (sources, bundle["src_blk"], bundle["dstl_blk"],
+                 bundle["w_blk"], bundle["rank"], gs_in_adj),
+                gs_kwargs,
+                dgraph, batch=ctx.batch,
+            )
+        res = KernelResult(
+            dist=dist,
+            converged=not bool(improving),
+            iterations=int(rounds),
+            edges_relaxed=examined,
+            route=gs_route,
+            cost=gs_cost,
+        )
+        if traj_bufs:
+            self._attach_trajectory(
+                res, *traj_bufs, dgraph, batch=ctx.batch
+            )
+        return res
+
+    def _plan_build_fw(self, ctx) -> KernelResult:
+        """Blocked min-plus Floyd-Warshall (ops.fw, ROADMAP item 3):
+        the B=V dense route — the O(V^3) closure wherever the exact MAC
+        counters say it beats O(V^3 log V) squaring. Single-chip (the
+        qualification gate); the tile is the ISSUE 14 auto-tuned
+        parameter (explicit config > profile-tuned > 512)."""
+        from paralleljohnson_tpu.ops import fw as fw_ops
+
+        dgraph, sources = ctx.dgraph, ctx.sources
+        v = dgraph.num_nodes
+        tile, tile_source = self._fw_tile(dgraph)
+        tile = fw_ops.effective_tile(v, tile)
+        ctx.params["fw_tile"] = tile
+        ctx.params["fw_tile_source"] = tile_source
+        vp = fw_ops.pad_tiles(v, tile)
+        dist, neg = _fw_apsp_kernel(
+            sources, dgraph.src, dgraph.dst, dgraph.weights,
+            num_nodes=v, tile=tile, k_block=fw_ops.FW_KBLOCK,
+        )
+        neg = bool(neg)
+        fw_route = "fw" if vp == tile else "fw-tile"
+        return KernelResult(
+            dist=dist,
+            negative_cycle=neg,
+            converged=not neg,
+            iterations=vp // tile,
+            # Exact tropical MACs of the closure (host int) —
+            # ~squaring/log2(V) on the same padded scale.
+            edges_relaxed=fw_ops.fw_mac_count(vp, tile),
+            route=fw_route,
+            cost=self._observe_analytic(
+                fw_route,
+                fw_ops.fw_analytic_cost(
+                    vp, tile, jnp.dtype(self._dtype).itemsize
+                ),
+                dgraph, batch=ctx.batch,
+            ),
+        )
+
+    def _plan_build_dw(self, ctx) -> KernelResult | None:
+        """Dirty-window compacted fan-out (ISSUE 13): examined work
+        tracks the measured collapsing frontier instead of rounds x E.
+        Returns None when the layout is unavailable (degrade to the
+        sweep chain)."""
+        return self._dw_multi_source(ctx.dgraph, ctx.sources, ctx.max_iter)
+
+    def _plan_build_sharded_2d(self, ctx) -> KernelResult:
+        """2-D ("sources", "edges") mesh: rows AND edge slices sharded.
+        A collective failure degrades to single-device inside
+        ``_sharded_fallback`` (re-dispatching through the planner on a
+        1-device mesh) — OOM re-raises for the solver's degrader."""
+        from paralleljohnson_tpu.parallel import sharded_fanout_2d
+
+        dgraph, sources, mesh = ctx.dgraph, ctx.sources, ctx.mesh
+        v = dgraph.num_nodes
+        ns = int(mesh.shape["sources"])
+        ne = int(mesh.shape["edges"])
+        chunk = _edge_chunk_for(
+            -(-sources.shape[0] // ns),
+            -(-dgraph.src.shape[0] // ne),
+        )
+        edges = (
+            dgraph.by_dst() if ctx.layout == "vertex_major"
+            else (dgraph.src, dgraph.dst, dgraph.weights)
+        )
+        try:
+            dist, iters, improving, row_sweeps = sharded_fanout_2d(
+                mesh, sources, *edges,
+                num_nodes=v, max_iter=ctx.max_iter, edge_chunk=chunk,
+                layout=ctx.layout, with_row_sweeps=True,
+                fault_hook=self._shard_fault_hook(),
+                telemetry=self._telemetry,
+            )
+        except Exception as e:
+            return self._sharded_fallback(e, dgraph, sources)
+        cost = self._observe_unavailable(
+            "sharded-2d",
+            "sharded collective executables are not "
+            "cost-instrumented", dgraph, batch=ctx.batch,
+        )
+        return self._sweep_kernel_result(
+            dist, iters, improving, row_sweeps, "sharded-2d", cost,
+            None, dgraph, ctx.batch,
+        )
+
+    def _plan_build_sharded_1d(self, ctx) -> KernelResult:
+        """1-D sources mesh: fan-out rows sharded, CSR replicated."""
+        from paralleljohnson_tpu.parallel import sharded_fanout
+
+        dgraph, sources, mesh = ctx.dgraph, ctx.sources, ctx.mesh
+        # Ceil: sharded_fanout pads the batch up to a mesh multiple, so
+        # each shard solves ceil(B / n) rows — floor would undersize the
+        # memory budget by up to 2x.
+        chunk = _edge_chunk_for(
+            -(-sources.shape[0] // mesh.devices.size),
+            dgraph.src.shape[0],
+        )
+        edges = (
+            dgraph.by_dst() if ctx.layout == "vertex_major"
+            else (dgraph.src, dgraph.dst, dgraph.weights)
+        )
+        try:
+            dist, iters, improving, row_sweeps = sharded_fanout(
+                mesh, sources, *edges,
+                num_nodes=dgraph.num_nodes, max_iter=ctx.max_iter,
+                edge_chunk=chunk,
+                layout=ctx.layout, with_row_sweeps=True,
+                fault_hook=self._shard_fault_hook(),
+                telemetry=self._telemetry,
+            )
+        except Exception as e:
+            return self._sharded_fallback(e, dgraph, sources)
+        cost = self._observe_unavailable(
+            "sharded-1d",
+            "sharded collective executables are not "
+            "cost-instrumented", dgraph, batch=ctx.batch,
+        )
+        return self._sweep_kernel_result(
+            dist, iters, improving, row_sweeps, "sharded-1d", cost,
+            None, dgraph, ctx.batch,
+        )
+
+    def _plan_build_dense(self, ctx) -> KernelResult:
+        """Dense min-plus fan-out (B x V^2 per sweep — the regularity
+        win on actually-dense small graphs)."""
+        dgraph, sources = ctx.dgraph, ctx.sources
+        v = dgraph.num_nodes
+        use_pallas, interpret = self._pallas_mode()
+        dist, iters, improving = _dense_fanout_kernel(
+            sources, dgraph.src, dgraph.dst, dgraph.weights,
+            num_nodes=v, max_iter=ctx.max_iter,
+            use_pallas=use_pallas, interpret=interpret,
+        )
+        # Honest work accounting for the dense regimes (BASELINE.md
+        # convention note): candidate min-plus operations, NOT E edge
+        # scans — per-iteration cost from the kernel's own regime
+        # decision so the two can never drift.
+        regime, work_per_iter = relax.dense_fanout_regime(v, ctx.batch)
+        dense_route = (
+            f"dense-{regime}" + ("-pallas" if use_pallas else "")
+        )
+        return KernelResult(
+            dist=dist,
+            converged=not bool(improving),
+            iterations=int(iters),
+            edges_relaxed=int(iters) * work_per_iter,
+            route=dense_route,
+            cost=self._observe_cost(
+                dense_route, _dense_fanout_kernel,
+                (sources, dgraph.src, dgraph.dst, dgraph.weights),
+                dict(num_nodes=v, max_iter=ctx.max_iter,
+                     use_pallas=use_pallas, interpret=interpret),
+                dgraph, batch=ctx.batch,
+            ),
+        )
+
+    def _plan_build_pallas_vm(self, ctx) -> KernelResult | None:
+        """VMEM-resident Pallas fan-out sweep (explicit opt-in via
+        use_pallas=True). The kernel's VMEM block specs are sized for
+        B=128 (three [vb, B] f32 blocks must fit ~16 MB/core), so
+        wider batches run as 128-wide slices; the last slice pads to a
+        128 multiple with duplicate sources[0] rows (trimmed).
+        Interpret-mode CI keeps tiny batches. None when the traffic
+        model refused the layout (degrade to the XLA sweeps)."""
+        dgraph, sources = ctx.dgraph, ctx.sources
+        v = dgraph.num_nodes
+        use_pallas, interpret = self._pallas_mode()
+        play = (
+            dgraph.pallas_sweep_layout(_pallas_vb(v), PALLAS_EC)
+            if use_pallas else None
+        )
+        if play is None:
+            return None
+        b_real = ctx.batch
+        bk = PALLAS_BATCH_SLICE
+        dists, iters_list, improving = [], [], False
+        row_sweeps = 0
+        for lo in range(0, b_real, bk):
+            sl = sources[lo: lo + bk]
+            b_sl = int(sl.shape[0])
+            pad = 0 if interpret else (-b_sl) % bk
+            if pad:
+                sl = jnp.concatenate(
+                    [sl, jnp.full(pad, sl[0], jnp.int32)]
+                )
+            d, it, imp = _fanout_pallas_kernel(
+                sl, play["srcl_ck"], play["dstl_ck"],
+                play["w_ck"], play["runend_ck"], play["sb_ids"],
+                play["db_ids"], play["first_ck"], num_nodes=v,
+                v_pad=play["v_pad"], vb=play["vb"],
+                max_iter=ctx.max_iter, interpret=interpret,
+            )
+            dists.append(d[:b_sl])
+            iters_list.append(int(it))
+            improving = improving or bool(imp)
+            row_sweeps += int(it) * b_sl
+        dist = dists[0] if len(dists) == 1 else jnp.concatenate(dists)
+        iters = max(iters_list)
+        cost = self._observe_unavailable(
+            "pallas-vm",
+            "the sliced Pallas sweep has no single "
+            "cost-instrumented executable", dgraph, batch=b_real,
+        )
+        return self._sweep_kernel_result(
+            dist, iters, improving, row_sweeps, "pallas-vm", cost,
+            None, dgraph, ctx.batch,
+        )
+
+    def _vm_lay_chunk(self, ctx) -> int:
+        # The layout's chunk size is derived from the batch size
+        # ROUNDED UP to a power of two, so ragged final batches
+        # (e.g. 104 of 128) reuse the canonical layout instead of
+        # triggering an O(E) host rebuild + duplicate device upload.
+        return _edge_chunk_for(
+            1 << max(0, ctx.batch - 1).bit_length(),
+            ctx.dgraph.src.shape[0],
+        )
+
+    def _plan_build_vm_blocked(self, ctx) -> KernelResult | None:
+        """Dst-blocked vertex-major sweep for large graphs: per-chunk
+        segment writes are [vb, B], not [V, B]. None when no host
+        structure is available (degrade to the plain vm sweep)."""
+        dgraph, sources = ctx.dgraph, ctx.sources
+        v = dgraph.num_nodes
+        lay = dgraph.vm_blocked_layout(VM_BLOCK, self._vm_lay_chunk(ctx))
+        if lay is None:
+            return None
+        cap = self._traj_cap()
+        traj_bufs = None
+        if cap is not None:
+            dist, iters, improving, *traj_bufs = (
+                _fanout_vm_blocked_kernel_traj(
+                    sources, lay["src_ck"],
+                    lay["dstl_ck"], lay["w_ck"],
+                    lay["base_ck"], num_nodes=v,
+                    v_pad=lay["v_pad"], vb=lay["vb"],
+                    max_iter=ctx.max_iter, traj_cap=cap,
+                )
+            )
+            vmb_fn = _fanout_vm_blocked_kernel_traj
+            vmb_kwargs = dict(
+                num_nodes=v, v_pad=lay["v_pad"],
+                vb=lay["vb"], max_iter=ctx.max_iter,
+                traj_cap=cap,
+            )
+        else:
+            dist, iters, improving = (
+                _fanout_vm_blocked_kernel(
+                    sources, lay["src_ck"],
+                    lay["dstl_ck"], lay["w_ck"],
+                    lay["base_ck"], num_nodes=v,
+                    v_pad=lay["v_pad"], vb=lay["vb"],
+                    max_iter=ctx.max_iter,
+                )
+            )
+            vmb_fn = _fanout_vm_blocked_kernel
+            vmb_kwargs = dict(
+                num_nodes=v, v_pad=lay["v_pad"],
+                vb=lay["vb"], max_iter=ctx.max_iter,
+            )
+        iters = int(iters)
+        cost = self._observe_cost(
+            "vm-blocked", vmb_fn,
+            (sources, lay["src_ck"], lay["dstl_ck"],
+             lay["w_ck"], lay["base_ck"]),
+            vmb_kwargs,
+            dgraph, batch=ctx.batch,
+        )
+        return self._sweep_kernel_result(
+            dist, iters, improving, iters * ctx.batch, "vm-blocked",
+            cost, traj_bufs, dgraph, ctx.batch,
+        )
+
+    def _plan_build_vm(self, ctx) -> KernelResult:
+        """Plain vertex-major fan-out sweep: dst-sorted edges, sorted
+        segment reduction (no scatter)."""
+        dgraph, sources = ctx.dgraph, ctx.sources
+        v = dgraph.num_nodes
+        chunk = _edge_chunk_for(ctx.batch, dgraph.src.shape[0])
+        src_bd, dst_bd, w_bd = dgraph.by_dst()
+        cap = self._traj_cap()
+        traj_bufs = None
+        if cap is not None:
+            dist, iters, improving, *traj_bufs = (
+                _fanout_vm_kernel_traj(
+                    sources, src_bd, dst_bd, w_bd,
+                    num_nodes=v, max_iter=ctx.max_iter,
+                    edge_chunk=chunk, traj_cap=cap,
+                )
+            )
+            vm_fn, vm_kwargs = _fanout_vm_kernel_traj, dict(
+                num_nodes=v, max_iter=ctx.max_iter,
+                edge_chunk=chunk, traj_cap=cap,
+            )
+        else:
+            dist, iters, improving = _fanout_vm_kernel(
+                sources, src_bd, dst_bd, w_bd,
+                num_nodes=v, max_iter=ctx.max_iter,
+                edge_chunk=chunk,
+            )
+            vm_fn, vm_kwargs = _fanout_vm_kernel, dict(
+                num_nodes=v, max_iter=ctx.max_iter,
+                edge_chunk=chunk,
+            )
+        iters = int(iters)
+        cost = self._observe_cost(
+            "vm", vm_fn,
+            (sources, src_bd, dst_bd, w_bd),
+            vm_kwargs,
+            dgraph, batch=ctx.batch,
+        )
+        return self._sweep_kernel_result(
+            dist, iters, improving, iters * ctx.batch, "vm", cost,
+            traj_bufs, dgraph, ctx.batch,
+        )
+
+    def _plan_build_sweep_sm(self, ctx) -> KernelResult:
+        """Source-major fan-out sweep (flattened-id scatter-min)."""
+        dgraph, sources = ctx.dgraph, ctx.sources
+        v = dgraph.num_nodes
+        chunk = _edge_chunk_for(ctx.batch, dgraph.src.shape[0])
+        cap = self._traj_cap()
+        traj_bufs = None
+        if cap is not None:
+            dist, iters, improving, *traj_bufs = _fanout_kernel_traj(
+                sources, dgraph.src, dgraph.dst, dgraph.weights,
+                num_nodes=v, max_iter=ctx.max_iter, edge_chunk=chunk,
+                traj_cap=cap,
+            )
+            sm_fn, sm_kwargs = _fanout_kernel_traj, dict(
+                num_nodes=v, max_iter=ctx.max_iter, edge_chunk=chunk,
+                traj_cap=cap,
+            )
+        else:
+            dist, iters, improving = _fanout_kernel(
+                sources, dgraph.src, dgraph.dst, dgraph.weights,
+                num_nodes=v, max_iter=ctx.max_iter, edge_chunk=chunk,
+            )
+            sm_fn, sm_kwargs = _fanout_kernel, dict(
+                num_nodes=v, max_iter=ctx.max_iter, edge_chunk=chunk,
+            )
+        iters = int(iters)
+        cost = self._observe_cost(
+            "sweep-sm", sm_fn,
+            (sources, dgraph.src, dgraph.dst, dgraph.weights),
+            sm_kwargs,
+            dgraph, batch=ctx.batch,
+        )
+        return self._sweep_kernel_result(
+            dist, iters, improving, iters * ctx.batch, "sweep-sm",
+            cost, traj_bufs, dgraph, ctx.batch,
+        )
+
+    def _sweep_kernel_result(
+        self, dist, iters, improving, row_sweeps, route, cost,
+        traj_bufs, dgraph, batch,
+    ) -> KernelResult:
+        """Shared result assembly of the sweep-family plans.
+        Single-chip kernels iterate every row together, so iters x B is
+        exact; the sharded paths pass the psum'd per-shard total."""
+        res = KernelResult(
+            dist=dist,
+            converged=not bool(improving),
+            iterations=int(iters),
             edges_relaxed=int(row_sweeps) * dgraph.num_real_edges,
             route=route,
             cost=cost,
         )
         if traj_bufs:
-            self._attach_trajectory(
-                res, *traj_bufs, dgraph, batch=int(sources.shape[0])
-            )
+            self._attach_trajectory(res, *traj_bufs, dgraph, batch=batch)
         return res
 
     def _dw_multi_source(
@@ -2829,6 +2980,394 @@ class JaxBackend(Backend):
             route="batch-vmapped",
             cost=cost,
         )
+
+
+
+# -- the fan-out planner registry (ISSUE 14 tentpole) ------------------------
+#
+# Each kernel family declares a ``planner.Plan``: contract (the loud
+# forced-flag NotImplementedErrors), qualification (the same ``_use_*``
+# predicates the old ladder consulted, now data instead of branch
+# order), cost hook (the CostModel route tags), build, and failure
+# policy (warn-once-and-disable on auto, propagate when forced). The
+# declared priorities ARE the old ladder order, so with nothing priced
+# dispatch is bit-for-bit the pre-registry behavior; adding a route is
+# now one Plan entry, not another elif.
+
+
+@dataclasses.dataclass
+class _FanoutCtx:
+    """One fan-out dispatch's context (what plan hooks see)."""
+
+    backend: "JaxBackend"
+    dgraph: JaxDeviceGraph
+    sources: jax.Array
+    batch: int
+    max_iter: int
+    mesh: object
+    layout: str
+    params: dict = dataclasses.field(default_factory=dict)
+
+
+def _no_edges_axis(ctx) -> bool:
+    return "edges" not in ctx.mesh.axis_names
+
+
+def _single_device(ctx) -> bool:
+    return _no_edges_axis(ctx) and ctx.mesh.devices.size == 1
+
+
+def _contract_gs(ctx) -> None:
+    if "edges" in ctx.mesh.axis_names and ctx.backend.config.gauss_seidel is True:
+        # The GS layout is not edge-sharded: its sequential block
+        # schedule needs the whole edge list per device. Sources-only
+        # sharding composes; an edges axis does not.
+        raise NotImplementedError(
+            "gauss_seidel=True fan-out shards sources only; use a "
+            "1-D mesh_shape=(n,) (or leave gauss_seidel='auto' to "
+            "use the 2-D sharded sweep path on this mesh)"
+        )
+
+
+def _contract_dia(ctx) -> None:
+    if "edges" in ctx.mesh.axis_names and ctx.backend.config.dia is True:
+        # Same contract as gauss_seidel=True: the stencil needs
+        # every diagonal per device, so an edges axis cannot carry
+        # it — "True forces" must fail loud, not silently route a
+        # gather kernel.
+        raise NotImplementedError(
+            "dia=True fan-out shards sources only; use a 1-D "
+            "mesh_shape=(n,) (or leave dia='auto' to use the 2-D "
+            "sharded sweep path on this mesh)"
+        )
+
+
+def _contract_fw(ctx) -> None:
+    if ctx.backend.config.fw is True and (
+        "edges" in ctx.mesh.axis_names or ctx.mesh.devices.size > 1
+    ):
+        # The FW closure holds the whole [Vp, Vp] matrix on one chip;
+        # "True forces" must fail rather than silently route a
+        # sharded sweep.
+        raise NotImplementedError(
+            "fw=True is a single-chip dense route; use mesh_shape=(1,)"
+        )
+
+
+def _qual_dia(ctx):
+    if not _no_edges_axis(ctx):
+        return False, "mesh has an edges axis (stencil needs every diagonal per device)"
+    if ctx.backend._use_dia(ctx.dgraph):
+        return True, "diagonal labeling qualifies (gather-free stencil)"
+    return False, "dia gate declined (flag / platform / labeling)"
+
+
+def _qual_gs(ctx):
+    if not _no_edges_axis(ctx):
+        return False, "mesh has an edges axis (GS needs the whole edge list per device)"
+    if ctx.backend._use_gs(ctx.dgraph):
+        return True, "low-degree family on the GS platform gate"
+    return False, "gs gate declined (flag / platform / degree family)"
+
+
+def _qual_fw(ctx):
+    if not _single_device(ctx):
+        return False, "fw is a single-chip dense route"
+    if ctx.backend._use_fw(ctx.dgraph, ctx.batch):
+        return True, "squaring regime + density gate + exact-MAC win over squaring"
+    return False, "fw gate declined (regime / density / V threshold / MAC count)"
+
+
+def _qual_dw(ctx):
+    be = ctx.backend
+    if not _single_device(ctx):
+        return False, "dirty-window is a single-device route"
+    if be._use_dense(ctx.dgraph):
+        return False, "dense regime (dw targets the sparse batched sweep)"
+    if be._use_dw(ctx.dgraph, ctx.batch):
+        if be.config.dirty_window is True:
+            return True, "dirty_window=True forces (no evidence required)"
+        return True, be._dw_decision(ctx.dgraph, ctx.batch).get(
+            "reason", "trajectory evidence clears the dw thresholds"
+        )
+    flag = getattr(be.config, "dirty_window", "auto")
+    if flag is False or getattr(be, "_dw_disabled", False):
+        return False, "dirty_window disabled"
+    if ctx.dgraph.num_nodes == 0:
+        return False, "empty graph"
+    if ctx.dgraph.num_real_edges >= relax.FRONTIER_ADDEND_MAX:
+        return False, "split examined counter's full-sweep addend would wrap"
+    return False, be._dw_decision(ctx.dgraph, ctx.batch).get(
+        "reason", "no trajectory evidence"
+    )
+
+
+def _qual_sharded_2d(ctx):
+    if "edges" in ctx.mesh.axis_names:
+        return True, "2-D (sources, edges) mesh configured"
+    return False, "no edges mesh axis"
+
+
+def _qual_sharded_1d(ctx):
+    if _no_edges_axis(ctx) and ctx.mesh.devices.size > 1:
+        return True, f"{ctx.mesh.devices.size}-device sources mesh"
+    return False, "single device (or edges axis owns the mesh)"
+
+
+def _qual_dense(ctx):
+    if not _single_device(ctx):
+        return False, "dense min-plus is single-chip"
+    if ctx.backend._use_dense(ctx.dgraph):
+        return True, "graph clears the dense density + size gates"
+    return False, "not dense enough (or above dense_threshold)"
+
+
+def _qual_pallas_vm(ctx):
+    if not _single_device(ctx) or ctx.backend._use_dense(ctx.dgraph):
+        return False, "pallas sweep serves the single-chip sparse fan-out only"
+    if ctx.layout != "vertex_major":
+        return False, "pallas sweep needs the vertex-major layout"
+    if ctx.backend._pallas_mode()[0]:
+        return True, "use_pallas=True opt-in"
+    return False, "use_pallas is not forced (XLA routes are the measured winner)"
+
+
+def _qual_vm_blocked(ctx):
+    if not _single_device(ctx) or ctx.backend._use_dense(ctx.dgraph):
+        return False, "blocked vm serves the single-chip sparse fan-out only"
+    if ctx.layout != "vertex_major":
+        return False, "source-major layout configured"
+    if ctx.dgraph.num_nodes <= VM_BLOCK:
+        return False, f"V <= {VM_BLOCK} (plain full-V segments are already this small)"
+    if getattr(ctx.backend, "_vmb_disabled", False):
+        return False, "disabled after a prior failure on this backend instance"
+    return True, f"V > {VM_BLOCK}: [vb, B] segment writes beat [V, B]"
+
+
+def _qual_vm(ctx):
+    if not _single_device(ctx) or ctx.backend._use_dense(ctx.dgraph):
+        return False, "plain vm serves the single-chip sparse fan-out only"
+    if ctx.layout != "vertex_major":
+        return False, "source-major layout configured"
+    return True, "vertex-major sorted segment reduction (the measured default)"
+
+
+def _qual_sweep_sm(ctx):
+    if not _single_device(ctx) or ctx.backend._use_dense(ctx.dgraph):
+        return False, "source-major sweep serves the single-chip sparse fan-out only"
+    if ctx.layout != "vertex_major":
+        return True, "source-major layout configured"
+    if ctx.backend.config.fanout_layout == "auto":
+        # Under layout "auto" the scatter sweep stays QUALIFIED behind
+        # the vertex-major plans: priority preserves the measured
+        # default (vm wins ~3x on the CPU mesh), but a calibration
+        # that prices the scatter sweep cheaper for a shape can
+        # promote it — the layout choice is a planner decision, not a
+        # hard gate (ISSUE 14).
+        return True, (
+            "layout 'auto': behind vm by priority; promotable when "
+            "priced cheaper"
+        )
+    return False, "vertex-major layout forced by config"
+
+
+def _fail_dia(be, ctx) -> None:
+    be._auto_route_failed(
+        "_dia_disabled",
+        "dia stencil fan-out failed on this platform; "
+        "falling back to the gather routes for this "
+        "backend instance",
+        forced=be.config.dia is True,
+    )
+
+
+def _fail_gs(be, ctx) -> None:
+    be._gs_auto_failed(ctx.dgraph)  # re-raises when forced
+
+
+def _fail_fw(be, ctx) -> None:
+    be._auto_route_failed(
+        "_fw_disabled",
+        "blocked Floyd-Warshall route failed on this "
+        "platform; falling back to the dense/sparse routes "
+        "for this backend instance",
+        forced=be.config.fw is True,
+    )
+
+
+def _fail_dw(be, ctx) -> None:
+    be._auto_route_failed(
+        "_dw_disabled",
+        "dirty-window fan-out failed on this platform; "
+        "falling back to the sweep routes for this backend "
+        "instance",
+        forced=be.config.dirty_window is True,
+    )
+
+
+def _fail_vm_blocked(be, ctx) -> None:
+    be._auto_route_failed(
+        "_vmb_disabled",
+        "dst-blocked vm fan-out failed on this "
+        "platform; falling back to the plain vm "
+        "sweep for this backend instance",
+        forced=False,
+    )
+
+
+FANOUT_PLANS = [
+    planner.Plan(
+        name="dia", entry="fanout", priority=10,
+        qualify=_qual_dia, contract=_contract_dia,
+        build=lambda ctx: ctx.backend._plan_build_dia(ctx),
+        price_routes=("dia",),
+        forced=lambda cfg: cfg.dia is True,
+        failure=_fail_dia,
+        force_overrides={"dia": True},
+    ),
+    planner.Plan(
+        name="gs", entry="fanout", priority=20,
+        qualify=_qual_gs, contract=_contract_gs,
+        build=lambda ctx: ctx.backend._plan_build_gs(ctx),
+        price_routes=("gs", "gs+dw"),
+        forced=lambda cfg: cfg.gauss_seidel is True,
+        failure=_fail_gs,
+        force_overrides={"gauss_seidel": True},
+    ),
+    planner.Plan(
+        name="fw", entry="fanout", priority=30,
+        qualify=_qual_fw, contract=_contract_fw,
+        build=lambda ctx: ctx.backend._plan_build_fw(ctx),
+        price_routes=("fw", "fw-tile"),
+        forced=lambda cfg: cfg.fw is True,
+        failure=_fail_fw,
+        force_overrides={"fw": True, "mesh_shape": (1,)},
+    ),
+    planner.Plan(
+        name="vm-blocked+dw", entry="fanout", priority=40,
+        qualify=_qual_dw,
+        build=lambda ctx: ctx.backend._plan_build_dw(ctx),
+        price_routes=("vm-blocked+dw",),
+        forced=lambda cfg: cfg.dirty_window is True,
+        failure=_fail_dw,
+        force_overrides={"dirty_window": True},
+    ),
+    planner.Plan(
+        name="sharded-2d", entry="fanout", priority=50,
+        qualify=_qual_sharded_2d,
+        build=lambda ctx: ctx.backend._plan_build_sharded_2d(ctx),
+    ),
+    planner.Plan(
+        name="sharded-1d", entry="fanout", priority=60,
+        qualify=_qual_sharded_1d,
+        build=lambda ctx: ctx.backend._plan_build_sharded_1d(ctx),
+    ),
+    planner.Plan(
+        name="dense", entry="fanout", priority=70,
+        qualify=_qual_dense,
+        build=lambda ctx: ctx.backend._plan_build_dense(ctx),
+        price_routes=("dense-squaring", "dense-iterate"),
+        # fw=False keeps the higher-priority FW plan out of the way so
+        # "force dense" measures the iterate/squaring kernel itself.
+        force_overrides={"fw": False, "mesh_shape": (1,)},
+    ),
+    planner.Plan(
+        name="pallas-vm", entry="fanout", priority=80,
+        qualify=_qual_pallas_vm,
+        build=lambda ctx: ctx.backend._plan_build_pallas_vm(ctx),
+        price_routes=("pallas-vm",),
+        force_overrides={"use_pallas": True, "fanout_layout": "vertex_major"},
+    ),
+    planner.Plan(
+        name="vm-blocked", entry="fanout", priority=90,
+        qualify=_qual_vm_blocked,
+        build=lambda ctx: ctx.backend._plan_build_vm_blocked(ctx),
+        price_routes=("vm-blocked",),
+        failure=_fail_vm_blocked,
+        force_overrides={"fanout_layout": "vertex_major",
+                         "dirty_window": False},
+    ),
+    planner.Plan(
+        name="vm", entry="fanout", priority=100,
+        qualify=_qual_vm,
+        build=lambda ctx: ctx.backend._plan_build_vm(ctx),
+        price_routes=("vm",),
+        force_overrides={"fanout_layout": "vertex_major",
+                         "dirty_window": False},
+    ),
+    planner.Plan(
+        name="sweep-sm", entry="fanout", priority=110,
+        qualify=_qual_sweep_sm,
+        build=lambda ctx: ctx.backend._plan_build_sweep_sm(ctx),
+        price_routes=("sweep-sm",),
+        force_overrides={"fanout_layout": "source_major",
+                         "dirty_window": False},
+    ),
+]
+
+# The B=1 (SSSP) and solver-level families, declared for the same
+# registry so pricing, `cli info`, and the bench harness speak one
+# plan vocabulary. Their dispatch sites (``bellman_ford``'s chain and
+# ``ParallelJohnsonSolver._use_partitioned``) consult the SAME
+# predicates these qualifications wrap; converting those loops to the
+# select() walk is the registry's next increment (ROADMAP item 2
+# re-scope note).
+SSSP_PLANS = [
+    planner.Plan(
+        name="edge-sharded", entry="sssp", priority=10,
+        qualify=lambda ctx: (
+            (True, "edge list sharded over the mesh")
+            if ctx.backend._use_edge_shard(ctx.dgraph)
+            else (False, "single device or frontier-family graph")
+        ),
+        forced=lambda cfg: cfg.edge_shard is True,
+    ),
+    planner.Plan(
+        name="dia", entry="sssp", priority=20,
+        qualify=lambda ctx: (
+            (True, "diagonal labeling qualifies")
+            if ctx.backend._use_dia(ctx.dgraph)
+            else (False, "dia gate declined")
+        ),
+        price_routes=("dia",),
+        forced=lambda cfg: cfg.dia is True,
+    ),
+    planner.Plan(
+        name="bucket", entry="sssp", priority=30,
+        qualify=lambda ctx: (
+            (True, "irregular low-degree family where DIA declines")
+            if ctx.backend._use_bucket(ctx.dgraph)
+            else (False, "bucket gate declined")
+        ),
+        price_routes=("bucket", "bucket+sweep"),
+        forced=lambda cfg: cfg.bucket is True,
+    ),
+    planner.Plan(
+        name="gs", entry="sssp", priority=40,
+        qualify=lambda ctx: (
+            (True, "low-degree family on the GS platform gate")
+            if ctx.backend._use_gs(ctx.dgraph)
+            else (False, "gs gate declined")
+        ),
+        price_routes=("gs", "gs+dw"),
+        forced=lambda cfg: cfg.gauss_seidel is True,
+    ),
+    planner.Plan(
+        name="frontier", entry="sssp", priority=50,
+        qualify=lambda ctx: (
+            (True, "low-degree family (compacted frontier)")
+            if ctx.backend._use_frontier(ctx.dgraph)
+            else (False, "frontier gate declined")
+        ),
+        price_routes=("frontier",),
+        forced=lambda cfg: cfg.frontier is True,
+    ),
+    planner.Plan(
+        name="sweep", entry="sssp", priority=60,
+        qualify=lambda ctx: (True, "unconditional full-sweep fallback"),
+        price_routes=("sweep",),
+    ),
+]
 
 
 register_backend("jax", JaxBackend)

@@ -89,6 +89,10 @@ _SIZES = {
     "dirty_window": dict(rows=24,      mini_rows=48,     full_rows=96,
                           sources=2,   mini_sources=4,   full_sources=4,
                           rscale=8,    mini_rscale=9,    full_rscale=12),
+    "planner_dispatch": dict(rows=16,  mini_rows=32,     full_rows=96,
+                          rscale=7,    mini_rscale=9,    full_rscale=12,
+                          dense_n=64,  mini_dense_n=128, full_dense_n=256,
+                          sources=4,   mini_sources=4,   full_sources=8),
     "serve_queries": dict(n=256,       mini_n=1024,      full_n=4096,
                           queries=200, mini_queries=2000, full_queries=20000,
                           clients=4,   mini_clients=4,   full_clients=8),
@@ -523,6 +527,181 @@ def bench_dense_apsp_fw(backend: str, preset: str) -> BenchRecord:
         "dense_apsp_fw", backend, preset, wall,
         res.stats.edges_relaxed, res.stats.edges_relaxed / wall, _n_chips(),
         detail,
+    )
+
+
+def bench_planner_dispatch(backend: str, preset: str) -> BenchRecord:
+    """Config 13 (ISSUE 14 tentpole): does the priced planner pick the
+    measured-fastest qualified route? Three contrasting graphs —
+    scrambled road grid (irregular low-degree sweep territory), R-MAT
+    power-law (hub-heavy sweep territory), and a dense small-V graph
+    (dense/FW territory). Per graph:
+
+    1. every candidate plan is FORCED via its registry
+       ``force_overrides`` and measured on the same sources, its solve
+       + plan records landing in a fresh throwaway profile store (the
+       calibration the planner will price from);
+    2. the auto planner then dispatches the same solve; the row's
+       detail records the pick, the measured-fastest auto-qualified
+       plan, whether the pick is the fastest or within the planner's
+       noise band of it (the acceptance criterion), and that the
+       planner solve's distances are BITWISE-identical to the forced
+       run of the same plan (registry dispatch never changes a
+       route's arithmetic).
+
+    Non-jax backends have no planner registry; their row records the
+    plain solve with an explicit marker."""
+    import tempfile
+
+    from paralleljohnson_tpu.graphs import (
+        erdos_renyi,
+        grid2d,
+        permute_labels,
+        rmat,
+    )
+
+    rows = _sz("planner_dispatch", "rows", preset)
+    rscale = _sz("planner_dispatch", "rscale", preset)
+    dense_n = _sz("planner_dispatch", "dense_n", preset)
+    n_sources = _sz("planner_dispatch", "sources", preset)
+
+    grid = permute_labels(
+        grid2d(rows, rows, negative_fraction=0.0, seed=7), seed=11
+    )
+    power = rmat(rscale, edge_factor=8, seed=5)
+    dense = erdos_renyi(dense_n, 0.5, seed=3)
+    # smoke keeps the candidate sets lean (every forced plan pays its
+    # compiles — the CI suite-budget); mini/full measure the full
+    # contrast set including the dw and GS schedules.
+    grid_plans = (
+        ["vm", "sweep-sm"] if preset == "smoke"
+        else ["vm", "sweep-sm", "vm-blocked+dw", "gs"]
+    )
+    workloads = [
+        # (name, graph, batch, candidate plan names to force-measure)
+        ("scrambled_grid", grid, n_sources, grid_plans),
+        ("rmat", power, n_sources, ["vm", "sweep-sm"]),
+        ("dense_small_v", dense, dense.num_nodes, ["dense", "fw"]),
+    ]
+
+    if backend != "jax":
+        t0 = time.perf_counter()
+        res = _solver(backend).multi_source(
+            grid, np.arange(n_sources, dtype=np.int64)
+        )
+        wall = time.perf_counter() - t0
+        return BenchRecord(
+            "planner_dispatch", backend, preset, wall,
+            res.stats.edges_relaxed, res.stats.edges_relaxed / wall,
+            _n_chips(),
+            {"skipped": "planner registry is jax-only; plain solve "
+                        "recorded", **_routes(res)},
+        )
+
+    from paralleljohnson_tpu.backends.jax_backend import FANOUT_PLANS
+    from paralleljohnson_tpu.planner import PLANNER_NOISE_BAND
+
+    plan_by_name = {p.name: p for p in FANOUT_PLANS}
+    per_graph = {}
+    total_wall = 0.0
+    total_edges = 0
+    headline_res = None
+    for name, g, b, candidates in workloads:
+        store = tempfile.mkdtemp(prefix=f"pj_planner_{name}_")
+        sources = np.arange(min(b, g.num_nodes), dtype=np.int64)
+        measured, dists, skipped = {}, {}, {}
+        for plan_name in candidates:
+            plan = plan_by_name[plan_name]
+            overrides = dict(plan.force_overrides)
+            try:
+                forced = _solver(
+                    backend, profile_store=store, planner=False,
+                    **overrides,
+                )
+                forced.multi_source(g, sources)  # warm compiles
+                t0 = time.perf_counter()
+                fres = forced.multi_source(g, sources)
+                dt = time.perf_counter() - t0
+            except Exception as e:  # noqa: BLE001 — a declined plan is data
+                skipped[plan_name] = f"{type(e).__name__}: {e}"
+                continue
+            measured[plan_name] = {
+                "route": fres.stats.routes_by_phase.get("fanout"),
+                "wall_ms": round(dt * 1e3, 3),
+                "wall_s": dt,
+            }
+            dists[plan_name] = np.asarray(fres.dist)
+        # All plans solve the same problem: any pairwise disagreement
+        # beyond float-order noise is a dispatch bug, not noise.
+        names = sorted(dists)
+        agree = all(
+            np.allclose(dists[names[0]], dists[m],
+                        rtol=1e-5, atol=1e-5, equal_nan=True)
+            for m in names[1:]
+        )
+        auto = _solver(backend, profile_store=store)
+        auto.multi_source(g, sources)  # warm (also lands records)
+        t0 = time.perf_counter()
+        res = auto.multi_source(g, sources)
+        dt = time.perf_counter() - t0
+        plan_info = res.stats.plan or {}
+        pick = plan_info.get("built") or plan_info.get("chosen")
+        qualified = [
+            c["plan"] for c in plan_info.get("candidates", [])
+            if c.get("qualified")
+        ]
+        contest = {
+            k: v["wall_s"] for k, v in measured.items() if k in qualified
+        }
+        fastest = min(contest, key=contest.get) if contest else None
+        within = (
+            contest[pick] <= contest[fastest] * (1.0 + PLANNER_NOISE_BAND)
+            if pick in contest and fastest is not None else None
+        )
+        bitwise = (
+            bool(np.array_equal(np.asarray(res.dist), dists[pick],
+                                equal_nan=True))
+            if pick in dists else None
+        )
+        per_graph[name] = {
+            "nodes": g.num_nodes,
+            "edges": g.num_real_edges,
+            "batch": int(len(sources)),
+            "measured": {
+                k: {kk: vv for kk, vv in v.items() if kk != "wall_s"}
+                for k, v in measured.items()
+            },
+            "skipped": skipped,
+            "pick": pick,
+            "reason": plan_info.get("reason"),
+            "qualified": qualified,
+            "fastest_qualified": fastest,
+            "pick_within_band": within,
+            "pick_bitwise_vs_forced": bitwise,
+            "routes_agree": bool(agree),
+            "planner_wall_ms": round(dt * 1e3, 3),
+        }
+        total_wall += dt
+        total_edges += res.stats.edges_relaxed
+        headline_res = res
+    verdict = {
+        "all_within_band": all(
+            v["pick_within_band"] in (True, None)
+            for v in per_graph.values()
+        ),
+        "all_bitwise": all(
+            v["pick_bitwise_vs_forced"] in (True, None)
+            for v in per_graph.values()
+        ),
+        "all_routes_agree": all(
+            v["routes_agree"] for v in per_graph.values()
+        ),
+    }
+    return BenchRecord(
+        "planner_dispatch", backend, preset, total_wall,
+        total_edges, total_edges / max(total_wall, 1e-9), _n_chips(),
+        {"noise_band": PLANNER_NOISE_BAND, **verdict,
+         "graphs": per_graph, **_routes(headline_res)},
     )
 
 
@@ -1016,6 +1195,7 @@ CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "batch_small": bench_batch_small,
     "dense_apsp_fw": bench_dense_apsp_fw,
     "dirty_window": bench_dirty_window,
+    "planner_dispatch": bench_planner_dispatch,
     "serve_queries": bench_serve_queries,
     "distributed_fleet": bench_distributed_fleet,
     "incremental_update": bench_incremental_update,

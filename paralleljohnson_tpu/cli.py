@@ -83,7 +83,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--fw-threshold", type=int, default=1 << 14,
                    help="max V the blocked-FW dense route accepts "
                         "(a [V, V] f32 closure is 1 GB at 2^14)")
-    p.add_argument("--fw-tile", type=int, default=512,
+    p.add_argument("--fw-tile", type=int, default=None,
                    help="FW tile edge (multiple of 128; 512 default — the "
                         "first 128-multiple whose t/8 flop/byte trailing "
                         "intensity clears the TPU roofline ridge)")
@@ -108,6 +108,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "ONLY when the profile store's trajectory "
                         "record for this graph shape shows a "
                         "collapsing frontier (never blindly)")
+    p.add_argument("--planner", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="priced dispatch registry (README 'Self-driving "
+                        "dispatch'): auto/true promote a cheaper "
+                        "qualified plan above the priority incumbent "
+                        "when the profile store's CostModel prices BOTH "
+                        "beyond the noise band (forced route flags "
+                        "always win); false = pure declared priority "
+                        "(the pre-registry ladder order)")
     p.add_argument("--dw-block", type=int, default=None,
                    help="vertices per dirty-window activity bit "
                         "(default: the measured-best fine granularity)")
@@ -130,12 +139,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "(otherwise the original uninstrumented kernels "
                         "compile — identical jaxpr)")
     p.add_argument("--checkpoint-dir", default=None)
-    p.add_argument("--pipeline-depth", type=int, default=2,
+    p.add_argument("--pipeline-depth", type=int, default=None,
                    help="max fan-out batches in flight (double-buffered "
                         "pipeline: batch k's row download + checkpoint "
                         "write run behind batch k+1's device compute; "
                         "each extra slot carries one more [B, V] block "
-                        "in device memory); 1 = strictly serial")
+                        "in device memory); 1 = strictly serial; "
+                        "default auto = profile-tuned per (platform, "
+                        "shape bucket), else 2")
     p.add_argument("--compilation-cache-dir", default=None, metavar="DIR",
                    help="persistent JAX compilation cache directory so "
                         "re-runs skip Mosaic/XLA compiles (default: "
@@ -271,6 +282,7 @@ def _config(args) -> "SolverConfig":
         retry_attempts=args.retry_attempts,
         stage_deadline_s=args.stage_deadline,
         min_source_batch=args.min_source_batch,
+        planner=tristate[args.planner],
         profile_store=args.profile_store,
         convergence=tristate[args.convergence],
         telemetry=_telemetry(args, args.command),
@@ -349,6 +361,23 @@ def _report(res, args) -> None:
                     f"  cost model: predicted {s.predicted_s * 1e3:.2f} ms"
                     f" vs measured {s.compute_seconds * 1e3:.2f} ms compute"
                 )
+        # Planner decision (ISSUE 14): chosen plan + why-line, and
+        # any profile-tuned parameters the solve resolved.
+        plan = getattr(s, "plan", None)
+        if plan:
+            line = f"  plan: {plan.get('built') or plan.get('chosen')}"
+            if plan.get("degraded"):
+                line += f" (degraded from {plan.get('chosen')})"
+            if plan.get("reason"):
+                line += f" — {plan['reason']}"
+            print(line)
+            params = plan.get("params") or {}
+            shown = {k: v for k, v in params.items()
+                     if not k.endswith("_source")}
+            if shown:
+                print("  plan params: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(shown.items())
+                ))
         # Convergence-observatory summary (ISSUE 9) — one line per
         # instrumented phase when the trajectory was recorded (off by
         # default; a plain solve stays quiet).
@@ -883,7 +912,12 @@ def main(argv: list[str] | None = None) -> int:
             # execution"): per-solve download_s / ckpt_wait_s /
             # overlap_saved_s prove the overlap in the stats output.
             "pipeline": {
-                "pipeline_depth": _dc.pipeline_depth,
+                "pipeline_depth": _dc.pipeline_depth or 2,
+                "pipeline_depth_auto": (
+                    "None = auto: profile-tuned per (platform, shape "
+                    "bucket) when the store has measured alternatives, "
+                    "else 2 (observe.tuning)"
+                ),
                 "compilation_cache_dir": _dc.compilation_cache_dir,
                 "compilation_cache_env": "PJ_COMPILE_CACHE",
                 "overlap": (
@@ -985,6 +1019,60 @@ def main(argv: list[str] | None = None) -> int:
                 ),
                 "evidence": "bench_artifacts/dw_offchip_validation.md",
             },
+            # Self-driving dispatch (README "Self-driving dispatch",
+            # ISSUE 14): the priced planner registry + the
+            # profile-calibrated auto-tuned parameters.
+            "planner": {
+                "flags": {
+                    "--planner": (
+                        "auto/true: promote a cheaper qualified plan "
+                        "above the priority incumbent when the profile "
+                        "store's CostModel prices BOTH beyond the noise "
+                        "band; false: pure declared priority (the "
+                        "pre-registry ladder order). Forced route flags "
+                        "(--fw/--dia/--gauss-seidel/--bucket/"
+                        "--dirty-window true) are qualification "
+                        "overrides: the forced plan is pinned first and "
+                        "its mesh contracts still fail loud"
+                    ),
+                },
+                "registry": (
+                    "each kernel family declares a Plan (contract, "
+                    "qualification predicate, cost hook, build, "
+                    "failure policy) in paralleljohnson_tpu.planner; "
+                    "dispatch picks the cheapest qualified plan and "
+                    "degrades down the ranking instead of crashing"
+                ),
+                "noise_band": 0.25,
+                "auto_tuned_parameters": {
+                    "fw_tile": "hand-tuned fallback 512 (roofline)",
+                    "partition_parts": (
+                        "hand-tuned fallback ~sqrt(V)/8, clamp [2, 32]"
+                    ),
+                    "delta": (
+                        "hand-tuned fallback: mean |w| x degree "
+                        "heuristic (ops.bucket.auto_delta)"
+                    ),
+                    "source_batch": (
+                        "hand-tuned fallback: device-memory budget "
+                        "(suggested_source_batch); tuned values stay "
+                        "capped by the budget"
+                    ),
+                    "pipeline_depth": "hand-tuned fallback 2",
+                },
+                "tuning": (
+                    "per (platform, shape bucket) from the profile "
+                    "store's kind='plan' records: the value with the "
+                    "lowest recorded wall wins once >= 2 distinct "
+                    "values were measured; an empty store always "
+                    "resolves the hand-tuned constants; explicit "
+                    "config values always win (observe.tuning)"
+                ),
+                "records": "kind='plan' rows in profiles.jsonl "
+                           "(chosen plan + why-line + candidates with "
+                           "explicit unpriced markers + resolved "
+                           "params + measured wall)",
+            },
         }
         # Priced route table from the persisted calibration — the
         # preview the planned dispatch registry (ROADMAP item 7) will
@@ -1006,7 +1094,19 @@ def main(argv: list[str] | None = None) -> int:
                 _model = CostModel.fit(_store)
                 info["cost_observatory"]["store"] = str(_store.path)
                 info["cost_observatory"]["records"] = len(_store.records())
-                info["cost_observatory"]["priced_routes"] = _model.table()
+                _table = _model.table()
+                # Explicit unpriced markers (ISSUE 14 satellite): every
+                # registry route with no profile samples appears, never
+                # silently omitted — "cheap" and "unmeasured" must stay
+                # distinguishable.
+                from paralleljohnson_tpu.planner import KNOWN_ROUTES
+
+                _priced_names = {e["route"] for e in _table}
+                _table.extend(
+                    {"route": r, "platform": None, "unpriced": True}
+                    for r in KNOWN_ROUTES if r not in _priced_names
+                )
+                info["cost_observatory"]["priced_routes"] = _table
             except Exception as e:  # noqa: BLE001 — report, don't die
                 info["cost_observatory"]["store_error"] = (
                     f"{type(e).__name__}: {e}"
@@ -1100,6 +1200,18 @@ def main(argv: list[str] | None = None) -> int:
             }
             from paralleljohnson_tpu.solver import ParallelJohnsonSolver
 
+            # Planner preview (ISSUE 14 satellite): the decision the
+            # registry would make for this graph at the fan-out width —
+            # chosen plan + why-line + candidate table (with explicit
+            # unpriced markers), no kernel built.
+            try:
+                info["graph"]["plan"] = be.plan_preview(
+                    dg, min(128, max(g.num_nodes, 1))
+                )
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                info["graph"]["plan"] = {
+                    "error": f"{type(e).__name__}: {e}"
+                }
             info["graph"]["routes"]["partitioned"] = bool(
                 ParallelJohnsonSolver(
                     SolverConfig(), backend=be

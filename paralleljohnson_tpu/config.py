@@ -98,8 +98,10 @@ class SolverConfig:
         frontier/gauss_seidel/dia route beats bucket="auto". True
         forces (including the virtual-source pass, which degrades to
         full sweeps via the overflow fallback); False disables.
-      delta: bucket width of the ``bucket`` route; ``None`` auto-tunes
-        from mean |edge weight| x an average-degree heuristic
+      delta: bucket width of the ``bucket`` route; ``None`` = auto:
+        the profile-tuned width for this (platform, shape bucket)
+        when the store has measured alternatives (``observe.tuning``),
+        else the mean |edge weight| x average-degree heuristic
         (``ops.bucket.auto_delta``). Any value > 0 is correct — the
         width only trades inner re-relaxation against bucket count.
       dia_max_offsets: max distinct (dst - src) diagonals the DIA
@@ -133,11 +135,14 @@ class SolverConfig:
       fw_threshold: max V the FW route accepts (default 2^14 — a
         [V, V] f32 closure is 1 GB there; beyond it the partitioned
         condensed route is the dense-core escape hatch).
-      fw_tile: FW tile edge, a multiple of 128 (default 512: the first
+      fw_tile: FW tile edge, a multiple of 128. ``None`` (the default)
+        = auto: the profile-tuned value for this (platform, shape
+        bucket) when the store has measured alternatives
+        (``observe.tuning``), else the hand-tuned 512 — the first
         128-multiple whose trailing-update arithmetic intensity, t/8
-        flop/byte, clears the v4-class roofline ridge — see ``ops.fw``).
+        flop/byte, clears the v4-class roofline ridge (``ops.fw``).
         Graphs smaller than the tile shrink it to their own 128-padded
-        size instead of padding up.
+        size instead of padding up. An explicit value always wins.
       partitioned: condense-solve-expand partitioned APSP route
         (``solver.partitioned``, route tag ``condensed+fw``): partition
         the vertices around seeded pivots (the ``serve.landmarks`` pivot
@@ -156,7 +161,9 @@ class SolverConfig:
         detected exactly (local and core closures jointly cover every
         cycle).
       partition_parts: partition count of the ``partitioned`` route;
-        None auto-sizes from V (~sqrt(V)/8, clamped to [2, 32]).
+        None = auto: profile-tuned per (platform, shape bucket) when
+        the store has measured alternatives (``observe.tuning``),
+        else ~sqrt(V)/8 clamped to [2, 32].
       dirty_window: dirty-window compacted relaxation (ISSUE 13, route
         tag ``vm-blocked+dw``; README "Dirty-window compaction"): the
         fan-out carries per-destination-block activity bitmaps in the
@@ -207,7 +214,10 @@ class SolverConfig:
         pipeline — batch k's D2H row download + checkpoint serialization
         run on a background stage while batch k+1's device compute
         proceeds, so the multi-GB transfers and fsyncs of RMAT-22-class
-        solves leave the critical path. Each extra slot carries one more
+        solves leave the critical path. ``None`` (the default) = auto:
+        the profile-tuned depth for this (platform, shape bucket) when
+        the store has measured alternatives (``observe.tuning``), else
+        the hand-tuned 2. Each extra slot carries one more
         computed-but-unmaterialized [B, V] block in device memory
         (``suggested_source_batch`` budgets the carry); on device OOM the
         window collapses to 1 BEFORE the batch is halved. 1 = the
@@ -235,6 +245,19 @@ class SolverConfig:
         deterministic failures into solve stages — the harness tier-1
         CPU tests use to exercise every retry/degrade/resume path
         without a TPU. Production solves leave it None.
+      planner: the priced dispatch registry's promotion switch
+        (ISSUE 14, ``paralleljohnson_tpu.planner``). ``"auto"`` (the
+        default): when a profile store is configured AND its CostModel
+        prices both the ladder-priority incumbent and a cheaper
+        qualified challenger (beyond the planner noise band), dispatch
+        promotes the cheaper plan; with no store, or nothing priced,
+        the declared plan priorities reproduce the pre-registry ladder
+        exactly. ``False`` disables priced promotion entirely (pure
+        declared priority). ``True`` behaves like "auto" (the flag
+        exists so scripts can pin semantics against future default
+        changes). Forced route flags (``fw=True``, ``dia=True``, ...)
+        override the pricing either way — a forced plan is pinned
+        first and its contract failures stay loud.
       profile_store: cost-observatory profile-store directory (ISSUE 7,
         ``paralleljohnson_tpu/observe``). When set (or via the
         ``PJ_PROFILE_DIR`` env var), the jax backend harvests XLA's
@@ -305,7 +328,7 @@ class SolverConfig:
     gs_inner_cap: int = 64
     fw: bool | str = "auto"
     fw_threshold: int = 1 << 14
-    fw_tile: int = 512
+    fw_tile: int | None = None
     partitioned: bool | str = "auto"
     partition_parts: int | None = None
     dirty_window: bool | str = "auto"
@@ -313,7 +336,7 @@ class SolverConfig:
     pred_extraction: bool | str = "auto"
     edge_shard: bool | str = "auto"
     checkpoint_dir: str | None = None
-    pipeline_depth: int = 2
+    pipeline_depth: int | None = None
     compilation_cache_dir: str | None = None
     validate: bool = False
     retry_attempts: int = 3
@@ -321,6 +344,7 @@ class SolverConfig:
     stage_deadline_s: float | None = None
     min_source_batch: int = 8
     fault_plan: object | None = None
+    planner: bool | str = "auto"
     profile_store: str | None = None
     convergence: bool | str = "auto"
     telemetry: object | None = None
@@ -371,7 +395,9 @@ class SolverConfig:
             raise ValueError(
                 f"fw_threshold must be >= 0, got {self.fw_threshold}"
             )
-        if self.fw_tile < 128 or self.fw_tile % 128:
+        if self.fw_tile is not None and (
+            self.fw_tile < 128 or self.fw_tile % 128
+        ):
             raise ValueError(
                 "fw_tile must be a multiple of 128 (the TPU lane width), "
                 f"got {self.fw_tile}"
@@ -449,9 +475,13 @@ class SolverConfig:
             raise ValueError(
                 f"min_source_batch must be >= 1, got {self.min_source_batch}"
             )
-        if self.pipeline_depth < 1:
+        if self.pipeline_depth is not None and self.pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth must be >= 1, got {self.pipeline_depth}"
+            )
+        if self.planner not in (True, False, "auto"):
+            raise ValueError(
+                f"planner must be True/False/'auto', got {self.planner!r}"
             )
         if self.convergence not in (True, False, "auto"):
             raise ValueError(

@@ -86,6 +86,14 @@ from paralleljohnson_tpu.observe.store import (  # noqa: F401
     ProfileStore,
     solve_record,
 )
+from paralleljohnson_tpu.observe.tuning import (  # noqa: F401
+    DEFAULT_FW_TILE,
+    DEFAULT_PIPELINE_DEPTH,
+    TUNABLE_PARAMS,
+    cached_records,
+    resolve_param,
+    tuned_value,
+)
 
 
 def current_platform() -> str:
@@ -147,6 +155,45 @@ def finalize_solve(
         )
         if pred is not None:
             stats.predicted_s = pred["predicted_s"]
+    # Planner decision record (ISSUE 14): one ``kind: "plan"`` line per
+    # solve whose dispatch went through the registry — carries the
+    # chosen plan + why-line + candidate table + the RESOLVED
+    # auto-tuned parameters, with the measured wall beside them so
+    # ``bench_regress.py`` can flag a planner that starts picking
+    # slower routes and ``observe.tuning`` can compare parameter
+    # alternatives.
+    decision = getattr(stats, "plan", None)
+    if decision:
+        from paralleljohnson_tpu.planner import plan_record
+
+        decision = dict(decision)
+        params = dict(decision.get("params") or {})
+        if getattr(stats, "final_batch", None):
+            params.setdefault("source_batch", int(stats.final_batch))
+        if getattr(stats, "final_pipeline_depth", None):
+            params.setdefault(
+                "pipeline_depth", int(stats.final_pipeline_depth)
+            )
+        decision["params"] = params
+        stats.plan = decision
+        phase_seconds = dict(getattr(stats, "phase_seconds", {}) or {})
+        store.append(
+            plan_record(
+                decision,
+                label=label,
+                platform=platform,
+                num_nodes=num_nodes,
+                num_edges=num_edges,
+                batch=batch,
+                wall_s=float(sum(phase_seconds.values())),
+                compute_s=float(
+                    sum(
+                        s for k, s in phase_seconds.items()
+                        if k in ("bellman_ford", "fanout", "batch_apsp")
+                    )
+                ),
+            )
+        )
     store.append(
         solve_record(
             stats,

@@ -141,16 +141,60 @@ def _driver_metric_rows(obj: dict, source: str | None) -> list[dict]:
     }]
 
 
+def _pow2_up(n) -> int:
+    n = int(n or 0)
+    return 1 << max(0, (n - 1).bit_length()) if n > 0 else 0
+
+
+def _planner_rows(obj: dict, source: str | None) -> list[dict]:
+    """Rows from ``kind: "plan"`` profile records (ISSUE 14): the
+    planner's per-solve decision + measured wall, keyed by the solve's
+    pow2 shape bucket. Re-ingesting the same profiles.jsonl is
+    idempotent (the ts-ignored dedup in ``BenchHistory.append``), and a
+    planner that starts picking a slower route for a shape it used to
+    serve faster flags as an ordinary wall regression against that
+    bucket's history — with the chosen plan + why-line in the flag's
+    detail, so the regression arrives pre-attributed to a dispatch
+    decision, not just a slow kernel."""
+    measured = obj.get("measured") or {}
+    wall = measured.get("wall_s") or measured.get("compute_s")
+    if not isinstance(wall, (int, float)) or wall <= 0:
+        return []
+    bench = (
+        f"planner:V{_pow2_up(obj.get('nodes'))}"
+        f":E{_pow2_up(obj.get('edges'))}"
+        f":B{_pow2_up(obj.get('batch'))}"
+    )
+    return [{
+        "bench": bench,
+        "backend": "jax",
+        "platform": obj.get("platform", "unknown"),
+        "preset": obj.get("label"),
+        "wall_s": float(wall),
+        "detail": {
+            "route": obj.get("route"),
+            "chosen": obj.get("chosen"),
+            "reason": obj.get("reason"),
+            "params": obj.get("params") or {},
+            "degraded": bool(obj.get("degraded")),
+        },
+        "source": source,
+    }]
+
+
 def normalize_record(obj: dict, *, source: str | None = None) -> list[dict]:
     """Normalize ONE parsed measurement object into history rows.
 
     Accepted shapes: an already-normalized row (has bench + wall_s);
     a ``pjtpu bench`` BenchRecord line (config/backend/preset/wall_s);
     a driver metric payload (metric/value/detail); the committed
-    ``BENCH_r0*.json`` wrapper (its ``parsed`` field is the payload).
+    ``BENCH_r0*.json`` wrapper (its ``parsed`` field is the payload);
+    a profile store's ``kind: "plan"`` planner-decision record.
     Unrecognized objects yield [] — ingestion skips, never crashes."""
     if not isinstance(obj, dict):
         return []
+    if obj.get("kind") == "plan":
+        return _planner_rows(obj, source)
     if "bench" in obj and "wall_s" in obj:
         row = dict(obj)
         row.setdefault("source", source)

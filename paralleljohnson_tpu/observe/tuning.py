@@ -1,0 +1,188 @@
+"""Profile-calibrated auto-tuning of the dispatch free parameters
+(ISSUE 14 tentpole, second half).
+
+Five knobs used to be hand-tuned constants buried in five different
+modules:
+
+====================  =========================  =======================
+parameter             hand-tuned fallback        consumed by
+====================  =========================  =======================
+``fw_tile``           512 (roofline-picked)      ``ops.fw`` closure,
+                                                 ``solver.partitioned``
+``partition_parts``   ~sqrt(V)/8, clamp [2,32]   ``solver.partitioned``
+``delta``             mean|w| x degree heuristic ``ops.bucket`` route
+``source_batch``      device-memory budget       solver fan-out batching
+``pipeline_depth``    2 (double buffering)       solver pipeline window
+====================  =========================  =======================
+
+This module converts them into one calibration loop: every solve whose
+dispatch went through the planner registry lands a ``kind: "plan"``
+profile record carrying the RESOLVED parameter values plus the
+measured wall (``planner.plan_record``). :func:`tuned_value` reads
+those records back per ``(platform, shape bucket)`` and picks the
+parameter value whose best recorded wall is lowest — so an explicit
+``--fw-tile 256`` run that measures faster than the 512 default
+becomes the auto default for that platform/shape from then on.
+
+Honesty rules:
+
+- **empty store → hand-tuned constant**, always (the acceptance
+  contract): with no records, or records for only ONE observed value,
+  there is nothing to compare and the fallback stands — a single
+  sample proves nothing about the alternatives;
+- values are only compared WITHIN a (platform, V-bucket, E-bucket)
+  key — a tile that wins on a dense 2^11 closure says nothing about a
+  2^14 one;
+- an explicit config value always wins over the tuner (set the knob,
+  get the knob), and the resolution source ("config" /
+  "profile-tuned" / "default") rides on every plan record and
+  why-line so a surprising value is attributable.
+
+Stdlib-only (the ``observe`` discipline).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+# The hand-tuned constants the tuner falls back to (single source of
+# truth — config.py and the resolution sites import from here).
+DEFAULT_FW_TILE = 512
+DEFAULT_PIPELINE_DEPTH = 2
+
+# The tunable-parameter vocabulary plan records carry.
+TUNABLE_PARAMS = (
+    "fw_tile", "partition_parts", "delta", "source_batch",
+    "pipeline_depth",
+)
+
+# A value needs at least this many distinct observed alternatives in
+# the key before the tuner overrides the hand-tuned constant: one
+# observed value has nothing to beat.
+MIN_DISTINCT_VALUES = 2
+
+# records cache keyed by (path, mtime_ns, size) — the store is
+# append-only and finalize_solve appends AFTER a solve completes, so
+# one solve's many batches re-read the file at most once.
+_CACHE: dict = {}
+
+
+def cached_records(store_dir: str | Path | None) -> list[dict]:
+    if store_dir is None:
+        return []
+    from paralleljohnson_tpu.observe.store import PROFILE_FILENAME
+
+    path = Path(store_dir) / PROFILE_FILENAME
+    try:
+        st = path.stat()
+    except OSError:
+        return []
+    key = (str(path), st.st_mtime_ns, st.st_size)
+    hit = _CACHE.get(str(path))
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    from paralleljohnson_tpu.observe.store import ProfileStore
+
+    try:
+        records = ProfileStore(store_dir).records()
+    except ValueError:
+        # A corrupt store must not crash dispatch; the solve record
+        # writer will surface the corruption on its own append path.
+        records = []
+    _CACHE.clear()  # one store per process in practice; stay bounded
+    _CACHE[str(path)] = (key, records)
+    return records
+
+
+def _bucket(num_nodes: int, num_edges: int) -> tuple[int, int]:
+    from paralleljohnson_tpu.observe.costs import shape_bucket
+
+    return shape_bucket(num_nodes, num_edges, 1)[:2]
+
+
+def tuned_value(
+    name: str,
+    *,
+    records=None,
+    store_dir: str | Path | None = None,
+    platform: str,
+    num_nodes: int,
+    num_edges: int,
+    validate=None,
+):
+    """The profile-tuned value of ``name`` for this (platform, shape
+    bucket), or None when the store holds nothing decisive (see module
+    docstring). ``validate`` filters candidate values (e.g. fw tiles
+    must be 128-multiples)."""
+    if name not in TUNABLE_PARAMS:
+        raise ValueError(
+            f"unknown tunable parameter {name!r}; expected one of "
+            f"{TUNABLE_PARAMS}"
+        )
+    if records is None:
+        records = cached_records(store_dir)
+    if not records:
+        return None
+    want = _bucket(num_nodes, num_edges)
+    best_wall: dict = {}
+    for r in records:
+        if r.get("kind") != "plan":
+            continue
+        if r.get("platform") != platform:
+            continue
+        if _bucket(r.get("nodes") or 0, r.get("edges") or 0) != want:
+            continue
+        value = (r.get("params") or {}).get(name)
+        if value is None:
+            continue
+        if validate is not None and not validate(value):
+            continue
+        measured = r.get("measured") or {}
+        wall = measured.get("compute_s") or measured.get("wall_s")
+        if not isinstance(wall, (int, float)) or wall <= 0:
+            continue
+        # Min-of-samples per value: timing noise only inflates (the
+        # CostModel rationale), so the best recorded wall is the
+        # steady-state cost of running with that value.
+        key = value
+        if key not in best_wall or wall < best_wall[key]:
+            best_wall[key] = wall
+    if len(best_wall) < MIN_DISTINCT_VALUES:
+        return None
+    return min(best_wall, key=best_wall.get)
+
+
+def resolve_param(
+    name: str,
+    explicit,
+    fallback,
+    *,
+    config=None,
+    store_dir: str | Path | None = None,
+    platform: str,
+    num_nodes: int,
+    num_edges: int,
+    validate=None,
+) -> tuple:
+    """Resolve one tunable parameter to ``(value, source)`` where
+    source is ``"config"`` (explicit value set), ``"profile-tuned"``
+    (the store's calibration picked it), or ``"default"`` (the
+    hand-tuned constant). ``store_dir`` defaults to the config's
+    profile store (+ ``PJ_PROFILE_DIR``)."""
+    if explicit is not None:
+        return explicit, "config"
+    if store_dir is None and config is not None:
+        from paralleljohnson_tpu.observe.costs import resolve_profile_dir
+
+        store_dir = resolve_profile_dir(
+            getattr(config, "profile_store", None)
+        )
+    if store_dir is not None and os.environ.get("PJ_NO_TUNE") != "1":
+        tuned = tuned_value(
+            name, store_dir=store_dir, platform=platform,
+            num_nodes=num_nodes, num_edges=num_edges, validate=validate,
+        )
+        if tuned is not None:
+            return tuned, "profile-tuned"
+    return fallback, "default"

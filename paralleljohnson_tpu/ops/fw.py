@@ -78,6 +78,8 @@ def effective_tile(v: int, tile: int = FW_TILE) -> int:
     ``tile`` and pad V up to a tile multiple — one static shape bucket
     per tile multiple instead of a recompile per odd V."""
     vp128 = 128 * max(1, -(-int(v) // 128))
+    if tile is None:
+        tile = FW_TILE  # config fw_tile=None = auto (ISSUE 14 tuning)
     return min(int(tile), vp128)
 
 

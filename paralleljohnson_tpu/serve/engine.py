@@ -44,9 +44,10 @@ interval, plus a final write at :meth:`close`.
 Telemetry: every batch is a ``serve_batch`` span, every query a
 ``query`` span (round-10 ``Tracer``); heartbeat progress carries
 ``queries_done``; :meth:`write_metrics` exports ``pjtpu_queries_total``
-/ ``pjtpu_query_latency_ms`` (a real Prometheus histogram) plus
-compatibility ``pjtpu_query_latency_p50/p99_ms`` gauges derived from
-it, through the same atomic ``write_prom_metrics`` writer the solver
+/ ``pjtpu_query_latency_ms`` (a real Prometheus histogram — use
+``histogram_quantile`` for percentiles; the deprecated round-11
+p50/p99 gauges were removed after their one-release grace period)
+through the same atomic ``write_prom_metrics`` writer the solver
 uses.
 """
 
@@ -159,17 +160,10 @@ SERVE_PROM_METRICS = (
      "Per-query latency distribution (log-bucketed streaming histogram; "
      "percentile error bounded by one bucket width ~19%)",
      lambda e: e.stats.hist),
-    # ...with the round-11 p50/p99 gauges kept one release for dashboard
-    # compatibility, now DERIVED from the same histogram (estimates, one
-    # bucket width of error — the _err_ms gauges carry the bound).
-    ("pjtpu_query_latency_p50_ms", "gauge",
-     "Median per-query latency (derived from pjtpu_query_latency_ms; "
-     "deprecated in favor of histogram_quantile)",
-     lambda e: e.stats.percentiles()["p50_ms"]),
-    ("pjtpu_query_latency_p99_ms", "gauge",
-     "99th-percentile per-query latency (derived from "
-     "pjtpu_query_latency_ms; deprecated)",
-     lambda e: e.stats.percentiles()["p99_ms"]),
+    # The round-11 pjtpu_query_latency_p50_ms / _p99_ms gauges were
+    # kept one release (round 17) after the histogram landed and are
+    # now REMOVED (ISSUE 14 satellite): use
+    # histogram_quantile(0.99, rate(pjtpu_query_latency_ms_bucket[5m])).
     ("pjtpu_slo_burn_rate", "gauge",
      "Error-budget burn rate per registered SLO (1 = spending exactly "
      "the budget; the multi-window alert fires per the SLO's rules)",
@@ -483,8 +477,9 @@ class QueryEngine:
 
     def write_metrics(self, path, *, labels: dict | None = None) -> Path:
         """Prometheus textfile export (``pjtpu_queries_total``, the
-        ``pjtpu_query_latency_ms`` histogram + derived p50/p99 gauges,
-        hit rate, ``pjtpu_slo_burn_rate{slo=...}``, ...)."""
+        ``pjtpu_query_latency_ms`` histogram — percentiles via
+        ``histogram_quantile`` — hit rate,
+        ``pjtpu_slo_burn_rate{slo=...}``, ...)."""
         return write_prom_metrics(self, path, labels=labels,
                                   metrics=SERVE_PROM_METRICS)
 

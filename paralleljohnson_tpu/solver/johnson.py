@@ -575,7 +575,9 @@ class ParallelJohnsonSolver:
             # from the exact MAC total, bytes from the model's
             # bytes-per-MAC at the configured tile.
             tile = fw_ops.effective_tile(
-                max(info["core_size"], 1), self.config.fw_tile
+                max(info["core_size"], 1),
+                (info.get("params") or {}).get("fw_tile")
+                or self.config.fw_tile,
             )
             per_mac_bytes = 4.0 * np.dtype(graph.dtype).itemsize / tile
             cost = capture.analytic(
@@ -593,6 +595,20 @@ class ParallelJohnsonSolver:
                 edges_relaxed=info["macs"],
                 route=info["route"],
                 cost=cost,
+                # Solver-level plan note (ISSUE 14): the condensed
+                # family's decision + its resolved auto-tuned
+                # parameters (fw_tile, partition_parts) land in the
+                # kind:"plan" record like every registry plan's.
+                plan={
+                    "chosen": "condensed+fw",
+                    "reason": (
+                        "solver-level qualification (forced)"
+                        if self.config.partitioned is True else
+                        "solver-level qualification: TPU + sparse + "
+                        "full-APSP scale in the blocked-FW size range"
+                    ),
+                    "params": dict(info.get("params") or {}),
+                },
             ),
             phase="fanout",
         )
@@ -761,6 +777,35 @@ class ParallelJohnsonSolver:
             dgraph = self.backend.reweight(dgraph, h)
         return h, dgraph
 
+    def _pipeline_depth(self, dgraph: Any = None) -> int:
+        """The resolved fan-out pipeline depth (ISSUE 14 auto-tuning):
+        explicit ``config.pipeline_depth`` wins, else the profile-tuned
+        value for this (platform, shape bucket), else the hand-tuned 2.
+        Backends that expose their own resolution (JaxBackend, which
+        budgets HBM carry slots from the same number) are deferred to
+        so the window and the memory budget can never disagree."""
+        resolver = getattr(self.backend, "_pipeline_depth", None)
+        if resolver is not None and dgraph is not None:
+            try:
+                return int(resolver(dgraph))
+            except Exception:  # noqa: BLE001 — tuning must not fail a solve
+                pass
+        from paralleljohnson_tpu import observe
+        from paralleljohnson_tpu.observe.tuning import (
+            DEFAULT_PIPELINE_DEPTH,
+            resolve_param,
+        )
+
+        value, _ = resolve_param(
+            "pipeline_depth", self.config.pipeline_depth,
+            DEFAULT_PIPELINE_DEPTH,
+            config=self.config, platform=observe.current_platform(),
+            num_nodes=int(getattr(dgraph, "num_nodes", 0) or 0),
+            num_edges=int(getattr(dgraph, "num_real_edges", 0) or 0),
+            validate=lambda d: isinstance(d, int) and d >= 1,
+        )
+        return max(1, int(value))
+
     def _initial_batch_size(
         self, sources: np.ndarray, dgraph: Any = None, *,
         with_pred: bool = False,
@@ -781,6 +826,31 @@ class ParallelJohnsonSolver:
                 )
             else:
                 bs = self.backend.suggested_source_batch(dgraph)
+            # Profile-tuned batch (ISSUE 14 auto-tuning): a recorded
+            # plan whose explicit batch measured faster on this
+            # (platform, shape bucket) refines the heuristic — but the
+            # backend's memory budget stays a HARD cap (a tuned value
+            # must never re-introduce the OOMs the budget prevents).
+            try:
+                from paralleljohnson_tpu import observe
+                from paralleljohnson_tpu.observe.tuning import (
+                    resolve_param,
+                )
+
+                tuned, source = resolve_param(
+                    "source_batch", None, None,
+                    config=self.config,
+                    platform=observe.current_platform(),
+                    num_nodes=int(getattr(dgraph, "num_nodes", 0) or 0),
+                    num_edges=int(
+                        getattr(dgraph, "num_real_edges", 0) or 0
+                    ),
+                    validate=lambda b: isinstance(b, int) and b >= 1,
+                )
+                if source == "profile-tuned" and bs:
+                    bs = min(int(tuned), int(bs))
+            except Exception:  # noqa: BLE001 — tuning must not fail a solve
+                pass
         return int(bs or len(sources) or 1)
 
     def _source_batches(
@@ -849,7 +919,7 @@ class ParallelJohnsonSolver:
             with_pred=with_pred,
         )
         depth = (
-            max(1, int(self.config.pipeline_depth))
+            self._pipeline_depth(dgraph)
             if finalize is not None
             else 1
         )
@@ -1169,7 +1239,7 @@ class ParallelJohnsonSolver:
             def try_resume(batch_idx, batch):
                 return ckpt.load(batch_idx, batch, with_pred=with_pred)
 
-        depth = max(1, int(self.config.pipeline_depth))
+        depth = self._pipeline_depth(dgraph)
         faults = self.config.fault_plan
         fault_hook = None
         if faults is not None:

@@ -213,9 +213,34 @@ def solve_condensed(
         if sources is None
         else np.asarray(sources, np.int64)
     )
-    tile_cfg = int(getattr(config, "fw_tile", 512) or 512)
-    k = int(num_parts or getattr(config, "partition_parts", None)
-            or auto_num_parts(v))
+    # Two of ISSUE 14's auto-tuned free parameters: an explicit config
+    # value wins, else the profile-tuned value for this (platform,
+    # shape bucket), else the hand-tuned constants (512 tile;
+    # ~sqrt(V)/8 parts) — observe.tuning.
+    from paralleljohnson_tpu import observe
+    from paralleljohnson_tpu.observe.tuning import (
+        DEFAULT_FW_TILE,
+        resolve_param,
+    )
+
+    _platform = observe.current_platform()
+    tile_cfg, _ = resolve_param(
+        "fw_tile", getattr(config, "fw_tile", None), DEFAULT_FW_TILE,
+        config=config, platform=_platform,
+        num_nodes=v, num_edges=graph.num_real_edges,
+        validate=lambda t_: isinstance(t_, int) and t_ >= 128
+        and t_ % 128 == 0,
+    )
+    tile_cfg = int(tile_cfg)
+    k, parts_source = resolve_param(
+        "partition_parts",
+        num_parts or getattr(config, "partition_parts", None),
+        auto_num_parts(v),
+        config=config, platform=_platform,
+        num_nodes=v, num_edges=graph.num_real_edges,
+        validate=lambda n_: isinstance(n_, int) and n_ >= 1,
+    )
+    k = int(k)
 
     labels = partition_by_pivots(graph, k, seed=seed)
     part_ids = np.unique(labels)
@@ -353,6 +378,11 @@ def solve_condensed(
         # would have cost.
         "expand_products_skipped": int(expand_skipped),
         "expand_macs_skipped": int(macs_skipped),
+        # The resolved auto-tuned parameters + provenance (ISSUE 14):
+        # ride the solver's plan record so the tuner can compare
+        # alternatives per (platform, shape bucket).
+        "params": {"fw_tile": tile_cfg, "partition_parts": int(k)},
+        "params_source": {"partition_parts": parts_source},
     }
     return dist, pred, info
 

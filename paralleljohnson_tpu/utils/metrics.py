@@ -148,6 +148,12 @@ class SolverStats:
     analytic_cost: dict | None = None
     roofline: dict | None = None
     predicted_s: float | None = None
+    # Planner decision of the solve's dominant dispatch (ISSUE 14,
+    # ``paralleljohnson_tpu.planner``): chosen plan, why-line,
+    # candidate table (explicit ``unpriced`` markers), and the resolved
+    # auto-tuned parameters; finalize_solve persists it as the
+    # ``kind: "plan"`` profile record. None for ladder-coded backends.
+    plan: dict | None = None
     convergence: dict | None = None
     trajectories: dict = dataclasses.field(default_factory=dict, repr=False)
 
@@ -158,6 +164,12 @@ class SolverStats:
         self.iterations_by_phase[phase] += int(result.iterations)
         self._accumulate_cost(getattr(result, "cost", None))
         self._accumulate_trajectory(result, phase)
+        plan = getattr(result, "plan", None)
+        if plan:
+            # Last decision wins (a multi-batch fan-out re-plans per
+            # batch with identical inputs); params already resolved by
+            # the solver merge in higher layers.
+            self.plan = plan
         route = getattr(result, "route", None)
         if route:
             # A phase can change route mid-solve (e.g. an auto route degrades
@@ -265,6 +277,7 @@ class SolverStats:
             "analytic_cost": self.analytic_cost,
             "roofline": self.roofline,
             "predicted_s": self.predicted_s,
+            "plan": self.plan,
             "convergence": self.convergence,
             "total_seconds": self.total_seconds,
             "edges_relaxed_per_sec": self.edges_relaxed_per_second(),

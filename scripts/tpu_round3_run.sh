@@ -251,12 +251,25 @@ run 1200 jax-incremental-bench python -m paralleljohnson_tpu.cli bench increment
 #     measured 96.3% skippable into recorded wall-clock
 run 1200 jax-dirty-window python -m paralleljohnson_tpu.cli bench dirty_window --backend jax --preset full --update-baseline BASELINE.md
 
+# 4m) planner-dispatch bench (ISSUE 14 tentpole): measure EVERY
+#     qualified plan on contrasting graphs (scrambled grid / rmat /
+#     dense small-V), then assert the registry's auto pick is the
+#     measured-fastest qualified route (or within the cost model's
+#     noise band), distances bitwise-checked per route
+run 1200 jax-planner-dispatch python -m paralleljohnson_tpu.cli bench planner_dispatch --backend jax --preset full --update-baseline BASELINE.md
+
 # 5) driver metric (should reflect the blocked kernel now)
 run 1200 bench.py python bench.py
 
 # 5a) final regression grade + the priced-route/cost report over the
 #     whole pass's profile store (the round's attribution artifact)
 run 120 bench-regress python scripts/bench_regress.py --history "$PJ_PROFILE_DIR" --last 1
+#     ... planner audit (ISSUE 14): ingest the pass's kind="plan"
+#     dispatch records (idempotent — exact re-ingests dedup) and grade
+#     the newest decisions against each shape bucket's history, so a
+#     planner that starts picking slower routes fails THIS stage with
+#     the chosen plan + why-line in the flag detail.
+run 120 planner-audit python scripts/bench_regress.py --history "$PJ_PROFILE_DIR" --ingest "$PJ_PROFILE_DIR/profiles.jsonl" --last 5
 run 120 cost-report python scripts/cost_report.py "$PJ_PROFILE_DIR"
 #     ... and the SLO observatory's view of the pass (ISSUE 12): the
 #     serve bench stage left its live-metrics snapshot (streaming

@@ -11,14 +11,15 @@ REPO = Path(__file__).resolve().parent.parent
 EXAMPLES = sorted((REPO / "examples").glob("*.py"))
 
 
-# The road-graph demo solves several full grids — the heaviest example
-# by far (ISSUE 9 suite-budget trim); the 01/02/03 smokes keep the
+# The road-graph demo solves several full grids and the 8-device mesh
+# demo compiles collective executables — the two heaviest examples
+# (ISSUE 9 + ISSUE 14 suite-budget trims); the 01/02 smokes keep the
 # examples dir covered in tier-1.
 @pytest.mark.parametrize(
     "script",
     [
         pytest.param(p, marks=pytest.mark.slow)
-        if p.name == "04_road_graphs.py" else p
+        if p.name in ("04_road_graphs.py", "03_multichip_mesh.py") else p
         for p in EXAMPLES
     ],
     ids=lambda p: p.name,

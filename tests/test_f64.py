@@ -6,6 +6,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _SCRIPT = r"""
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -36,6 +38,7 @@ print(json.dumps({"exact": bool(exact), "close": bool(close),
 """
 
 
+@pytest.mark.slow  # ISSUE 14 suite-budget trim (full f64 recompile)
 def test_f64_matches_oracle_tightly():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(

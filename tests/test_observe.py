@@ -171,12 +171,18 @@ def test_solve_appends_profile_record_and_calibrated_prediction(tmp_path):
     assert res.stats.roofline is not None
     assert res.stats.roofline["bound"] in ("hbm", "mxu")
     recs = ProfileStore(tmp_path).records()
-    assert len(recs) == 1
+    # One kind:"plan" decision record + one solve record (ISSUE 14).
+    assert [r.get("kind") for r in recs] == ["plan", "solve"]
+    assert recs[0]["chosen"] == recs[0]["route"]
+    recs = [r for r in recs if r.get("kind") == "solve"]
     assert recs[0]["cost"]["bytes_accessed"] > 0
     assert recs[0]["roofline"]["bound"] == res.stats.roofline["bound"]
     res2 = solver.solve(g, sources=np.arange(8))
     assert res2.stats.predicted_s is not None and res2.stats.predicted_s > 0
-    assert len(ProfileStore(tmp_path).records()) == 2
+    assert len(
+        [r for r in ProfileStore(tmp_path).records()
+         if r.get("kind") == "solve"]
+    ) == 2
 
 
 def test_sharded_route_records_unavailable_marker(tmp_path):

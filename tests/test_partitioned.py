@@ -100,6 +100,7 @@ def test_condensed_bitwise_equal_negative_weights():
     np.testing.assert_array_equal(dist, oracle_apsp(g))
 
 
+@pytest.mark.slow  # ISSUE 14 suite-budget trim (several condensed solves)
 def test_condensed_source_subset_and_duplicates():
     from conftest import oracle_apsp
 

@@ -1,0 +1,432 @@
+"""Priced planner registry + profile-calibrated auto-tuning (ISSUE 14).
+
+Covers the four contracts the registry must keep:
+
+1. **Ladder parity** — with nothing priced, the declared plan
+   priorities reproduce the pre-registry if/else order exactly.
+2. **Forced-flag contracts** — the loud ``NotImplementedError``s a
+   forced flag carried through the ladder survive the registry
+   verbatim (pinned to the exact messages), and ``dirty_window=True``
+   without evidence behaves exactly as the ladder did (engages — True
+   forces; "auto" without evidence stays plain).
+3. **Priced promotion** — a calibrated challenger displaces the
+   incumbent only when BOTH are priced and the gap clears the noise
+   band; ``planner=False`` restores pure priority.
+4. **Auto-tuning honesty** — every tuned parameter falls back to its
+   hand-tuned constant on an empty store; a store with measured
+   alternatives promotes the faster value per (platform, shape
+   bucket); explicit config always wins.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu.backends import get_backend
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.graphs import erdos_renyi
+from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+
+def _sparse_graph(n=64, seed=5):
+    """Small but below the dense-density gate (E < V^2/16), so the
+    sparse sweep family serves the fan-out."""
+    g = erdos_renyi(n, 0.04, seed=seed)
+    assert g.num_real_edges < n * n / 16
+    return g
+
+
+def _solve_rec(route, wall_s, *, nodes, edges, batch, platform="cpu"):
+    """Minimal profile-store solve record that calibrates
+    ``(route, platform)`` at wall_s / (batch * edges) s per edge-row."""
+    return {
+        "kind": "solve", "route": route, "platform": platform,
+        "nodes": nodes, "edges": edges, "batch": batch,
+        "measured": {"wall_s": wall_s, "compute_s": wall_s},
+    }
+
+
+def _write_store(tmp_path, records):
+    d = tmp_path / "profiles"
+    d.mkdir(exist_ok=True)
+    with open(d / "profiles.jsonl", "w", encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return str(d)
+
+
+# -- 1. ladder parity --------------------------------------------------------
+
+
+def test_unpriced_dispatch_reproduces_ladder_order():
+    g = _sparse_graph()
+    be = get_backend("jax", SolverConfig(mesh_shape=(1,)))
+    preview = be.plan_preview(be.upload(g), 8)
+    assert preview["chosen"] == "vm"
+    assert preview["reason"].startswith("priority")
+    names = [c["plan"] for c in preview["candidates"]]
+    # The declared priorities ARE the old ladder order.
+    assert names == [
+        "dia", "gs", "fw", "vm-blocked+dw", "sharded-2d", "sharded-1d",
+        "dense", "pallas-vm", "vm-blocked", "vm", "sweep-sm",
+    ]
+    # Every qualified-but-uncalibrated candidate carries the explicit
+    # unpriced marker — never silently omitted, never read as free.
+    for c in preview["candidates"]:
+        if c["qualified"]:
+            assert c.get("unpriced") is True
+
+
+def test_dense_graph_still_routes_dense():
+    g = erdos_renyi(48, 0.5, seed=3)
+    be = get_backend("jax", SolverConfig(mesh_shape=(1,)))
+    assert be.plan_preview(be.upload(g), 48)["chosen"] == "dense"
+
+
+# -- 2. forced-flag contracts (pinned to the ladder's messages) --------------
+
+
+def test_gs_forced_on_edges_mesh_raises_exact_message():
+    g = _sparse_graph()
+    be = get_backend(
+        "jax", SolverConfig(gauss_seidel=True, mesh_shape=(4, 2))
+    )
+    with pytest.raises(
+        NotImplementedError,
+        match=r"gauss_seidel=True fan-out shards sources only",
+    ):
+        be.multi_source(be.upload(g), np.arange(4, dtype=np.int64))
+
+
+def test_dia_forced_on_edges_mesh_raises_exact_message():
+    g = _sparse_graph()
+    be = get_backend("jax", SolverConfig(dia=True, mesh_shape=(4, 2)))
+    with pytest.raises(
+        NotImplementedError,
+        match=r"dia=True fan-out shards sources only",
+    ):
+        be.multi_source(be.upload(g), np.arange(4, dtype=np.int64))
+
+
+def test_fw_forced_on_multi_device_mesh_raises_exact_message():
+    g = erdos_renyi(48, 0.5, seed=3)
+    # Default mesh on the simulated host = 8 devices (conftest).
+    solver = ParallelJohnsonSolver(SolverConfig(fw=True))
+    with pytest.raises(
+        NotImplementedError,
+        match=r"fw=True is a single-chip dense route; use mesh_shape=\(1,\)",
+    ):
+        solver.solve(g)
+
+
+def test_dw_forced_without_evidence_engages(tmp_path):
+    """dirty_window=True is a qualification override: it engages with
+    NO profile store at all (True forces), exactly as the ladder did."""
+    g = _sparse_graph()
+    cfg = SolverConfig(dirty_window=True, mesh_shape=(1,))
+    res = ParallelJohnsonSolver(cfg).multi_source(
+        g, np.arange(4, dtype=np.int64)
+    )
+    assert res.stats.routes_by_phase["fanout"] == "vm-blocked+dw"
+    assert res.stats.plan["chosen"] == "vm-blocked+dw"
+    assert "forced" in res.stats.plan["reason"]
+
+
+def test_dw_auto_without_evidence_stays_plain():
+    g = _sparse_graph()
+    be = get_backend("jax", SolverConfig(mesh_shape=(1,)))
+    preview = be.plan_preview(be.upload(g), 4)
+    dw = next(
+        c for c in preview["candidates"] if c["plan"] == "vm-blocked+dw"
+    )
+    assert not dw["qualified"]
+    assert "no profile store" in dw["reason"]
+
+
+# -- 3. priced promotion -----------------------------------------------------
+
+
+def test_priced_challenger_promoted_beyond_band(tmp_path):
+    g = _sparse_graph()
+    e, b = g.num_real_edges, 8
+    store = _write_store(tmp_path, [
+        _solve_rec("vm", 1.0, nodes=g.num_nodes, edges=e, batch=b),
+        _solve_rec("sweep-sm", 0.1, nodes=g.num_nodes, edges=e, batch=b),
+    ])
+    cfg = SolverConfig(mesh_shape=(1,), profile_store=store)
+    res = ParallelJohnsonSolver(cfg).multi_source(
+        g, np.arange(b, dtype=np.int64)
+    )
+    assert res.stats.routes_by_phase["fanout"] == "sweep-sm"
+    assert res.stats.plan["chosen"] == "sweep-sm"
+    assert res.stats.plan["reason"].startswith("priced")
+
+
+def test_unpriced_incumbent_is_never_displaced(tmp_path):
+    """A cheap challenger with an UNPRICED incumbent stays behind it:
+    an unpriced route must read as unpriced, not as infinitely slow."""
+    g = _sparse_graph()
+    store = _write_store(tmp_path, [
+        _solve_rec("sweep-sm", 1e-6, nodes=g.num_nodes,
+                   edges=g.num_real_edges, batch=8),
+    ])
+    be = get_backend(
+        "jax", SolverConfig(mesh_shape=(1,), profile_store=store)
+    )
+    preview = be.plan_preview(be.upload(g), 8)
+    assert preview["chosen"] == "vm"
+    assert "unpriced" in preview["reason"]
+
+
+def test_planner_false_disables_promotion(tmp_path):
+    g = _sparse_graph()
+    e, b = g.num_real_edges, 8
+    store = _write_store(tmp_path, [
+        _solve_rec("vm", 1.0, nodes=g.num_nodes, edges=e, batch=b),
+        _solve_rec("sweep-sm", 0.1, nodes=g.num_nodes, edges=e, batch=b),
+    ])
+    cfg = SolverConfig(
+        mesh_shape=(1,), profile_store=store, planner=False
+    )
+    res = ParallelJohnsonSolver(cfg).multi_source(
+        g, np.arange(b, dtype=np.int64)
+    )
+    assert res.stats.routes_by_phase["fanout"] == "vm"
+
+
+def test_challenger_inside_noise_band_not_promoted(tmp_path):
+    g = _sparse_graph()
+    e, b = g.num_real_edges, 8
+    store = _write_store(tmp_path, [
+        _solve_rec("vm", 1.0, nodes=g.num_nodes, edges=e, batch=b),
+        _solve_rec("sweep-sm", 0.9, nodes=g.num_nodes, edges=e, batch=b),
+    ])
+    be = get_backend(
+        "jax", SolverConfig(mesh_shape=(1,), profile_store=store)
+    )
+    preview = be.plan_preview(be.upload(g), b)
+    assert preview["chosen"] == "vm"
+    assert "noise band" in preview["reason"]
+
+
+def test_forced_flag_pins_plan_over_pricing(tmp_path):
+    """A forced route flag is a qualification override: pricing that
+    favors another plan cannot displace it."""
+    g = _sparse_graph()
+    e, b = g.num_real_edges, 4
+    store = _write_store(tmp_path, [
+        _solve_rec("vm-blocked+dw", 1.0, nodes=g.num_nodes, edges=e,
+                   batch=b),
+        _solve_rec("vm", 1e-6, nodes=g.num_nodes, edges=e, batch=b),
+    ])
+    cfg = SolverConfig(
+        mesh_shape=(1,), profile_store=store, dirty_window=True
+    )
+    res = ParallelJohnsonSolver(cfg).multi_source(
+        g, np.arange(b, dtype=np.int64)
+    )
+    assert res.stats.routes_by_phase["fanout"] == "vm-blocked+dw"
+    assert "forced" in res.stats.plan["reason"]
+
+
+# -- plan records + regression ingest ---------------------------------------
+
+
+def test_solve_lands_plan_record_with_params(tmp_path):
+    from paralleljohnson_tpu.observe.store import ProfileStore
+
+    g = _sparse_graph()
+    store = str(tmp_path / "profiles")
+    cfg = SolverConfig(mesh_shape=(1,), profile_store=store)
+    ParallelJohnsonSolver(cfg).multi_source(
+        g, np.arange(8, dtype=np.int64)
+    )
+    plans = [
+        r for r in ProfileStore(store).records()
+        if r.get("kind") == "plan"
+    ]
+    assert len(plans) == 1
+    rec = plans[0]
+    assert rec["chosen"] == rec["route"] == "vm"
+    assert rec["measured"]["wall_s"] > 0
+    # The resolved auto-tuned parameters ride the record — the samples
+    # the tuner compares.
+    assert rec["params"]["source_batch"] >= 1
+    assert rec["params"]["pipeline_depth"] >= 1
+    # Candidate table keeps the explicit unpriced markers.
+    assert any(c.get("unpriced") for c in rec["candidates"])
+
+
+def test_regress_ingests_plan_records_idempotently(tmp_path):
+    from paralleljohnson_tpu.observe.regress import (
+        BenchHistory,
+        detect_regressions,
+        normalize_record,
+    )
+
+    rec = {
+        "kind": "plan", "label": "solve", "platform": "cpu",
+        "nodes": 100, "edges": 400, "batch": 8, "route": "vm",
+        "chosen": "vm", "reason": "priority", "params": {},
+        "measured": {"wall_s": 1.0, "compute_s": 0.9},
+    }
+    rows = normalize_record(rec, source="profiles.jsonl")
+    assert len(rows) == 1
+    assert rows[0]["bench"] == "planner:V128:E512:B8"
+    assert rows[0]["wall_s"] == 1.0
+    assert rows[0]["detail"]["route"] == "vm"
+    hist = BenchHistory(tmp_path)
+    assert hist.append(rows[0]) is True
+    assert hist.append(rows[0]) is False  # exact re-ingest dedups
+    # A planner that starts picking a slower route for the same shape
+    # flags as an ordinary wall regression for that bucket.
+    history = [dict(rows[0], wall_s=1.0), dict(rows[0], wall_s=1.05)]
+    slow = dict(rows[0], wall_s=3.0,
+                detail={**rows[0]["detail"], "route": "sweep-sm"})
+    flagged = detect_regressions([slow], history)
+    assert len(flagged) == 1 and flagged[0]["kind"] == "wall"
+
+
+# -- 4. auto-tuning ----------------------------------------------------------
+
+
+def test_empty_store_resolves_every_hand_tuned_fallback(tmp_path):
+    """The acceptance contract: all five parameters fall back to the
+    hand-tuned constants when the profile store is empty."""
+    from paralleljohnson_tpu.observe.tuning import (
+        DEFAULT_FW_TILE,
+        DEFAULT_PIPELINE_DEPTH,
+        TUNABLE_PARAMS,
+        resolve_param,
+    )
+
+    store = str(tmp_path / "empty")
+    fallbacks = {
+        "fw_tile": DEFAULT_FW_TILE,
+        "partition_parts": 7,
+        "delta": 0.5,
+        "source_batch": 64,
+        "pipeline_depth": DEFAULT_PIPELINE_DEPTH,
+    }
+    assert set(fallbacks) == set(TUNABLE_PARAMS)
+    for name, fb in fallbacks.items():
+        value, source = resolve_param(
+            name, None, fb, store_dir=store, platform="cpu",
+            num_nodes=100, num_edges=400,
+        )
+        assert value == fb and source == "default"
+
+
+def test_tuned_value_picks_faster_alternative_same_bucket():
+    from paralleljohnson_tpu.observe.tuning import tuned_value
+
+    def plan_rec(value, wall, *, nodes=1000, edges=8000, platform="cpu"):
+        return {
+            "kind": "plan", "platform": platform, "nodes": nodes,
+            "edges": edges, "batch": 8,
+            "params": {"fw_tile": value},
+            "measured": {"compute_s": wall},
+        }
+
+    records = [
+        plan_rec(512, 2.0),
+        plan_rec(256, 1.0),
+        plan_rec(128, 0.2, nodes=64, edges=128),   # other bucket
+        plan_rec(384, 0.1, platform="tpu"),        # other platform
+    ]
+    assert tuned_value(
+        "fw_tile", records=records, platform="cpu",
+        num_nodes=1000, num_edges=8000,
+    ) == 256
+    # One observed value has nothing to beat — fallback stands.
+    assert tuned_value(
+        "fw_tile", records=[plan_rec(256, 1.0)], platform="cpu",
+        num_nodes=1000, num_edges=8000,
+    ) is None
+    with pytest.raises(ValueError, match="unknown tunable parameter"):
+        tuned_value("nonsense", records=records, platform="cpu",
+                    num_nodes=1, num_edges=1)
+
+
+def test_explicit_config_beats_tuning(tmp_path):
+    from paralleljohnson_tpu.observe.tuning import resolve_param
+
+    value, source = resolve_param(
+        "fw_tile", 384, 512, store_dir=str(tmp_path), platform="cpu",
+        num_nodes=100, num_edges=400,
+    )
+    assert value == 384 and source == "config"
+
+
+def test_backend_fw_tile_profile_tuned(tmp_path):
+    """A store whose plan records measured fw_tile=256 faster than 512
+    in this shape bucket flips the backend's resolved tile; invalid
+    (non-128-multiple) recorded values are filtered out."""
+    g = erdos_renyi(48, 0.5, seed=3)
+    recs = []
+    for value, wall in ((512, 2.0), (256, 0.5), (200, 0.001)):
+        recs.append({
+            "kind": "plan", "platform": "cpu", "nodes": g.num_nodes,
+            "edges": g.num_real_edges, "batch": 8,
+            "params": {"fw_tile": value},
+            "measured": {"compute_s": wall},
+        })
+    store = _write_store(tmp_path, recs)
+    be = get_backend(
+        "jax", SolverConfig(mesh_shape=(1,), profile_store=store)
+    )
+    tile, source = be._fw_tile(be.upload(g))
+    assert tile == 256 and source == "profile-tuned"
+    # Explicit config still wins.
+    be2 = get_backend(
+        "jax",
+        SolverConfig(mesh_shape=(1,), profile_store=store, fw_tile=512),
+    )
+    assert be2._fw_tile(be2.upload(g)) == (512, "config")
+
+
+# -- select() unit behavior --------------------------------------------------
+
+
+def test_select_requires_a_qualified_plan():
+    from paralleljohnson_tpu.planner import Plan, select
+
+    plans = [Plan(name="never", entry="fanout", priority=1,
+                  qualify=lambda ctx: (False, "no"))]
+    with pytest.raises(RuntimeError, match="no qualified plan"):
+        select(plans, object())
+
+
+def test_select_contract_runs_before_any_qualification():
+    from paralleljohnson_tpu.planner import Plan, select
+
+    def boom(ctx):
+        raise NotImplementedError("contract violated")
+
+    plans = [
+        Plan(name="ok", entry="fanout", priority=1,
+             qualify=lambda ctx: (True, "yes")),
+        Plan(name="guarded", entry="fanout", priority=2,
+             qualify=lambda ctx: (False, "no"), contract=boom),
+    ]
+    # The guarded plan would never be chosen — its contract must still
+    # fire (the ladder ran these checks at the top of dispatch).
+    with pytest.raises(NotImplementedError, match="contract violated"):
+        select(plans, object())
+
+
+@pytest.mark.slow
+def test_planner_dispatch_bench_smoke():
+    from paralleljohnson_tpu.benchmarks import bench_planner_dispatch
+
+    rec = bench_planner_dispatch("jax", "smoke")
+    d = rec.detail
+    assert d["all_bitwise"] is True
+    assert d["all_routes_agree"] is True
+    assert d["all_within_band"] is True
+    assert len(d["graphs"]) == 3
+    for g in d["graphs"].values():
+        assert g["pick"] is not None

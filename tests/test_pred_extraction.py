@@ -186,6 +186,7 @@ def test_pred_extraction_false_keeps_legacy_sweep():
     validate_pred_tree(g, res.dist, res.predecessors, res.sources)
 
 
+@pytest.mark.slow  # ISSUE 14 suite-budget trim (8-dev extraction compile)
 def test_sharded_pred_extraction_route_and_validity():
     g = erdos_renyi(48, 0.1, seed=5)
     res = ParallelJohnsonSolver(

@@ -293,8 +293,11 @@ def test_serve_prom_metrics(tmp_path):
                                labels={"command": "serve"})
     text = out.read_text()
     assert 'pjtpu_queries_total{command="serve"} 2.0' in text
-    assert "pjtpu_query_latency_p50_ms" in text
-    assert "pjtpu_query_latency_p99_ms" in text
+    # The deprecated derived p50/p99 gauges are gone (ISSUE 14
+    # satellite): the histogram is the only latency export.
+    assert "pjtpu_query_latency_p50_ms" not in text
+    assert "pjtpu_query_latency_p99_ms" not in text
+    assert "pjtpu_query_latency_ms_bucket" in text
     assert 'pjtpu_serve_batches_scheduled_total{command="serve"} 1.0' in text
 
 
@@ -537,8 +540,8 @@ def test_serve_stats_readable_after_sigkill(tmp_path):
 
 def test_serve_prom_histogram_and_burn_gauge(tmp_path):
     """The latency export is a real Prometheus histogram (cumulative
-    _bucket/_sum/_count, format self-checked) with the p50/p99 gauges
-    kept for compatibility and the labeled SLO burn gauge beside them."""
+    _bucket/_sum/_count, format self-checked); the deprecated derived
+    p50/p99 gauges are removed, the labeled SLO burn gauge stays."""
     from paralleljohnson_tpu.utils.telemetry import validate_prom_text
 
     g = erdos_renyi(16, 0.2, seed=23)
@@ -553,6 +556,6 @@ def test_serve_prom_histogram_and_burn_gauge(tmp_path):
     assert 'pjtpu_query_latency_ms_count{command="serve"} 4.0' in text
     assert 'le="+Inf"} 4.0' in text
     assert "pjtpu_query_latency_ms_sum" in text
-    assert "pjtpu_query_latency_p50_ms" in text  # compat gauges stay
-    assert "pjtpu_query_latency_p99_ms" in text
+    assert "pjtpu_query_latency_p50_ms" not in text  # removed (deprecated)
+    assert "pjtpu_query_latency_p99_ms" not in text
     assert 'pjtpu_slo_burn_rate{command="serve",slo="serve"}' in text

@@ -120,6 +120,7 @@ def test_device_builder_matches_host(monkeypatch):
     np.testing.assert_allclose(np.asarray(res.dist), want, rtol=1e-4, atol=1e-3)
 
 
+@pytest.mark.slow  # ISSUE 14 suite-budget trim (device layout rebuild)
 def test_device_builder_reweight_regather(monkeypatch):
     """Post-reweight, the device-built structure re-gathers the NEW
     device weights through order/slots — the branch the device path
