@@ -96,6 +96,12 @@ _SIZES = {
     "serve_queries": dict(n=256,       mini_n=1024,      full_n=4096,
                           queries=200, mini_queries=2000, full_queries=20000,
                           clients=4,   mini_clients=4,   full_clients=8),
+    "serve_overload": dict(rows=12,    mini_rows=20,     full_rows=40,
+                          clients=4,   mini_clients=6,   full_clients=8,
+                          overload_s=2.5, mini_overload_s=4.0,
+                          full_overload_s=6.0,
+                          cooldown_s=3.5, mini_cooldown_s=5.0,
+                          full_cooldown_s=6.0),
     "distributed_fleet": dict(n=96,    mini_n=1024,      full_n=4096,
                           workers=2,   mini_workers=3,   full_workers=4),
     "incremental_update": dict(n=96,   mini_n=1024,      full_n=4096,
@@ -966,6 +972,279 @@ def bench_serve_queries(backend: str, preset: str) -> BenchRecord:
     )
 
 
+def bench_serve_overload(backend: str, preset: str) -> BenchRecord:
+    """Config 13 (ISSUE 15 tentpole): the traffic FRONT END measured at
+    ~2x its own calibrated capacity, through real TCP sockets — the
+    designed-overload contract under test, not throughput:
+
+    - accepted traffic stays in SLO (the latency target is calibrated
+      from a closed-loop mixed probe; admission bounds the queue, so
+      accepted p99 cannot grow without bound);
+    - overload produces explicit ``overloaded`` rejections (never an
+      unbounded queue), which burn the availability budget and trip the
+      multi-window burn alert;
+    - the burn alert engages CERTIFIED shedding: a nonzero-but-bounded
+      fraction of answers comes back ``{shed: true, exact: false,
+      max_error: <finite>}`` and every one is verified against the
+      direct solve's matrix (|answer - exact| <= max_error);
+    - every non-shed answer is verified BITWISE against the same matrix;
+    - when offered load drops back below capacity (the cooldown phase),
+      shedding disengages — zero shed answers in the late cooldown.
+
+    Violations land in ``detail["failed"]`` (the row is the assertion).
+    The graph is a strongly connected 2-D lattice so every landmark
+    bound is finite — a shed answer with an infinite bound would be
+    honest but useless, and this bench demands useful degradation."""
+    import socket as _socket
+    import tempfile
+    import threading
+
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.graphs import grid2d
+    from paralleljohnson_tpu.observe.live import SLO
+    from paralleljohnson_tpu.serve import (
+        LandmarkIndex,
+        QueryEngine,
+        ServeFrontend,
+        TileStore,
+    )
+    from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+    rows = _sz("serve_overload", "rows", preset)
+    n_clients = _sz("serve_overload", "clients", preset)
+    overload_s = float(_sz("serve_overload", "overload_s", preset))
+    cooldown_s = float(_sz("serve_overload", "cooldown_s", preset))
+    g = grid2d(rows, rows, seed=41)
+    n = g.num_nodes
+    cfg = SolverConfig(backend=backend, telemetry=_BENCH_TELEMETRY.get(),
+                       profile_store=_BENCH_PROFILE.get())
+    # The oracle every answer is graded against (f32 rows, losslessly
+    # widened — the same values the engine serves).
+    exact = np.asarray(ParallelJohnsonSolver(
+        SolverConfig(backend=backend)).solve(g).matrix)
+
+    rng = np.random.default_rng(43)
+    warm = np.sort(rng.choice(n, size=max(8, n // 4), replace=False))
+    rest = np.array(sorted(set(range(n)) - set(map(int, warm))), np.int64)
+    probe_cold = rest[: max(1, len(rest) // 3)]
+    phase_cold = rest[max(1, len(rest) // 3):]
+
+    with tempfile.TemporaryDirectory() as d:
+        store = TileStore(d, g, hot_rows=max(8, n // 8), warm_rows=n)
+        landmarks = LandmarkIndex.build(g, k=8, config=cfg, seed=0)
+        QueryEngine(g, store, landmarks=landmarks, config=cfg).warm(warm)
+
+        # Capacity + latency calibration: a mixed (80% warm hit / 20%
+        # cold miss -> scheduled solve) closed loop through a throwaway
+        # engine over the same store. The SLO latency target is 10x the
+        # probe's p99 — generous enough that bounded-queue accepted
+        # traffic holds it, tight enough that an unbounded queue would
+        # not.
+        probe_engine = QueryEngine(g, store, landmarks=landmarks,
+                                   config=cfg, stats_interval_s=0)
+        probe_n = 64
+        t0 = time.perf_counter()
+        for i in range(probe_n):
+            src = (int(probe_cold[i % len(probe_cold)]) if i % 5 == 4
+                   else int(rng.choice(warm)))
+            probe_engine.query_batch(
+                [{"source": src, "dst": int(rng.integers(n))}])
+        capacity_qps = probe_n / max(time.perf_counter() - t0, 1e-9)
+        probe_p99 = probe_engine.stats.percentiles()["p99_ms"]
+        probe_engine.close()
+        latency_target_ms = max(50.0, 10.0 * probe_p99)
+
+        slo = SLO(name="serve", latency_ms=latency_target_ms,
+                  latency_pct=99.0, availability=0.9,
+                  rules=((20.0, 1.5, 2.0),))
+        engine = QueryEngine(g, store, landmarks=landmarks, config=cfg,
+                             miss_policy="solve", slo=slo,
+                             stats_interval_s=0)
+        frontend = ServeFrontend(
+            engine, max_connections=2 * n_clients, max_inflight=2,
+            shed_policy="landmark", retry_after_ms=25,
+        ).start()
+        host, port = frontend.address
+
+        results: dict[str, list] = {"overload": [], "cooldown": []}
+        res_lock = threading.Lock()
+        client_errors: list[BaseException] = []
+
+        def client(k: int, phase: str, rate: float, duration_s: float,
+                   barrier) -> None:
+            # Closed-loop paced: wait until the next send is due, send,
+            # read the one response line (every request gets exactly
+            # one — a missing line is a hung connection and fails the
+            # bench via the socket timeout).
+            try:
+                sock = _socket.create_connection((host, port), timeout=30)
+                sock.settimeout(30)
+                f = sock.makefile("rw", encoding="utf-8", newline="\n")
+                json.loads(f.readline())  # protocol header
+                crng = np.random.default_rng(1000 * (1 + k) + len(phase))
+                local = []
+                sent = 0
+                barrier.wait()
+                start = time.perf_counter()
+                while True:
+                    elapsed = time.perf_counter() - start
+                    if elapsed >= duration_s:
+                        break
+                    delay = sent / rate - elapsed
+                    if delay > 0:
+                        time.sleep(delay)
+                    src = (int(crng.choice(warm)) if crng.random() < 0.7
+                           else int(phase_cold[crng.integers(
+                               len(phase_cold))]))
+                    dst = int(crng.integers(n))
+                    f.write(json.dumps(
+                        {"id": sent, "source": src, "dst": dst}) + "\n")
+                    f.flush()
+                    resp = json.loads(f.readline())
+                    local.append((src, dst, resp,
+                                  time.perf_counter() - start))
+                    sent += 1
+                f.close()
+                sock.close()
+                with res_lock:
+                    results[phase].extend(local)
+            except BaseException as e:  # noqa: BLE001 — surface, don't hang
+                client_errors.append(e)
+
+        def run_phase(phase: str, total_rate: float,
+                      duration_s: float) -> None:
+            barrier = threading.Barrier(n_clients)
+            threads = [
+                threading.Thread(
+                    target=client,
+                    args=(k, phase, total_rate / n_clients, duration_s,
+                          barrier),
+                    name=f"overload-client-{phase}-{k}")
+                for k in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        t0 = time.perf_counter()
+        run_phase("overload", 2.0 * capacity_qps, overload_s)
+        shed_after_overload = engine.stats.shed_answers
+        rejected_after_overload = engine.stats.rejected
+        run_phase("cooldown", 0.3 * capacity_qps, cooldown_s)
+        wall = time.perf_counter() - t0
+        if client_errors:
+            frontend.drain()
+            raise client_errors[0]
+
+        # -- grade every response against the oracle ----------------------
+        failures: list[str] = []
+        all_resps = results["overload"] + results["cooldown"]
+        shed_n = rejected_n = exact_n = 0
+        for src, dst, r, _ in all_resps:
+            if "error" in r:
+                if r["error"] in ("overloaded", "deadline", "draining"):
+                    rejected_n += 1
+                else:
+                    failures.append(f"unexpected error answer: {r}")
+                continue
+            want = float(exact[src, dst])
+            if r.get("shed"):
+                shed_n += 1
+                if r.get("exact") is not False or "max_error" not in r:
+                    failures.append(f"shed answer not flagged: {r}")
+                    continue
+                err = float(r["max_error"])
+                if not np.isfinite(err):
+                    failures.append(
+                        f"shed answer with non-finite max_error: {r}")
+                elif abs(float(r["distance"]) - want) > err + 1e-9:
+                    failures.append(
+                        f"shed answer outside certified bound: "
+                        f"|{r['distance']} - {want}| > {err}")
+            elif r.get("exact") is True:
+                exact_n += 1
+                if float(r["distance"]) != want:
+                    failures.append(
+                        f"non-shed answer not bitwise: s={src} t={dst} "
+                        f"{r['distance']} != {want}")
+            else:
+                failures.append(f"unflagged approximate answer: {r}")
+
+        accepted = shed_n + exact_n
+        shed_frac = shed_n / max(1, accepted)
+        if shed_after_overload == 0:
+            failures.append(
+                "overload phase shed nothing — the burn alert never "
+                "engaged at 2x capacity")
+        if rejected_after_overload == 0:
+            failures.append(
+                "overload phase rejected nothing — admission control "
+                "never bit at 2x capacity")
+        if shed_frac >= 0.5:
+            failures.append(
+                f"shed fraction {shed_frac:.3f} unbounded — most "
+                "answers degraded (shedding should be a tail, not the "
+                "service)")
+        # Disengagement: zero shed answers in the late cooldown (the
+        # short burn window has drained by then).
+        shed_late = sum(
+            1 for _, _, r, t in results["cooldown"]
+            if r.get("shed") and t >= cooldown_s / 2
+        )
+        if shed_late:
+            failures.append(
+                f"{shed_late} shed answers in the late cooldown — "
+                "shedding failed to disengage below capacity")
+        verdict = engine.slo_tracker().evaluate()
+        latency = verdict.get("latency") or {}
+        if latency.get("met") is False:
+            failures.append(
+                f"accepted-traffic p{latency.get('pct')} "
+                f"{latency.get('observed_ms')} ms missed the "
+                f"{latency.get('target_ms')} ms target")
+
+        pcts = engine.stats.percentiles()
+        stats = engine.stats
+        detail = {
+            "nodes": n, "edges": g.num_real_edges,
+            "clients": n_clients,
+            "capacity_per_s": round(capacity_qps, 2),
+            "offered_x": 2.0,
+            "overload_s": overload_s, "cooldown_s": cooldown_s,
+            "accepted": accepted,
+            "rejected": rejected_n,
+            "deadline_drops": stats.deadline_drops,
+            "shed_answers": shed_n,
+            "shed_frac": round(shed_frac, 4),
+            "shed_late_cooldown": shed_late,
+            "exact_bitwise_checked": exact_n,
+            "p50_ms": round(pcts["p50_ms"], 4),
+            "p50_err_ms": round(pcts["p50_err_ms"], 4),
+            "p99_ms": round(pcts["p99_ms"], 4),
+            "p99_err_ms": round(pcts["p99_err_ms"], 4),
+            "slo": {
+                "p99_target_ms": round(latency_target_ms, 3),
+                "availability": slo.availability,
+                "verdict": "burn" if verdict["burning"] else "ok",
+                "burn_rate": verdict["burn_rate"],
+                "p99_met": latency.get("met"),
+            },
+        }
+        if failures:
+            detail["failed"] = failures[:10]
+        tel = _BENCH_TELEMETRY.get()
+        if tel is not None and getattr(tel, "trace_dir", None):
+            engine.metrics.write_snapshot(
+                Path(tel.trace_dir) / "serve_overload_live.json"
+            )
+        frontend.drain()  # flushes snapshots + closes the engine
+    return BenchRecord(
+        "serve_overload", backend, preset, wall, 0, 0.0, _n_chips(),
+        detail,
+    )
+
+
 def bench_distributed_fleet(backend: str, preset: str) -> BenchRecord:
     """Config 8 (round-15 tentpole): the distributed solve fleet — N
     local CPU worker processes vs 1 on the SAME graph (README
@@ -1197,6 +1476,7 @@ CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "dirty_window": bench_dirty_window,
     "planner_dispatch": bench_planner_dispatch,
     "serve_queries": bench_serve_queries,
+    "serve_overload": bench_serve_overload,
     "distributed_fleet": bench_distributed_fleet,
     "incremental_update": bench_incremental_update,
 }

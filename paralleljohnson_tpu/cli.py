@@ -519,6 +519,53 @@ def main(argv: list[str] | None = None) -> int:
                               "store dir every N seconds while serving "
                               "(heartbeat idiom — a killed process leaves "
                               "stats fresh to within N; 0 disables)")
+    # Traffic front end (ISSUE 15, README "Traffic front end"): socket
+    # serving with designed overload behavior instead of the stdin loop.
+    p_serve.add_argument("--listen", default=None, metavar="HOST:PORT",
+                         help="serve newline-delimited JSON over TCP "
+                              "instead of the stdin/--queries loop: one "
+                              "protocol header per connection, per-"
+                              "connection worker threads over one shared "
+                              "engine, admission control + certified load "
+                              "shedding + SIGTERM drain; port 0 picks an "
+                              "ephemeral port (announced on stdout)")
+    p_serve.add_argument("--max-connections", type=int, default=64,
+                         help="connection-admission bound: past it a new "
+                              "connection gets one {\"error\": "
+                              "\"overloaded\", \"retry_after_ms\": ...} "
+                              "line and a close (default 64)")
+    p_serve.add_argument("--max-inflight", type=int, default=8,
+                         help="in-flight query bound: past it a request "
+                              "is rejected (or, with deadline_ms, waits "
+                              "up to its own deadline for a slot) "
+                              "instead of queueing unboundedly (default 8)")
+    p_serve.add_argument("--shed-policy", default="landmark",
+                         choices=["landmark", "reject", "off"],
+                         help="overload shedding when the SLO burn alert "
+                              "fires: 'landmark' downgrades exact-MISS "
+                              "queries to flagged {shed: true, exact: "
+                              "false, max_error: ...} landmark answers "
+                              "(hits still answer exactly; implies a "
+                              "landmark index), 'reject' turns misses "
+                              "into overloaded rejections, 'off' never "
+                              "sheds (default landmark)")
+    p_serve.add_argument("--drain-timeout", type=float, default=10.0,
+                         metavar="SECONDS",
+                         help="SIGTERM drain deadline: stop accepting, "
+                              "finish in-flight requests up to this "
+                              "long, force-close stragglers, flush "
+                              "snapshots, exit 0 (default 10)")
+    p_serve.add_argument("--retry-after-ms", type=int, default=100,
+                         help="the retry_after_ms hint carried by "
+                              "overloaded rejections (default 100)")
+    p_serve.add_argument("--shed-min-events", type=int, default=20,
+                         help="low-traffic guard: shedding engages only "
+                              "when the burn verdict is backed by at "
+                              "least this many observations inside the "
+                              "burn rule's long window — one rejection "
+                              "on a near-idle server must not degrade "
+                              "the next answer (default 20; 0 disables "
+                              "the guard)")
     _add_common(p_serve)
 
     p_top = sub.add_parser(
@@ -863,8 +910,54 @@ def main(argv: list[str] | None = None) -> int:
                     "(max_error 0); exact=false landmark answers carry "
                     "|answer - exact| <= max_error, never unflagged"
                 ),
+                # The traffic front end (ISSUE 15, README "Traffic
+                # front end"): socket serving with designed overload
+                # behavior — admission bounds, deadline drops,
+                # burn-triggered certified shedding, SIGTERM drain.
+                "listen": {
+                    "command": "pjtpu serve <graph> --listen HOST:PORT "
+                               "[--max-connections N] [--max-inflight "
+                               "N] [--shed-policy landmark|reject|off] "
+                               "[--drain-timeout S]",
+                    "protocol": (
+                        "newline-delimited JSON over TCP; one header "
+                        "line {protocol: 'pjtpu-serve/1', graph_digest, "
+                        "shed_policy} per connection; requests may add "
+                        "deadline_ms; {'op': 'health'} returns the "
+                        "liveness document"
+                    ),
+                    "admission": (
+                        "past --max-connections / --max-inflight new "
+                        "work gets {'error': 'overloaded', "
+                        "'retry_after_ms': ...} instead of an unbounded "
+                        "queue; a deadline_ms request may wait for a "
+                        "slot up to its own deadline, then drops "
+                        "WITHOUT touching the engine (deadline_drops)"
+                    ),
+                    "shedding": (
+                        "when the SLO burn-rate alert fires (and is "
+                        "backed by >= --shed-min-events observations in "
+                        "the rule's long window — the low-traffic "
+                        "guard), exact-MISS queries degrade to landmark "
+                        "answers flagged {shed: true, exact: false, "
+                        "max_error: ...} — certified bounds, never "
+                        "unflagged; hits still answer exactly; recovers "
+                        "when the burn clears; both transitions emit "
+                        "slo_shed flight events"
+                    ),
+                    "drain": (
+                        "SIGTERM stops accepting, finishes in-flight "
+                        "requests under --drain-timeout, flushes "
+                        "serve_stats.json + serve_live.json "
+                        "(atomically), exits 0; SIGKILL leaves the last "
+                        "periodic snapshots readable"
+                    ),
+                    "chaos_drill": "python scripts/serve_chaos_drill.py "
+                                   "(fault points serve_accept / "
+                                   "serve_lookup / serve_solve)",
+                },
                 "exit_codes": {
-                    "0": "all queries answered",
+                    "0": "all queries answered (or clean SIGTERM drain)",
                     "1": "some queries malformed / bad arguments",
                     "2": "negative cycle during a scheduled solve",
                     "3": "corruption or abandoned stage",
@@ -1373,7 +1466,8 @@ def main(argv: list[str] | None = None) -> int:
             )
             landmarks = None
             k = args.landmarks or (
-                16 if args.miss_policy == "landmark" else 0
+                16 if args.miss_policy == "landmark"
+                or (args.listen and args.shed_policy == "landmark") else 0
             )
             if k > 0:
                 if store.ckpt is not None:
@@ -1396,6 +1490,48 @@ def main(argv: list[str] | None = None) -> int:
                         availability=args.slo_availability),
                 stats_interval_s=args.stats_interval,
             )
+            if args.listen:
+                # Traffic front end (README "Traffic front end"): a
+                # threaded socket server in the foreground until
+                # SIGTERM/SIGINT, then a graceful drain (exit 0).
+                from paralleljohnson_tpu.serve import (
+                    PROTOCOL,
+                    ServeFrontend,
+                    parse_listen,
+                )
+
+                host, port = parse_listen(args.listen)
+                frontend = ServeFrontend(
+                    engine, host=host, port=port,
+                    max_connections=args.max_connections,
+                    max_inflight=args.max_inflight,
+                    shed_policy=args.shed_policy,
+                    drain_timeout_s=args.drain_timeout,
+                    retry_after_ms=args.retry_after_ms,
+                    shed_min_events=args.shed_min_events,
+                    fault_plan=cfg.fault_plan,
+                    heartbeat_file=args.heartbeat_file,
+                ).start()
+                # The announce line scripts/chaos drills parse for the
+                # bound (possibly ephemeral) port.
+                print(json.dumps({
+                    "listening": f"{frontend.address[0]}:"
+                                 f"{frontend.address[1]}",
+                    "host": frontend.address[0],
+                    "port": frontend.address[1],
+                    "protocol": PROTOCOL,
+                    "shed_policy": args.shed_policy,
+                    "max_connections": args.max_connections,
+                    "max_inflight": args.max_inflight,
+                }), flush=True)
+                frontend.run_until_shutdown()
+                if getattr(args, "metrics_file", None):
+                    engine.write_metrics(args.metrics_file,
+                                         labels={"command": "serve"})
+                if args.summary:
+                    print(json.dumps(engine.serve_summary()),
+                          file=sys.stderr)
+                return 0
             stream = (
                 sys.stdin if args.queries == "-"
                 else open(args.queries, encoding="utf-8")

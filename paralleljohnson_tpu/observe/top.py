@@ -114,11 +114,22 @@ def _gather_serve(root: Path, now: float, stale_after_s: float) -> list[dict]:
         if stats is not None:
             engine = stats.get("engine") or {}
             store = stats.get("store") or {}
+            live_summary = _summarize_live(stats.get("live"))
+            shed_rate = ((live_summary.get("rates") or {})
+                         .get("pjtpu_shed_answers") or {}).get("rate_60s")
             entry["serve"] = {
                 "pid": stats.get("pid"),
                 "queries_total": engine.get("queries_total"),
                 "errors": engine.get("errors"),
                 "stale_answers": engine.get("stale_answers"),
+                # Traffic-front-end overload columns (ISSUE 15): how
+                # much of the answer stream is certified-degraded, and
+                # what admission turned away.
+                "shed_answers": engine.get("shed_answers"),
+                "shed_rate_60s": shed_rate,
+                "rejected": engine.get("rejected"),
+                "deadline_drops": engine.get("deadline_drops"),
+                "open_connections": engine.get("open_connections"),
                 "hits_by_tier": engine.get("hits_by_tier"),
                 "p50_ms": engine.get("p50_ms"),
                 "p50_err_ms": engine.get("p50_err_ms"),
@@ -126,7 +137,7 @@ def _gather_serve(root: Path, now: float, stale_after_s: float) -> list[dict]:
                 "p99_err_ms": engine.get("p99_err_ms"),
                 "hit_rate": store.get("hit_rate"),
                 "digest": store.get("digest"),
-                "live": _summarize_live(stats.get("live")),
+                "live": live_summary,
             }
             _flag_stale(entry["serve"], stats.get("ts"), now, stale_after_s)
         if repair is not None:
@@ -274,6 +285,17 @@ def _render_serve(lines: list[str], entries: list[dict]) -> None:
             f"stale-answers {_fmt(s.get('stale_answers'))}   "
             f"errors {_fmt(s.get('errors'))}"
         )
+        # Overload line only when the front end saw any of it — a plain
+        # JSONL-loop serve keeps the old two-line layout.
+        if any(s.get(k) for k in ("shed_answers", "rejected",
+                                  "deadline_drops", "open_connections")):
+            lines.append(
+                f"  shed {_fmt(s.get('shed_answers'))} "
+                f"({_fmt(s.get('shed_rate_60s'))}/s 1m)   "
+                f"rejected {_fmt(s.get('rejected'))}   "
+                f"deadline-drops {_fmt(s.get('deadline_drops'))}   "
+                f"conns {_fmt(s.get('open_connections'))}"
+            )
         for name, slo in (live.get("slos") or {}).items():
             lat = slo.get("latency") or {}
             verdict = "BURNING" if slo.get("burning") else "ok"

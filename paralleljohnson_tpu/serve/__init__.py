@@ -5,7 +5,10 @@ checkpoint-cold), a :class:`QueryEngine` aggregates client queries into
 source-batched lookups and schedules exact solves for misses, and a
 :class:`LandmarkIndex` answers unsolved sources immediately with a
 certified ``(estimate, max_error)`` bound. ``pjtpu serve`` is the CLI
-front end (JSONL request loop)."""
+front end: a JSONL request loop by default, or — with ``--listen`` —
+the :class:`ServeFrontend` threaded socket server with admission
+control, per-request deadlines, burn-rate-triggered certified load
+shedding, and a SIGTERM drain (ISSUE 15)."""
 
 from paralleljohnson_tpu.serve.engine import (
     DEFAULT_SLO,
@@ -14,6 +17,12 @@ from paralleljohnson_tpu.serve.engine import (
     SERVE_PROM_METRICS,
     SERVE_STATS_FILENAME,
     ServeStats,
+)
+from paralleljohnson_tpu.serve.frontend import (
+    PROTOCOL,
+    SHED_POLICIES,
+    ServeFrontend,
+    parse_listen,
 )
 from paralleljohnson_tpu.serve.landmarks import Bounds, LandmarkIndex
 from paralleljohnson_tpu.serve.store import (
@@ -28,10 +37,14 @@ __all__ = [
     "DEFAULT_SLO",
     "DEFAULT_WARM_ROWS",
     "LandmarkIndex",
+    "PROTOCOL",
     "QueryEngine",
     "QueryError",
     "SERVE_PROM_METRICS",
     "SERVE_STATS_FILENAME",
+    "SHED_POLICIES",
+    "ServeFrontend",
     "ServeStats",
     "TileStore",
+    "parse_listen",
 ]

@@ -101,6 +101,14 @@ class ServeStats:
     batches_scheduled: int = 0
     solved_sources: int = 0
     stale_answers: int = 0
+    # Traffic-front-end counters (ISSUE 15): maintained by the socket
+    # frontend (the engine never sheds or rejects by itself) but kept
+    # here so serve_stats.json / pjtpu top / prom all read ONE set of
+    # serving counters regardless of which loop drove the engine.
+    shed_answers: int = 0
+    rejected: int = 0
+    deadline_drops: int = 0
+    open_connections: int = 0
     hits_by_tier: dict = dataclasses.field(default_factory=dict)
     hist: LogHistogram = dataclasses.field(default_factory=LogHistogram)
 
@@ -124,6 +132,10 @@ class ServeStats:
             "batches_scheduled": self.batches_scheduled,
             "solved_sources": self.solved_sources,
             "stale_answers": self.stale_answers,
+            "shed_answers": self.shed_answers,
+            "rejected": self.rejected,
+            "deadline_drops": self.deadline_drops,
+            "open_connections": self.open_connections,
             "hits_by_tier": dict(self.hits_by_tier),
             **{k: round(v, 4) for k, v in self.percentiles().items()},
         }
@@ -151,6 +163,24 @@ SERVE_PROM_METRICS = (
      "Answers served from a pre-update checkpoint while (or after) an "
      "incremental repair ran — every one carries stale: true",
      lambda e: e.stats.stale_answers),
+    # Traffic-front-end counters (ISSUE 15): certified shedding,
+    # admission rejections, deadline drops, live connection gauge.
+    ("pjtpu_shed_answers_total", "counter",
+     "Exact-miss queries downgraded to flagged landmark answers while "
+     "the burn-rate alert fired (every one carries shed: true + a "
+     "certified max_error)",
+     lambda e: e.stats.shed_answers),
+    ("pjtpu_rejected_total", "counter",
+     "Connections/requests rejected by admission control (explicit "
+     "overloaded + retry_after_ms, never an unbounded queue)",
+     lambda e: e.stats.rejected),
+    ("pjtpu_deadline_drops_total", "counter",
+     "Requests dropped because they could not start before their "
+     "deadline_ms (rejected without touching the engine)",
+     lambda e: e.stats.deadline_drops),
+    ("pjtpu_open_connections", "gauge",
+     "Client connections currently open on the socket frontend",
+     lambda e: e.stats.open_connections),
     ("pjtpu_query_hit_rate", "gauge",
      "Fraction of row lookups served by a store tier (hot/warm/cold)",
      lambda e: e.store.hit_rate()),
@@ -234,6 +264,11 @@ class QueryEngine:
         # (TileStore's own lock protects its dicts, but hit counters and
         # the miss->solve->put sequence span many store calls).
         self._lock = threading.RLock()
+        # Closed-engine contract (ISSUE 15 satellite): the frontend's
+        # drain path closes the engine while late connections may still
+        # hold a reference — queries after close must fail with a
+        # diagnosable QueryError, never a racy AttributeError.
+        self._closed = False
         self.stats_interval_s = (
             float(stats_interval_s) if stats_interval_s else 0.0
         )
@@ -308,12 +343,33 @@ class QueryEngine:
         t_batch = time.perf_counter()
         tel = self._tel
         with self._lock:
+            if self._closed:
+                raise QueryError(
+                    "query engine is closed (the serving process drained "
+                    "or shut down; open a new engine over the store)"
+                )
             self._ensure_stats_writer()
             responses = self._query_batch_locked(requests, t_batch, tel)
         return responses
 
+    def _fire_fault(self, stage: str, batch=None) -> None:
+        """Serving-path fault injection (ISSUE 15): fire the FaultPlan's
+        scheduled fault for ``stage`` INSIDE the latency-measured
+        section — an injected ``slow_ms`` inflates the very histogram
+        the SLO burn rules watch (a realistic store stall), an injected
+        ``error`` raises out of :meth:`query_batch` exactly like a real
+        solver/store failure (the frontend converts it to per-request
+        error responses; a direct caller sees the raw failure)."""
+        fp = getattr(self.config, "fault_plan", None)
+        if fp is None:
+            return
+        active = fp.fire(stage, batch=batch)
+        if active is not None:
+            active.wrap(lambda: None)()
+
     def _query_batch_locked(self, requests, t_batch, tel) -> list[dict]:
         with tel.span("serve_batch", n_queries=len(requests)):
+            self._fire_fault("serve_lookup")
             parsed: list[dict | None] = []
             responses: list[dict | None] = []
             for req in requests:
@@ -349,6 +405,8 @@ class QueryEngine:
             if missing_exact:
                 batch = np.asarray(missing_exact, np.int64)
                 with tel.span("serve_solve", n_sources=len(batch)):
+                    self._fire_fault("serve_solve",
+                                     batch=self.stats.batches_scheduled)
                     res = self.solver.solve(self.graph, sources=batch)
                 self.stats.batches_scheduled += 1
                 self.stats.solved_sources += len(batch)
@@ -424,6 +482,31 @@ class QueryEngine:
             out["distance"] = float(vals[0])
         return out
 
+    # -- the front end's hooks (ISSUE 15) ------------------------------------
+
+    def slo_tracker(self):
+        """The live :class:`~paralleljohnson_tpu.observe.live.SLOTracker`
+        for this engine's objective — the burn-state the frontend's
+        shedding decision reads (``tracker.burning`` flips on the same
+        multi-window rules that emit ``slo_burn`` events)."""
+        return self.metrics.slo(self.slo)
+
+    def note_failed_requests(self, n: int = 1) -> None:
+        """File ``n`` requests that died OUTSIDE the batch pipeline (a
+        solve/store exception the frontend converted to error responses)
+        into the same counters + SLO stream a parse error uses — a
+        failure that burned real error budget must never be invisible to
+        the burn-rate alert."""
+        with self._lock:
+            self.stats.errors += n
+        self.metrics.counter("pjtpu_query_errors").add(n)
+        for _ in range(int(n)):
+            self.metrics.observe_slo(self.slo.name, None, ok=False)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
     # -- warm-up and ops surface ---------------------------------------------
 
     def warm(self, sources) -> int:
@@ -431,6 +514,8 @@ class QueryEngine:
         whichever of them the store does not already hold). Returns how
         many sources were actually solved."""
         with self._lock:
+            if self._closed:
+                raise QueryError("query engine is closed")
             missing = [int(s) for s in np.asarray(sources, np.int64)
                        if self.store.get(int(s))[0] is None]
             if not missing:
@@ -540,7 +625,16 @@ class QueryEngine:
         counters next to the store's batches (atomic) so ``pjtpu info
         --serve-store`` / ``pjtpu top`` can report capacity, landmark
         count, and hit rates after the loop exits. Does NOT close the
-        telemetry façade — its owner (the CLI) does."""
+        telemetry façade — its owner (the CLI) does.
+
+        Idempotent (ISSUE 15 satellite): the frontend's drain path and
+        the CLI's finally block may both call it; the second call is a
+        no-op. In-flight batches finish (close waits on the engine
+        lock); queries that arrive after raise :class:`QueryError`."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
         self._stats_stop.set()
         t = self._stats_thread
         if t is not None:

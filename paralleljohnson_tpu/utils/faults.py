@@ -27,12 +27,23 @@ checkpoint writer thread mid-commit, surfacing as
 ``SolveCorruptionError``; a killed commit leaves only an uncommitted
 ``.tmp.npz``, so resume recomputes exactly that batch).
 
+Round-20 serving path: the socket frontend and the query engine fire
+``"serve_accept"`` (per accepted connection), ``"serve_lookup"`` (per
+query batch, before the tier walk) and ``"serve_solve"`` (around each
+scheduled exact-miss solve) — the injection points
+``scripts/serve_chaos_drill.py`` drives.
+
 Kinds:
 - ``"oom"``     raises :class:`InjectedOOMError` (a ``MemoryError``
                 subclass — classified by ``resilience.is_oom_error``
                 exactly like a real ``RESOURCE_EXHAUSTED``).
 - ``"timeout"`` makes the attempt sleep ``sleep_s`` before running, so a
                 watchdog deadline shorter than that abandons the stage.
+- ``"slow_ms"`` makes the attempt sleep ``slow_ms`` MILLISECONDS before
+                running — injected latency, not failure: the attempt
+                still succeeds, just late. The chaos-drill primitive for
+                realistic tail-latency storms (a store stall inflates
+                p99 and burns the SLO budget without erroring anything).
 - ``"error"``   raises :class:`InjectedFaultError` (a generic runtime
                 failure — e.g. a collective/tunnel drop on the sharded
                 path).
@@ -49,7 +60,7 @@ from typing import Callable
 
 import numpy as np
 
-_KINDS = ("oom", "timeout", "error", "nan")
+_KINDS = ("oom", "timeout", "error", "nan", "slow_ms")
 
 
 class InjectedOOMError(MemoryError):
@@ -73,6 +84,7 @@ class Fault:
     batch: int | None = None
     times: int = 1
     sleep_s: float = 30.0
+    slow_ms: float = 50.0  # "slow_ms" kind: injected latency per attempt
     rows: int = 1  # "nan" kind: poison the first ``rows`` rows
 
     def __post_init__(self) -> None:
@@ -80,6 +92,8 @@ class Fault:
             raise ValueError(f"kind must be one of {_KINDS}, got {self.kind!r}")
         if self.attempt < 1 or self.times < 1:
             raise ValueError("attempt and times must be >= 1")
+        if self.slow_ms < 0:
+            raise ValueError(f"slow_ms must be >= 0, got {self.slow_ms}")
 
 
 class _ActiveFault:
@@ -110,6 +124,11 @@ class _ActiveFault:
                 self._sleep(fault.sleep_s)
                 return fn()
             return slow_call
+        if fault.kind == "slow_ms":
+            def late_call():
+                self._sleep(fault.slow_ms / 1e3)
+                return fn()
+            return late_call
         return fn  # "nan": poisoning happens at the call site
 
 

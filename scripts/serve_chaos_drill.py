@@ -1,0 +1,448 @@
+#!/usr/bin/env python
+"""serve-chaos: the traffic front end's chaos drill (ISSUE 15).
+
+PR 3 proved the solver degrades instead of dying; PR 10 proved the
+fleet survives host loss. This drill proves the SERVING path — the one
+hot path ``utils/faults.py`` could not previously reach — holds the
+same line. Three parts, all deterministic (every fault comes from a
+:class:`FaultPlan` schedule, never wall-clock randomness):
+
+1. **Fault storm through real sockets** — concurrent clients hammer an
+   in-process :class:`ServeFrontend` while the plan injects:
+   ``serve_accept`` error (a connection refused with an explicit
+   ``unavailable`` line, not a hang), ``serve_solve`` errors (the
+   scheduled exact-miss solve dies -> ``internal`` error RESPONSES,
+   connections stay usable), and a ``serve_lookup`` ``slow_ms`` storm
+   (a store stall inflating every batch past the SLO latency target ->
+   the burn alert fires -> certified shedding engages). Assertions:
+   zero hung connections, zero unflagged approximations, every exact
+   answer bitwise-identical to a direct solve, every shed answer inside
+   its certified bound, the burn + shed transitions actually happened
+   (``slo_burn`` / ``slo_shed`` flight events on disk), and shedding
+   DISENGAGES once the storm passes.
+2. **SIGTERM drain** — a real ``pjtpu serve --listen`` subprocess is
+   terminated mid-traffic: it must exit 0 with parseable
+   ``serve_stats.json`` / ``serve_live.json``.
+3. **SIGKILL mid-traffic** — same subprocess shape, killed without
+   ceremony: the last periodic atomic snapshots must still parse (the
+   heartbeat idiom, through the socket path).
+
+Run standalone (CPU, seconds):  python scripts/serve_chaos_drill.py
+Staged in scripts/tpu_round3_run.sh as ``serve-chaos``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+failures: list[str] = []
+
+
+def fail(msg: str) -> None:
+    failures.append(msg)
+
+
+def drill_fault_storm(tmp: Path) -> dict:
+    from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+    from paralleljohnson_tpu.graphs import grid2d
+    from paralleljohnson_tpu.observe.live import SLO
+    from paralleljohnson_tpu.serve import (
+        LandmarkIndex,
+        QueryEngine,
+        ServeFrontend,
+        TileStore,
+    )
+    from paralleljohnson_tpu.utils.faults import Fault, FaultPlan
+    from paralleljohnson_tpu.utils.telemetry import Telemetry
+
+    g = grid2d(12, 12, seed=7)  # strongly connected: finite bounds
+    n = g.num_nodes
+    oracle = np.asarray(
+        ParallelJohnsonSolver(SolverConfig(backend="numpy")).solve(g).matrix
+    )
+    plan = FaultPlan([
+        # Connection 2 is refused at accept — an explicit line + close.
+        Fault(stage="serve_accept", kind="error", attempt=2),
+        # The serve_solve and slow_ms faults are armed LATER, pinned to
+        # the live counters (warm() consumes a batch index; an unpinned
+        # solve fault would match attempt 1 of EVERY later batch index
+        # too, injecting failures into the recovery probe).
+    ])
+    tel = Telemetry.create(trace_dir=tmp / "telemetry", label="chaos")
+    cfg = SolverConfig(backend="numpy", fault_plan=plan, telemetry=tel)
+    store = TileStore(tmp / "store", g, warm_rows=n)
+    landmarks = LandmarkIndex.build(g, 6, config=cfg, seed=0)
+    rng = np.random.default_rng(11)
+    warm = np.sort(rng.choice(n, size=n // 2, replace=False))
+    cold = np.array(sorted(set(range(n)) - set(map(int, warm))), np.int64)
+    slo = SLO(name="serve", latency_ms=25.0, latency_pct=99.0,
+              availability=0.9, rules=((10.0, 1.0, 2.0),))
+    engine = QueryEngine(g, store, landmarks=landmarks, config=cfg,
+                         slo=slo, stats_interval_s=0.2)
+    engine.warm(warm)
+    # The next scheduled exact-miss batch dies twice (batch pinned to
+    # the index the query path will actually use).
+    plan.faults.append(
+        Fault(stage="serve_solve", kind="error", attempt=1, times=2,
+              batch=engine.stats.batches_scheduled)
+    )
+    frontend = ServeFrontend(engine, max_connections=16, max_inflight=4,
+                             shed_policy="landmark", fault_plan=plan,
+                             retry_after_ms=20).start()
+    host, port = frontend.address
+
+    # Connection 2 (the injected accept failure) must answer and close,
+    # not hang. Connection order is deterministic: we open it alone.
+    s1 = socket.create_connection((host, port), timeout=20)
+    f1 = s1.makefile("rw", encoding="utf-8", newline="\n")
+    json.loads(f1.readline())  # header: connection 1 admitted
+    s2 = socket.create_connection((host, port), timeout=20)
+    f2 = s2.makefile("r", encoding="utf-8", newline="\n")
+    refused = json.loads(f2.readline())
+    if refused.get("error") != "unavailable":
+        fail(f"injected serve_accept fault did not refuse: {refused}")
+    if f2.readline() != "":
+        fail("refused connection was not closed")
+    s2.close()
+    f1.close()
+    s1.close()
+
+    # Phase A (single client, no concurrency): the injected solve
+    # failures, observed deterministically — two cold queries hit the
+    # two scheduled batch-0 faults and come back as error RESPONSES on
+    # a connection that stays usable. (In the concurrent phase this
+    # would be timing-dependent: real lock-wait latency can trip the
+    # burn alert and shed the cold queries before any solve fires.)
+    sa = socket.create_connection((host, port), timeout=30)
+    sa.settimeout(30)
+    fa = sa.makefile("rw", encoding="utf-8", newline="\n")
+    json.loads(fa.readline())
+    injected_solve_errors = 0
+    for i in range(2):
+        fa.write(json.dumps({"id": f"boom{i}", "source": int(cold[i]),
+                             "dst": 0}) + "\n")
+        fa.flush()
+        r = json.loads(fa.readline())
+        if ("error" in r and r["error"].startswith("internal")
+                and "InjectedFaultError" in r["error"]):
+            injected_solve_errors += 1
+        elif r.get("shed"):
+            pass  # burn from failure 1 may shed query 2 — still honest
+        else:
+            fail(f"injected serve_solve fault answer unexpected: {r}")
+    if injected_solve_errors == 0:
+        fail("injected serve_solve failures never surfaced as error "
+             "responses")
+    # Those failures spent real error budget; drive good traffic on the
+    # same connection until the burn clears (bounded), so phase B starts
+    # from a healthy service.
+    t_clear = time.monotonic()
+    i = 0
+    while engine.slo_tracker().burning and time.monotonic() - t_clear < 20:
+        fa.write(json.dumps({"id": i, "source": int(warm[i % len(warm)]),
+                             "dst": 0}) + "\n")
+        fa.flush()
+        json.loads(fa.readline())
+        i += 1
+        time.sleep(0.005)
+    if engine.slo_tracker().burning:
+        fail("burn never cleared after the injected solve failures")
+    fa.close()
+    sa.close()
+
+    # Arm the store-stall storm relative to the attempts phase A really
+    # consumed: 65 batches at +60 ms each — every one blows the 25 ms
+    # SLO target, burning the error budget mid-phase-B.
+    plan.faults.append(
+        Fault(stage="serve_lookup", kind="slow_ms",
+              attempt=plan.attempts("serve_lookup") + 10, times=65,
+              slow_ms=60.0)
+    )
+
+    # Phase B — the concurrent client storm: fixed per-client schedules,
+    # closed loop (determinism over pacing), socket timeouts as the
+    # hang guard.
+    n_clients, per_client = 4, 60
+    responses: list[tuple[int, int, dict]] = []
+    res_lock = threading.Lock()
+    client_errors: list[str] = []
+    barrier = threading.Barrier(n_clients)
+
+    def client(k: int) -> None:
+        try:
+            sock = socket.create_connection((host, port), timeout=60)
+            sock.settimeout(60)
+            f = sock.makefile("rw", encoding="utf-8", newline="\n")
+            json.loads(f.readline())
+            crng = np.random.default_rng(100 + k)
+            local = []
+            barrier.wait()
+            for i in range(per_client):
+                src = (int(crng.choice(warm)) if crng.random() < 0.6
+                       else int(crng.choice(cold)))
+                dst = int(crng.integers(n))
+                f.write(json.dumps(
+                    {"id": i, "source": src, "dst": dst}) + "\n")
+                f.flush()
+                local.append((src, dst, json.loads(f.readline())))
+            f.close()
+            sock.close()
+            with res_lock:
+                responses.extend(local)
+        except Exception as e:  # noqa: BLE001
+            client_errors.append(f"client {k}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(n_clients)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 120
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+    if any(t.is_alive() for t in threads):
+        fail("HUNG CONNECTIONS: client threads still alive after 120 s")
+    for e in client_errors:
+        fail(e)
+
+    # Grade every response against the oracle.
+    shed_n = internal_n = exact_n = rejected_n = 0
+    for src, dst, r in responses:
+        if "error" in r:
+            if r["error"].startswith("internal"):
+                internal_n += 1
+            elif r["error"] in ("overloaded", "deadline"):
+                rejected_n += 1
+            else:
+                fail(f"unexpected error answer: {r}")
+            continue
+        want = float(oracle[src, dst])
+        if r.get("shed"):
+            shed_n += 1
+            if r.get("exact") is not False or "max_error" not in r:
+                fail(f"shed answer not flagged: {r}")
+            elif not np.isfinite(float(r["max_error"])):
+                fail(f"shed answer with non-finite bound: {r}")
+            elif abs(float(r["distance"]) - want) > float(r["max_error"]) + 1e-9:
+                fail(f"shed answer outside certified bound: {r} vs {want}")
+        elif r.get("exact") is True:
+            exact_n += 1
+            if float(r["distance"]) != want:
+                fail(f"exact answer not bitwise: s={src} t={dst} "
+                     f"{r['distance']} != {want}")
+        else:
+            fail(f"unflagged approximate answer: {r}")
+
+    if shed_n == 0:
+        fail("the slow_ms storm never engaged shedding (no shed answers)")
+
+    # Recovery: the storm schedule is exhausted; drive good traffic
+    # until the short burn window drains, then verify a cold query
+    # answers exactly again (shedding disengaged).
+    recovered = False
+    sock = socket.create_connection((host, port), timeout=30)
+    sock.settimeout(30)
+    f = sock.makefile("rw", encoding="utf-8", newline="\n")
+    json.loads(f.readline())
+    t_rec = time.monotonic()
+    i = 0
+    while time.monotonic() - t_rec < 20.0:
+        src = int(warm[i % len(warm)])
+        f.write(json.dumps({"id": i, "source": src, "dst": 0}) + "\n")
+        f.flush()
+        json.loads(f.readline())
+        i += 1
+        if not frontend.shed_active and time.monotonic() - t_rec > 1.2:
+            recovered = True
+            break
+        time.sleep(0.01)
+    if not recovered:
+        fail("shedding never disengaged after the storm cleared")
+    else:
+        probe_cold = int(cold[-1])
+        f.write(json.dumps({"id": "post", "source": probe_cold,
+                            "dst": 1}) + "\n")
+        f.flush()
+        post = json.loads(f.readline())
+        if post.get("exact") is not True or post.get("shed"):
+            fail(f"post-recovery cold query not exact: {post}")
+        elif float(post["distance"]) != float(oracle[probe_cold, 1]):
+            fail("post-recovery exact answer not bitwise")
+    f.close()
+    sock.close()
+
+    frontend.drain()
+    tel.close()
+
+    # The transitions must be on disk as flight events.
+    flight = tmp / "telemetry" / "flight-chaos.jsonl"
+    events = []
+    if flight.exists():
+        for line in flight.read_text().splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("type") == "event":
+                events.append(rec["name"])
+    if "slo_burn" not in events:
+        fail("no slo_burn flight event recorded")
+    engaged = sum(1 for e in events if e == "slo_shed")
+    if engaged < 2:
+        fail(f"expected slo_shed events for BOTH transitions, got {engaged}")
+
+    stats_file = store.ckpt.dir / "serve_stats.json"
+    try:
+        json.loads(stats_file.read_text())
+    except (OSError, ValueError) as e:
+        fail(f"serve_stats.json unreadable after drain: {e}")
+    return {
+        "responses": len(responses), "exact": exact_n, "shed": shed_n,
+        "internal_errors": injected_solve_errors + internal_n,
+        "rejected": rejected_n,
+        "slo_shed_events": engaged,
+    }
+
+
+_SERVE_ARGS = [
+    "serve", "grid:rows=10,cols=10", "--backend", "numpy",
+    "--listen", "127.0.0.1:0", "--landmarks", "4",
+    "--stats-interval", "0.2", "--drain-timeout", "10",
+]
+
+
+def _spawn_serve(tmp: Path, name: str) -> tuple[subprocess.Popen, dict, Path]:
+    store = tmp / name
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paralleljohnson_tpu.cli",
+         *_SERVE_ARGS, "--store-dir", str(store)],
+        cwd=REPO, stdout=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    announce = json.loads(proc.stdout.readline())
+    return proc, announce, store
+
+
+def _traffic(announce: dict, n_queries: int) -> int:
+    sock = socket.create_connection(
+        (announce["host"], announce["port"]), timeout=60)
+    sock.settimeout(60)
+    f = sock.makefile("rw", encoding="utf-8", newline="\n")
+    json.loads(f.readline())
+    done = 0
+    for i in range(n_queries):
+        f.write(json.dumps({"id": i, "source": i % 100,
+                            "dst": (i * 7) % 100}) + "\n")
+        f.flush()
+        r = json.loads(f.readline())
+        if "error" not in r:
+            done += 1
+    return done
+
+
+def _snapshots_readable(store: Path, *, expect_queries: int) -> None:
+    stats = sorted(store.glob("graph_*/serve_stats.json"))
+    if not stats:
+        fail(f"no serve_stats.json under {store}")
+        return
+    try:
+        payload = json.loads(stats[0].read_text())
+    except ValueError as e:
+        fail(f"torn serve_stats.json: {e}")
+        return
+    if payload["engine"]["queries_total"] < expect_queries:
+        fail(f"serve_stats.json counters too stale: "
+             f"{payload['engine']['queries_total']} < {expect_queries}")
+    for live in store.glob("graph_*/serve_live.json"):
+        try:
+            json.loads(live.read_text())
+        except ValueError as e:
+            fail(f"torn serve_live.json: {e}")
+
+
+def drill_sigterm(tmp: Path) -> dict:
+    proc, announce, store = _spawn_serve(tmp, "sigterm_store")
+    try:
+        answered = _traffic(announce, 30)
+        os.kill(proc.pid, signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    if rc != 0:
+        fail(f"SIGTERM drain exited {rc}, want 0")
+    _snapshots_readable(store, expect_queries=answered)
+    return {"answered": answered, "exit_code": rc}
+
+
+def drill_sigkill(tmp: Path) -> dict:
+    proc, announce, store = _spawn_serve(tmp, "sigkill_store")
+    try:
+        answered = _traffic(announce, 30)
+        # Let at least one periodic publish land, then kill without
+        # ceremony — no atexit, no finally.
+        deadline = time.monotonic() + 30
+        stats = None
+        while time.monotonic() < deadline:
+            found = sorted(store.glob("graph_*/serve_stats.json"))
+            if found:
+                try:
+                    stats = json.loads(found[0].read_text())
+                except ValueError:
+                    stats = None
+                if stats and stats["engine"]["queries_total"] >= 1:
+                    break
+            time.sleep(0.05)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    _snapshots_readable(store, expect_queries=1)
+    return {"answered": answered}
+
+
+def main() -> int:
+    argparse.ArgumentParser(description=__doc__.splitlines()[0]).parse_args()
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory() as d:
+        tmp = Path(d)
+        storm = drill_fault_storm(tmp)
+        sigterm = drill_sigterm(tmp)
+        sigkill = drill_sigkill(tmp)
+    for f in failures[:20]:
+        print("FAIL:", f)
+    if failures:
+        print(f"FAIL serve-chaos: {len(failures)} failures")
+        return 1
+    print(
+        f"PASS serve-chaos in {time.monotonic() - t0:.1f}s: "
+        f"{storm['responses']} graded responses "
+        f"({storm['exact']} bitwise-exact, {storm['shed']} certified-shed, "
+        f"{storm['internal_errors']} injected-solve errors, "
+        f"{storm['rejected']} rejected, "
+        f"{storm['slo_shed_events']} slo_shed transitions), "
+        f"SIGTERM drain rc=0 with readable snapshots "
+        f"({sigterm['answered']} answered), SIGKILL snapshots readable "
+        f"({sigkill['answered']} answered)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

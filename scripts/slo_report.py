@@ -20,6 +20,14 @@ snapshotter appends one compact line per publish), the burn-rate
 HISTORY: per SLO, the trajectory of burn rates across publishes, time
 spent burning, and the worst window.
 
+Traffic-front-end post-mortems (ISSUE 15): snapshots carrying the shed
+/ rejection counters get a SHEDDING line (what fraction of the answer
+stream was certified-degraded, what admission turned away), the
+history section tracks when the shed counter was actually moving
+across publishes, and flight-recorder JSONLs beside the snapshots are
+scanned for the ``slo_burn`` / ``slo_shed`` transition events — the
+exact moments shedding engaged and disengaged.
+
 No jax, no numpy, no package import: ``observe/live.py`` is loaded
 standalone (the ``cost_report.py`` pattern), safe on any log-analysis
 box.
@@ -113,6 +121,17 @@ def report_snapshot(path: Path, snap: dict, out=sys.stdout) -> None:
             f"p99 {_fmt(h.get('p99_ms'))}±{_fmt(h.get('p99_err_ms'))} ms  "
             f"max {_fmt(h.get('max'))} ms", file=out,
         )
+    shed = (counters.get("pjtpu_shed_answers") or {}).get("total")
+    if shed is not None:
+        answered = (counters.get("pjtpu_queries") or {}).get("total") or 0
+        rejected = (counters.get("pjtpu_rejected") or {}).get("total") or 0
+        drops = ((counters.get("pjtpu_deadline_drops") or {})
+                 .get("total") or 0)
+        frac = shed / answered if answered else 0.0
+        print(f"  shedding: {_fmt(shed, 0)} certified-degraded answers "
+              f"({_fmt(100 * frac)}% of {_fmt(answered, 0)} answered)  "
+              f"rejected {_fmt(rejected, 0)}  deadline-drops "
+              f"{_fmt(drops, 0)}", file=out)
     for name, s in sorted((snap.get("slos") or {}).items()):
         verdict = "BURNING" if s.get("burning") else "ok"
         print(f"  slo     {name:<34} {verdict}  "
@@ -133,6 +152,50 @@ def report_snapshot(path: Path, snap: dict, out=sys.stdout) -> None:
                   f"{_fmt(rule.get('burn_short'))} "
                   f"(threshold {_fmt(rule.get('threshold'), 1)})"
                   + ("  FIRING" if rule.get("firing") else ""), file=out)
+
+
+def report_shed_events(path: Path, out=sys.stdout) -> None:
+    """Scan one flight-recorder JSONL for the burn/shed transition
+    events (``slo_burn`` fires on not-burning -> burning, ``slo_shed``
+    on every shedding engage/disengage) and print the timeline — when
+    shedding engaged, what the burn rate was, and how many answers it
+    had covered by then. Torn trailing lines are tolerated (the
+    flight-recorder convention: a killed writer tears at most the last
+    line)."""
+    try:
+        raw = path.read_text(encoding="utf-8").splitlines()
+    except OSError:
+        return
+    events = []
+    for line in raw:
+        if '"event"' not in line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn line: kill damage, not report damage
+        if rec.get("type") == "event" and rec.get("name") in (
+                "slo_burn", "slo_shed"):
+            events.append(rec)
+    if not events:
+        return
+    print(f"\n{path} — {len(events)} burn/shed transition event(s)",
+          file=out)
+    for rec in events:
+        attrs = rec.get("attrs") or {}
+        if rec["name"] == "slo_burn":
+            print(f"  t={_fmt(rec.get('t'), 3)}s slo_burn "
+                  f"slo={attrs.get('slo')} "
+                  f"burn {_fmt(attrs.get('burn_rate'))} "
+                  f"bad {_fmt(attrs.get('bad_total'), 0)}", file=out)
+        else:
+            state = "ENGAGED" if attrs.get("engaged") else "disengaged"
+            print(f"  t={_fmt(rec.get('t'), 3)}s slo_shed {state} "
+                  f"policy={attrs.get('policy')} "
+                  f"burn {_fmt(attrs.get('burn_rate'))} "
+                  f"shed-so-far {_fmt(attrs.get('shed_answers'), 0)} "
+                  f"rejected-so-far {_fmt(attrs.get('rejected'), 0)}",
+                  file=out)
 
 
 def report_history(path: Path, out=sys.stdout) -> None:
@@ -164,6 +227,29 @@ def report_history(path: Path, out=sys.stdout) -> None:
             for _, s in tail
         )
         print(f"      trajectory (newest {len(tail)}): {marks}", file=out)
+    # Shed-counter trajectory (ISSUE 15): which publishes saw the
+    # certified-degrade tier actually covering answers — the offline
+    # "when did shedding engage and how much did it carry" view.
+    sheds = [
+        (line.get("ts"), (line.get("counters") or {})
+         .get("pjtpu_shed_answers"))
+        for line in lines
+        if (line.get("counters") or {}).get("pjtpu_shed_answers")
+        is not None
+    ]
+    if sheds and sheds[-1][1]:
+        active = sum(
+            1 for (_, a), (_, b) in zip(sheds, sheds[1:]) if b > a
+        )
+        total = sheds[-1][1]
+        answered = (lines[-1].get("counters") or {}).get("pjtpu_queries")
+        frac = (f" ({_fmt(100 * total / answered)}% of "
+                f"{_fmt(answered, 0)} answered)" if answered else "")
+        print(
+            f"  shed: {_fmt(total, 0)} certified-degraded answers"
+            f"{frac}; counter moving in {active}/{max(1, len(sheds) - 1)} "
+            "publish interval(s)", file=out,
+        )
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -202,6 +288,17 @@ def main(argv: list[str] | None = None) -> int:
     )
     for h in histories:
         report_history(h)
+    # Flight recorders beside the snapshots: the slo_burn / slo_shed
+    # transition timeline (ISSUE 15).
+    flights = (
+        sorted(p for p in root.rglob("*.jsonl")
+               if not p.name.endswith("_history.jsonl"))
+        if root.is_dir()
+        else sorted(p for p in root.parent.glob("*.jsonl")
+                    if not p.name.endswith("_history.jsonl"))
+    )
+    for f in flights:
+        report_shed_events(f)
     return 0
 
 

@@ -214,6 +214,24 @@ run 900 serve-smoke python scripts/serve_smoke.py
 #     the detail column — serving performance tracked like kernels)
 run 900 jax-serve-bench python -m paralleljohnson_tpu.cli bench serve_queries --backend jax --preset full --update-baseline BASELINE.md
 
+# 4g') traffic-front-end chaos drill (ISSUE 15 tentpole): injected
+#      serve_accept/serve_lookup/serve_solve faults through real
+#      sockets under concurrent clients — zero hung connections, zero
+#      unflagged approximations, bitwise-exact non-shed answers,
+#      slo_burn/slo_shed transitions on disk, SIGTERM drain rc=0 and
+#      SIGKILL snapshots readable. CPU workers by design (it must
+#      never dial the single-tenant tunnel), so it rides any window
+#      state.
+run 600 serve-chaos env JAX_PLATFORMS=cpu python scripts/serve_chaos_drill.py
+
+# 4g'') the recorded overload bench row (ISSUE 15): K socket clients at
+#       ~2x the calibrated capacity — the detail column must show an
+#       in-SLO p99 for accepted traffic, a nonzero-but-bounded shed
+#       fraction with every shed answer inside a finite certified
+#       bound, explicit rejections (never an unbounded queue), and
+#       shedding disengaging in the cooldown phase
+run 900 jax-serve-overload python -m paralleljohnson_tpu.cli bench serve_overload --backend jax --preset full --update-baseline BASELINE.md
+
 # 4h) dense-APSP blocked-FW bench row (round-13 tentpole): blocked
 #     min-plus Floyd-Warshall vs min-plus squaring on the same graph,
 #     BITWISE-checked (integer weights); the detail column must carry

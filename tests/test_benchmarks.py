@@ -10,14 +10,17 @@ from paralleljohnson_tpu import benchmarks
 
 
 # The dirty-window and planner-dispatch configs force-measure several
-# kernel schedules (compile-heavy) — their smoke rows ride the slow set
-# (suite-budget trims, ISSUE 13/14); each has dedicated slow validation
-# (tests/test_dirty_window.py, tests/test_planner.py bench smoke).
+# kernel schedules (compile-heavy) and serve_overload drives real
+# wall-clock overload/cooldown phases — their smoke rows ride the slow
+# set (suite-budget trims, ISSUE 13/14/15); each has dedicated slow
+# validation (tests/test_dirty_window.py, tests/test_planner.py,
+# test_serve_overload_contract below).
 @pytest.mark.parametrize(
     "name",
     [
         pytest.param(n, marks=pytest.mark.slow)
-        if n in ("dirty_window", "planner_dispatch") else n
+        if n in ("dirty_window", "planner_dispatch", "serve_overload")
+        else n
         for n in sorted(benchmarks.CONFIGS)
     ],
 )
@@ -32,9 +35,32 @@ def test_config_smoke(name):
         # the request path, not kernel compute).
         assert line["detail"]["queries_per_s"] > 0
         assert line["detail"]["p99_ms"] >= line["detail"]["p50_ms"] > 0
+    elif name == "serve_overload":
+        assert "failed" not in line["detail"], line["detail"]["failed"]
     else:
         assert rec.edges_relaxed > 0
         assert line["edges_relaxed_per_sec_per_chip"] > 0
+
+
+@pytest.mark.slow
+def test_serve_overload_contract():
+    """ISSUE 15 acceptance: at ~2x calibrated capacity through real
+    sockets, accepted traffic holds the SLO, the shed fraction is
+    nonzero but bounded, every shed answer carries a finite certified
+    bound (graded in-bench against the direct solve), non-shed answers
+    are bitwise-exact, admission rejects explicitly, and shedding
+    disengages in the cooldown phase."""
+    (rec,) = benchmarks.run(["serve_overload"], backend="numpy",
+                            preset="smoke")
+    d = rec.detail
+    assert "failed" not in d, d["failed"]
+    assert d["shed_answers"] > 0
+    assert 0.0 < d["shed_frac"] < 0.5
+    assert d["rejected"] > 0
+    assert d["shed_late_cooldown"] == 0
+    assert d["exact_bitwise_checked"] > 0
+    assert d["slo"]["p99_met"] in (True, "within-error-bound")
+    assert d["capacity_per_s"] > 0 and d["offered_x"] == 2.0
 
 
 def test_unknown_preset_rejected():

@@ -87,6 +87,41 @@ def test_fault_plan_attempt_counting():
         Fault(stage="s", kind="explode")
 
 
+def test_slow_ms_fault_kind_fires_per_stage_batch_attempt():
+    """ISSUE 15 satellite: the injected-latency kind fires on exactly
+    the scheduled (stage, batch, attempt) like every other kind, sleeps
+    ``slow_ms`` MILLISECONDS through the plan's injectable sleeper, and
+    still runs the wrapped call (latency, not failure)."""
+    sleeps: list[float] = []
+    plan = FaultPlan(
+        [Fault(stage="serve_lookup", kind="slow_ms", attempt=2, times=2,
+               slow_ms=80.0)],
+        sleep=sleeps.append,
+    )
+    calls: list[int] = []
+
+    def run_attempt():
+        active = plan.fire("serve_lookup")
+        fn = (lambda: calls.append(1) or "ok")
+        if active is not None:
+            fn = active.wrap(fn)
+        return fn()
+
+    assert run_attempt() == "ok"       # attempt 1: clean, no sleep
+    assert sleeps == []
+    assert run_attempt() == "ok"       # attempt 2: +80 ms, still runs
+    assert run_attempt() == "ok"       # attempt 3: +80 ms (times=2)
+    assert run_attempt() == "ok"       # attempt 4: clean again
+    assert sleeps == [0.08, 0.08]
+    assert len(calls) == 4             # every attempt completed
+    assert [k for (_, _, _, k) in plan.fired] == ["slow_ms", "slow_ms"]
+    # batch keys count independently, like the other kinds.
+    assert plan.fire("serve_lookup", batch=3) is None
+    assert plan.attempts("serve_lookup", 3) == 1
+    with pytest.raises(ValueError, match="slow_ms"):
+        Fault(stage="s", kind="slow_ms", slow_ms=-1.0)
+
+
 # -- OOM degradation ---------------------------------------------------------
 
 

@@ -284,6 +284,28 @@ def test_query_errors_survive_the_batch(tmp_path):
         engine.query(-1, 0)
 
 
+def test_engine_close_idempotent_and_query_after_close_raises(tmp_path):
+    """ISSUE 15 satellite: the frontend's drain path closes the engine
+    while late connections may still hold a reference — close must be
+    idempotent, and queries after close must fail with a diagnosable
+    QueryError (never a racy AttributeError)."""
+    g = erdos_renyi(16, 0.2, seed=17)
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg(),
+                         stats_interval_s=0)
+    engine.query(0, 1)
+    engine.close()
+    engine.close()  # second close: no-op, no exception
+    assert engine.closed
+    with pytest.raises(QueryError, match="closed"):
+        engine.query(2, 3)
+    with pytest.raises(QueryError, match="closed"):
+        engine.query_batch([{"source": 2, "dst": 3}])
+    with pytest.raises(QueryError, match="closed"):
+        engine.warm([4, 5])
+    # Nothing leaked into the counters from the refused queries.
+    assert engine.stats.queries_total == 1
+
+
 def test_serve_prom_metrics(tmp_path):
     g = erdos_renyi(16, 0.2, seed=15)
     engine = QueryEngine(g, TileStore(tmp_path / "store", g), config=_cfg())

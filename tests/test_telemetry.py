@@ -390,6 +390,42 @@ def test_heartbeat_atomicity_hammer(tmp_path):
     assert hb.write_errors == 0
 
 
+@pytest.mark.parametrize("content", [
+    "",                                  # zero-byte file (open + kill)
+    '{"ts": 123.0, "stage": "fan',       # torn mid-object
+    '{"ts": 123.0}{"ts": 124.0}',        # concatenated garbage
+    "not json at all",
+])
+def test_read_heartbeat_torn_partial_files(tmp_path, content):
+    """ISSUE 15 satellite: the frontend's health endpoint reads
+    heartbeat files written by OTHER processes — non-atomic writers (or
+    a cp caught mid-copy) can present torn/partial JSON. The contract:
+    ``read_heartbeat`` raises (atomic writers make torn reads a real
+    anomaly worth surfacing), ``heartbeat_age_s`` propagates that, and
+    the liveness verdict ``heartbeat_fresh`` degrades to False — an
+    unreadable beat never vouches for anyone."""
+    from paralleljohnson_tpu.utils.telemetry import heartbeat_fresh
+
+    path = tmp_path / "hb.json"
+    path.write_text(content)
+    with pytest.raises(ValueError):
+        read_heartbeat(path)
+    with pytest.raises(ValueError):
+        heartbeat_age_s(path)
+    assert heartbeat_fresh(path, stale_s=1e9) is False
+
+
+def test_heartbeat_fresh_verdicts(tmp_path):
+    from paralleljohnson_tpu.utils.telemetry import heartbeat_fresh
+
+    path = tmp_path / "hb.json"
+    assert heartbeat_fresh(path, stale_s=60.0) is False  # absent
+    path.write_text(json.dumps({"ts": time.time()}))
+    assert heartbeat_fresh(path, stale_s=60.0) is True
+    path.write_text(json.dumps({"ts": time.time() - 120.0}))
+    assert heartbeat_fresh(path, stale_s=60.0) is False  # stale
+
+
 def test_heartbeat_staleness_clock(tmp_path):
     hb = HeartbeatReporter(tmp_path / "hb.json", interval_s=5.0)
     assert heartbeat_age_s(tmp_path / "hb.json") is None  # absent

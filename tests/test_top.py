@@ -121,6 +121,55 @@ def test_cli_top_requires_a_target(capsys):
     assert "needs --serve-store" in capsys.readouterr().err
 
 
+def test_top_shed_rate_column(tmp_path):
+    """ISSUE 15: a serve entry whose frontend shed/rejected under
+    overload surfaces those counters (plus the 60 s shed RATE from the
+    live snapshot) in the gathered document and the rendered console;
+    a plain JSONL-loop serve (all zeros) keeps the old layout."""
+    import time as _time
+
+    from paralleljohnson_tpu.serve.engine import SERVE_STATS_FILENAME
+
+    d = tmp_path / "graph_feed"
+    d.mkdir(parents=True)
+    now = _time.time()
+    (d / SERVE_STATS_FILENAME).write_text(json.dumps({
+        "ts": now, "pid": 1234,
+        "engine": {
+            "queries_total": 100, "errors": 2, "stale_answers": 0,
+            "shed_answers": 17, "rejected": 9, "deadline_drops": 3,
+            "open_connections": 4,
+            "p50_ms": 1.0, "p50_err_ms": 0.1,
+            "p99_ms": 5.0, "p99_err_ms": 0.5,
+            "hits_by_tier": {"hot": 83},
+        },
+        "store": {"hit_rate": 0.9, "digest": "feed"},
+        "live": {
+            "kind": "live_metrics",
+            "counters": {
+                "pjtpu_queries": {"total": 100, "rate_60s": 10.0},
+                "pjtpu_shed_answers": {"total": 17, "rate_60s": 1.7},
+            },
+        },
+    }))
+    doc = gather_ops(serve_store=tmp_path, now=now)
+    s = doc["serve"][0]["serve"]
+    assert s["shed_answers"] == 17 and s["shed_rate_60s"] == 1.7
+    assert s["rejected"] == 9 and s["deadline_drops"] == 3
+    assert s["open_connections"] == 4
+    text = render_ops(doc)
+    assert "shed 17 (1.70/s 1m)" in text
+    assert "rejected 9" in text and "deadline-drops 3" in text
+    # All-zero overload counters: the overload line is omitted.
+    payload = json.loads((d / SERVE_STATS_FILENAME).read_text())
+    for k in ("shed_answers", "rejected", "deadline_drops",
+              "open_connections"):
+        payload["engine"][k] = 0
+    (d / SERVE_STATS_FILENAME).write_text(json.dumps(payload))
+    text = render_ops(gather_ops(serve_store=tmp_path, now=now))
+    assert "rejected" not in text and "deadline-drops" not in text
+
+
 def test_top_tolerates_missing_sources(tmp_path):
     """Absent serve stats / a dir that is not a coordinator: the
     console reports what it can instead of crashing (an ops tool must

@@ -60,6 +60,9 @@ def test_blocked_routes_and_matches_plain(small_vm_block):
     np.testing.assert_allclose(np.asarray(res.dist), want, rtol=1e-4, atol=1e-3)
 
 
+@pytest.mark.slow  # ISSUE 15 suite-budget trim (~1.9 s full Johnson at
+# V=1500); the device-weight structure reuse it guards stays tier-1 via
+# test_structure_cache_shared_across_reweight on the small fixture
 def test_blocked_survives_reweight(small_vm_block):
     """Full Johnson on a negative-weight graph: the fan-out runs on the
     REWEIGHTED graph, whose weights exist only on device — the blocked
