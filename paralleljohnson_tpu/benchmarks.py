@@ -95,7 +95,7 @@ _SIZES = {
                           sources=4,   mini_sources=4,   full_sources=8),
     "serve_queries": dict(n=256,       mini_n=1024,      full_n=4096,
                           queries=200, mini_queries=2000, full_queries=20000,
-                          clients=4,   mini_clients=4,   full_clients=8),
+                          clients=16,  mini_clients=16,  full_clients=32),
     "serve_overload": dict(rows=12,    mini_rows=20,     full_rows=40,
                           clients=4,   mini_clients=6,   full_clients=8,
                           overload_s=2.5, mini_overload_s=4.0,
@@ -835,13 +835,28 @@ def bench_serve_queries(backend: str, preset: str) -> BenchRecord:
     each client sleeps to its own send schedule, and the detail column
     reports the STREAMING histogram p50/p99 with their one-bucket error
     bounds plus the SLO burn verdict — the row is the CPU twin of the
-    staged `jax-serve-bench` stage."""
+    staged `jax-serve-bench` stage.
+
+    Since ISSUE 16 the row also carries a ``lookup`` contrast block:
+    the SAME request mix replayed closed-loop by K >= 16 concurrent
+    clients through a shared :class:`MicroBatcher`, once with the host
+    tier walk forced and once with the device megabatch path forced.
+    The two response sets must be BITWISE identical (the planner's
+    bit-for-bit promise, asserted here, not assumed), and the block
+    records both walls, the speedup, and the auto planner's why-line
+    for this platform."""
+    import json as _json
     import tempfile
     import threading
 
     from paralleljohnson_tpu.graphs import erdos_renyi
     from paralleljohnson_tpu.observe.live import SLO
-    from paralleljohnson_tpu.serve import LandmarkIndex, QueryEngine, TileStore
+    from paralleljohnson_tpu.serve import (
+        LandmarkIndex,
+        MicroBatcher,
+        QueryEngine,
+        TileStore,
+    )
 
     n = _sz("serve_queries", "n", preset)
     n_queries = _sz("serve_queries", "queries", preset)
@@ -955,6 +970,65 @@ def bench_serve_queries(backend: str, preset: str) -> BenchRecord:
                 / max(1, engine.stats.queries_total), 4,
             ),
         }
+        # -- host vs device lookup contrast (ISSUE 16) --------------------
+        # Same store, same mix, closed loop: K clients hammer a shared
+        # MicroBatcher so the engine sees device-width batches, once
+        # per forced path. Wall times compare the LOOKUP paths alone.
+        def _lookup_phase(mode: str) -> tuple[float, list, "QueryEngine"]:
+            eng = QueryEngine(g, store, landmarks=landmarks, config=cfg,
+                              miss_policy="landmark", device_lookup=mode)
+            mb = MicroBatcher(eng, max_width=max(16, n_clients))
+            out: list = [None] * len(requests)
+            gate = threading.Barrier(n_clients + 1)
+            errs: list[BaseException] = []
+
+            def worker(k: int) -> None:
+                try:
+                    gate.wait()
+                    for req in requests[k::n_clients]:
+                        out[req["id"]] = mb.submit(req)
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=worker, args=(k,),
+                                   name=f"lookup-{mode}-{k}")
+                  for k in range(n_clients)]
+            for t in ts:
+                t.start()
+            gate.wait()
+            t1 = time.perf_counter()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t1
+            if errs:
+                raise errs[0]
+            return dt, out, eng
+
+        wall_host, host_out, host_eng = _lookup_phase("off")
+        wall_dev, dev_out, dev_eng = _lookup_phase("on")
+        bitwise = (_json.dumps(host_out, sort_keys=True)
+                   == _json.dumps(dev_out, sort_keys=True))
+        # What would AUTO pick here? One batch through an auto engine
+        # records the planner's decision + why-line for this platform.
+        auto_eng = QueryEngine(g, store, landmarks=landmarks, config=cfg,
+                               miss_policy="landmark")
+        auto_eng.query_batch(requests[: max(16, n_clients)])
+        detail["lookup"] = {
+            "clients": n_clients,
+            "wall_host_s": round(wall_host, 4),
+            "wall_device_s": round(wall_dev, 4),
+            "speedup": round(wall_host / max(wall_dev, 1e-9), 3),
+            "bitwise_identical": bitwise,
+            "device_lookups": dev_eng.stats.device_lookups,
+            "host_lookups": host_eng.stats.host_lookups,
+            "auto_decision": auto_eng.last_lookup_decision,
+        }
+        for e in (host_eng, dev_eng, auto_eng):
+            e.close()
+        if not bitwise:
+            # A parity break is a wrong-answer bug, not a slow bench.
+            detail["failed"] = "host/device lookup answers diverged"
+
         # Leave the live snapshot beside the flight recorder when the
         # pass runs with telemetry (tpu_round3_run.sh preserves the dir;
         # the slo-report stage reads it offline).

@@ -501,6 +501,32 @@ def main(argv: list[str] | None = None) -> int:
     p_serve.add_argument("--batch-queries", type=int, default=64,
                          help="aggregate up to this many request lines "
                               "into one source-batched lookup")
+    # Device-resident lookups (ISSUE 16, README "Serving queries"):
+    # the planner prices host tier walk vs device megabatch per batch.
+    p_serve.add_argument("--device-lookup", default="auto",
+                         choices=["auto", "on", "off"],
+                         help="lookup path dispatch: 'auto' lets the "
+                              "planner price host tier walk vs device "
+                              "megabatch per batch (bit-for-bit "
+                              "identical answers), 'on'/'off' force "
+                              "one path (default: auto)")
+    p_serve.add_argument("--landmark-picker", default="uniform",
+                         choices=["uniform", "coverage"],
+                         help="pivot picker for a freshly built "
+                              "landmark index: 'coverage' weights "
+                              "candidates by degree (hub coverage), "
+                              "'uniform' is the reproducible default")
+    p_serve.add_argument("--batch-window", type=int, default=None,
+                         metavar="W",
+                         help="micro-batch up to W concurrent socket "
+                              "requests into one engine batch "
+                              "(--listen only; default 32; 1 disables)")
+    p_serve.add_argument("--batch-wait-ms", type=float, default=None,
+                         metavar="MS",
+                         help="optional fixed window the micro-batch "
+                              "leader waits to accumulate followers "
+                              "(default 0: width comes only from the "
+                              "convoy — no idle-server latency tax)")
     p_serve.add_argument("--summary", action="store_true",
                          help="print the serving summary JSON (engine + "
                               "store counters, hit rate) to stderr at exit")
@@ -908,7 +934,51 @@ def main(argv: list[str] | None = None) -> int:
                 "answer_contract": (
                     "exact=true answers are bitwise the solver's rows "
                     "(max_error 0); exact=false landmark answers carry "
-                    "|answer - exact| <= max_error, never unflagged"
+                    "|answer - exact| <= max_error, never unflagged; "
+                    "stale=true answers (pre-update rows) additionally "
+                    "carry a landmark-derived max_error drift estimate"
+                ),
+                # Device-resident lookups (ISSUE 16): the planner
+                # prices the two lookup routes per aggregated batch;
+                # forcing either path reproduces the other bit for bit.
+                "device_lookup": {
+                    "flags": "--device-lookup auto|on|off "
+                             "[--batch-window W] [--batch-wait-ms MS]",
+                    "paths": {
+                        "host_lookup": "per-source tier walk (hot/"
+                                       "warm/cold), the measured "
+                                       "default on cpu",
+                        "device_lookup": "megabatched gathers over the "
+                                         "stacked [B, V] hot tile + "
+                                         "on-device landmark bounds, "
+                                         "one launch per query class "
+                                         "per batch",
+                    },
+                    "contract": (
+                        "bit-for-bit identical answers on every path: "
+                        "exact hits move f32 bits; raw landmark bounds "
+                        "(add/sub + min/max, f64) compute on device, "
+                        "the tolerance widening and estimate finishing "
+                        "always run on host through shared helpers; "
+                        "TPU (no native f64) keeps landmark bounds on "
+                        "host — the why-line says so"
+                    ),
+                    "micro_batching": (
+                        "--listen requests convoy-combine into device-"
+                        "width engine batches (leader drains up to "
+                        "--batch-window pending peers; wait 0 means an "
+                        "idle server pays zero added latency); "
+                        "batch_width_p50/p99 land in serve_stats.json"
+                    ),
+                    "decision": "engine serve summary + bench detail "
+                                "record the planner why-line "
+                                "(lookup.auto_decision)",
+                },
+                "landmark_picker": (
+                    "--landmark-picker uniform|coverage — coverage "
+                    "weights pivot sampling by vertex degree (hub "
+                    "coverage for skewed graphs); uniform stays the "
+                    "reproducible default"
                 ),
                 # The traffic front end (ISSUE 15, README "Traffic
                 # front end"): socket serving with designed overload
@@ -1477,7 +1547,8 @@ def main(argv: list[str] | None = None) -> int:
                     if landmarks is not None and landmarks.k != k:
                         landmarks = None  # stale size: rebuild
                 if landmarks is None:
-                    landmarks = LandmarkIndex.build(g, k, config=cfg)
+                    landmarks = LandmarkIndex.build(
+                        g, k, config=cfg, picker=args.landmark_picker)
                     if store.ckpt is not None:
                         landmarks.save(store.ckpt.dir)
             from paralleljohnson_tpu.observe.live import SLO
@@ -1485,6 +1556,7 @@ def main(argv: list[str] | None = None) -> int:
             engine = QueryEngine(
                 g, store, landmarks=landmarks, config=cfg,
                 miss_policy=args.miss_policy,
+                device_lookup=args.device_lookup,
                 slo=SLO(name="serve", latency_ms=args.slo_p99_ms,
                         latency_pct=99.0,
                         availability=args.slo_availability),
@@ -1501,11 +1573,17 @@ def main(argv: list[str] | None = None) -> int:
                 )
 
                 host, port = parse_listen(args.listen)
+                fe_kw = {}
+                if args.batch_window is not None:
+                    fe_kw["batch_window"] = args.batch_window
+                if args.batch_wait_ms is not None:
+                    fe_kw["batch_wait_ms"] = args.batch_wait_ms
                 frontend = ServeFrontend(
                     engine, host=host, port=port,
                     max_connections=args.max_connections,
                     max_inflight=args.max_inflight,
                     shed_policy=args.shed_policy,
+                    **fe_kw,
                     drain_timeout_s=args.drain_timeout,
                     retry_after_ms=args.retry_after_ms,
                     shed_min_events=args.shed_min_events,

@@ -130,6 +130,12 @@ def _gather_serve(root: Path, now: float, stale_after_s: float) -> list[dict]:
                 "rejected": engine.get("rejected"),
                 "deadline_drops": engine.get("deadline_drops"),
                 "open_connections": engine.get("open_connections"),
+                # Lookup-path dispatch (ISSUE 16): which path answered
+                # and how wide the aggregated batches ran.
+                "device_lookups": engine.get("device_lookups"),
+                "host_lookups": engine.get("host_lookups"),
+                "batch_width_p50": engine.get("batch_width_p50"),
+                "batch_width_p99": engine.get("batch_width_p99"),
                 "hits_by_tier": engine.get("hits_by_tier"),
                 "p50_ms": engine.get("p50_ms"),
                 "p50_err_ms": engine.get("p50_err_ms"),
@@ -295,6 +301,15 @@ def _render_serve(lines: list[str], entries: list[dict]) -> None:
                 f"rejected {_fmt(s.get('rejected'))}   "
                 f"deadline-drops {_fmt(s.get('deadline_drops'))}   "
                 f"conns {_fmt(s.get('open_connections'))}"
+            )
+        # Lookup-path line only once a path counter moved (older
+        # snapshots and idle engines keep the compact layout).
+        if s.get("device_lookups") or s.get("host_lookups"):
+            lines.append(
+                f"  lookups device {_fmt(s.get('device_lookups'))} / "
+                f"host {_fmt(s.get('host_lookups'))}   "
+                f"batch-width p50 {_fmt(s.get('batch_width_p50'))} "
+                f"p99 {_fmt(s.get('batch_width_p99'))}"
             )
         for name, slo in (live.get("slos") or {}).items():
             lat = slo.get("latency") or {}

@@ -73,7 +73,7 @@ KNOWN_ROUTES = (
     "sweep", "sweep-sm", "vm", "vm-blocked", "vm-blocked+dw",
     "pallas-vm", "gs", "gs+dw", "dia", "bucket", "bucket+sweep",
     "frontier", "fw", "fw-tile", "dense-squaring", "dense-iterate",
-    "condensed+fw", "incremental-repair",
+    "condensed+fw", "incremental-repair", "lookup-host", "lookup-device",
 )
 
 
@@ -251,6 +251,68 @@ def select(
         chosen=chosen, ranking=ranking, candidates=candidates,
         reason=reason,
     )
+
+
+# -- the serving-tier lookup family (ISSUE 16) -------------------------------
+#
+# The query engine dispatches each aggregated batch's LOOKUP work (exact
+# hot hits + landmark bounds) through this registry exactly like the
+# backend dispatches a fan-out: ``device_lookup`` megabatches the batch
+# into one kernel launch over the store's device tile, ``host_lookup``
+# is the per-source tier walk the engine always had. Both produce
+# bitwise-identical answers (the device path's design invariant — see
+# ``serve/device_query.py``), so the choice is pure economics: tiny
+# batches and CPU platforms keep the host path by qualification, a
+# priced calibration or a forced ``device_lookup="on"``/``"off"`` pin
+# overrides. The ``ctx`` is the engine's per-batch lookup context
+# (``platform`` / ``device_available`` / ``device_reason`` /
+# ``n_device_eligible`` / ``forced_on``); ``config`` carries the
+# engine's ``device_lookup`` tristate.
+
+# Below this many device-eligible lookups in a batch the kernel-launch
+# overhead dwarfs the per-query saving — the host walk keeps them.
+MIN_DEVICE_LOOKUP_BATCH = 4
+
+
+def _qual_device_lookup(ctx):
+    if not getattr(ctx, "device_available", False):
+        return False, getattr(ctx, "device_reason",
+                              "device query path unavailable")
+    if getattr(ctx, "forced_on", False):
+        return True, "device megabatch (pinned by device_lookup='on')"
+    n = int(getattr(ctx, "n_device_eligible", 0))
+    if n < MIN_DEVICE_LOOKUP_BATCH:
+        return False, (
+            f"tiny batch ({n} device-eligible lookups < "
+            f"{MIN_DEVICE_LOOKUP_BATCH}): host walk keeps it"
+        )
+    if getattr(ctx, "platform", "cpu") == "cpu":
+        return False, (
+            "cpu platform: host tier walk is the measured default; "
+            "promotable when priced cheaper or forced"
+        )
+    return True, (
+        f"device backend with {n} device-eligible lookups: one "
+        "megabatched launch beats per-query host round-trips"
+    )
+
+
+LOOKUP_PLANS = [
+    Plan(
+        name="device_lookup", entry="serve", priority=10,
+        qualify=_qual_device_lookup,
+        price_routes=("lookup-device",),
+        forced=lambda cfg: getattr(cfg, "device_lookup", "auto") == "on",
+        force_overrides={"device_lookup": "on"},
+    ),
+    Plan(
+        name="host_lookup", entry="serve", priority=20,
+        qualify=lambda ctx: (True, "unconditional host tier-walk fallback"),
+        price_routes=("lookup-host",),
+        forced=lambda cfg: getattr(cfg, "device_lookup", "auto") == "off",
+        force_overrides={"device_lookup": "off"},
+    ),
+]
 
 
 def plan_record(

@@ -8,8 +8,13 @@ certified ``(estimate, max_error)`` bound. ``pjtpu serve`` is the CLI
 front end: a JSONL request loop by default, or — with ``--listen`` —
 the :class:`ServeFrontend` threaded socket server with admission
 control, per-request deadlines, burn-rate-triggered certified load
-shedding, and a SIGTERM drain (ISSUE 15)."""
+shedding, and a SIGTERM drain (ISSUE 15). Concurrent socket clients
+are micro-batched through a :class:`MicroBatcher` into device-width
+``query_batch`` calls, and a :class:`DeviceQueryPath` answers them in
+megabatched kernel launches over the resident hot tier when the
+planner prices the device route cheaper (ISSUE 16)."""
 
+from paralleljohnson_tpu.serve.device_query import DeviceQueryPath
 from paralleljohnson_tpu.serve.engine import (
     DEFAULT_SLO,
     QueryEngine,
@@ -19,12 +24,22 @@ from paralleljohnson_tpu.serve.engine import (
     ServeStats,
 )
 from paralleljohnson_tpu.serve.frontend import (
+    DEFAULT_BATCH_WAIT_MS,
+    DEFAULT_BATCH_WINDOW,
+    MicroBatcher,
     PROTOCOL,
     SHED_POLICIES,
     ServeFrontend,
     parse_listen,
 )
-from paralleljohnson_tpu.serve.landmarks import Bounds, LandmarkIndex
+from paralleljohnson_tpu.serve.landmarks import (
+    Bounds,
+    LandmarkIndex,
+    PIVOT_PICKERS,
+    finish_estimates,
+    pick_pivots,
+    widen_bounds,
+)
 from paralleljohnson_tpu.serve.store import (
     DEFAULT_HOT_ROWS,
     DEFAULT_WARM_ROWS,
@@ -33,10 +48,15 @@ from paralleljohnson_tpu.serve.store import (
 
 __all__ = [
     "Bounds",
+    "DEFAULT_BATCH_WAIT_MS",
+    "DEFAULT_BATCH_WINDOW",
     "DEFAULT_HOT_ROWS",
     "DEFAULT_SLO",
     "DEFAULT_WARM_ROWS",
+    "DeviceQueryPath",
     "LandmarkIndex",
+    "MicroBatcher",
+    "PIVOT_PICKERS",
     "PROTOCOL",
     "QueryEngine",
     "QueryError",
@@ -46,5 +66,8 @@ __all__ = [
     "ServeFrontend",
     "ServeStats",
     "TileStore",
+    "finish_estimates",
     "parse_listen",
+    "pick_pivots",
+    "widen_bounds",
 ]

@@ -1,0 +1,277 @@
+"""Device-resident query path (ISSUE 16 tentpole — ROADMAP item 3).
+
+The hot tier of :class:`~paralleljohnson_tpu.serve.store.TileStore`
+already keeps device-resident ``[V]`` rows, but the host lookup path
+(`QueryEngine._answer`) indexes them one source at a time — one device
+gather plus one D2H round-trip PER QUERY. This module answers a whole
+aggregated batch in one kernel launch instead: the engine flattens its
+concurrent clients' lookups into index vectors, the kernels below
+megabatch them over a stacked ``[B, V]`` tile (exact hits) and over the
+landmark row blocks (certified bounds for misses and shed answers), and
+ONE transfer returns everything. The 3D-tensor Floyd-Warshall paper's
+point — the hardware wants batched dense tensor ops — applied to the
+serving tier.
+
+Bitwise identity with the host path is a DESIGN INVARIANT, not a
+tolerance:
+
+- **Exact hits** gather f32 row entries; a gather moves bits, and the
+  f32 -> f64 conversion both paths end with is exact.
+- **Landmark bounds** are computed on-device in f64 (under
+  ``jax.experimental.enable_x64``) but ONLY the raw part — elementwise
+  add/sub plus min/max reductions, which are correctly rounded and
+  order-independent over never-NaN inputs, so they match numpy bit for
+  bit. The multiply-carrying f32-slack widening (where FMA contraction
+  could diverge) and the estimate/err derivation always run on host
+  through the SAME helpers the host path uses
+  (:func:`~paralleljohnson_tpu.serve.landmarks.widen_bounds` /
+  :func:`finish_estimates`).
+
+Platforms without native f64 (TPU) fail the one-time probe and the
+landmark sub-path falls back to host — recorded in the planner
+why-line; the exact-gather sub-path (f32) rides the device everywhere.
+
+The tile is a cached ``jnp.stack`` of the store's non-stale hot rows,
+keyed by :meth:`TileStore.hot_token` — any put/evict/stale transition
+invalidates it (stable row -> tile-slot mapping in between). Stale rows
+are excluded at build: the kernel can never gather a row the host path
+would flag. All operand batches are padded to power-of-two lengths so
+the jit cache stays bounded under arbitrary client mixes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# Pads below this floor round up to it — tiny batches share one
+# compiled shape instead of minting one per width.
+_MIN_PAD = 8
+
+# Full-row landmark queries materialize a [k, chunk, V] f64 temp;
+# chunking bounds it (k is small, V can be large).
+_LM_ROW_CHUNK = 8
+
+
+def available() -> tuple[bool, str]:
+    """Whether the device path can exist in this process at all."""
+    try:
+        import jax  # noqa: F401
+    except Exception as e:  # noqa: BLE001 — absence is a reason, not a crash
+        return False, f"jax unavailable ({type(e).__name__})"
+    return True, "jax importable"
+
+
+def _pad_len(n: int) -> int:
+    return max(_MIN_PAD, 1 << (max(1, int(n)) - 1).bit_length())
+
+
+@functools.lru_cache(maxsize=1)
+def _kernels():
+    """The four jitted megabatch kernels, built once per process."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def gather_pairs(tile, slots, dsts):
+        # [P] entries tile[slots[i], dsts[i]] — the flattened one-to-
+        # many megagather (heterogeneous per-query dst lists flatten
+        # into one index vector; the engine re-segments on host).
+        return tile[slots, dsts]
+
+    @jax.jit
+    def gather_rows(tile, slots):
+        return tile[slots]  # [Q, V] full rows
+
+    @jax.jit
+    def lm_pairs(fwd, rev, s, t):
+        # Raw triangle-inequality bounds per flattened (s, t) pair —
+        # the f64 twin of LandmarkIndex.raw_bounds_row (see its
+        # docstring for why only THIS part may run on device).
+        d_s_L = rev[:, s]           # [k, P]  d(s, L)
+        d_L_s = fwd[:, s]           # [k, P]  d(L, s)
+        fwd_t = fwd[:, t]           # [k, P]  d(L, t)
+        rev_t = rev[:, t]           # [k, P]  d(t, L)
+        upper = jnp.min(d_s_L + fwd_t, axis=0)
+        a = jnp.where(jnp.isfinite(d_L_s), fwd_t - d_L_s, -jnp.inf)
+        b = jnp.where(jnp.isfinite(rev_t), d_s_L - rev_t, -jnp.inf)
+        lower = jnp.maximum(jnp.max(a, axis=0), jnp.max(b, axis=0))
+        return lower, upper
+
+    @jax.jit
+    def lm_rows(fwd, rev, s):
+        # Raw bounds for Q full-row queries at once: [Q, V] outputs.
+        d_s_L = rev[:, s]           # [k, Q]
+        d_L_s = fwd[:, s]           # [k, Q]
+        upper = jnp.min(d_s_L[:, :, None] + fwd[:, None, :], axis=0)
+        a = jnp.where(jnp.isfinite(d_L_s)[:, :, None],
+                      fwd[:, None, :] - d_L_s[:, :, None], -jnp.inf)
+        b = jnp.where(jnp.isfinite(rev)[:, None, :],
+                      d_s_L[:, :, None] - rev[:, None, :], -jnp.inf)
+        lower = jnp.maximum(jnp.max(a, axis=0), jnp.max(b, axis=0))
+        return lower, upper
+
+    return gather_pairs, gather_rows, lm_pairs, lm_rows
+
+
+class DeviceQueryPath:
+    """Megabatched device lookups over a store's hot tier (+ landmark
+    index). One instance per engine; NOT thread-safe on its own — the
+    engine's batch lock already serializes every caller."""
+
+    def __init__(self, store, landmarks=None) -> None:
+        self.store = store
+        self.landmarks = landmarks
+        self._token: object = object()  # never equal to a store token
+        self._slots: dict[int, int] = {}
+        self._tile = None
+        self._lm_fwd = None
+        self._lm_rev = None
+        self._f64_ok: bool | None = None
+        self.tile_rebuilds = 0
+
+    # -- qualification --------------------------------------------------------
+
+    def platform(self) -> str:
+        import jax
+
+        return jax.default_backend()
+
+    def f64_supported(self) -> bool:
+        """One-time probe: can this backend hold and add REAL f64?
+        (TPU demotes or refuses — the landmark sub-path then stays on
+        host; a silent f32 demotion would break bitwise parity, which
+        is exactly what the dtype check catches.)"""
+        if self._f64_ok is None:
+            try:
+                import jax.numpy as jnp
+                from jax.experimental import enable_x64
+
+                with enable_x64():
+                    x = jnp.asarray(np.array([1.5, 2.5], np.float64))
+                    ok = x.dtype == jnp.float64
+                    ok = ok and float(np.asarray(x + x)[0]) == 3.0
+                self._f64_ok = bool(ok)
+            except Exception:  # noqa: BLE001 — no f64 is a route fact
+                self._f64_ok = False
+        return self._f64_ok
+
+    def landmark_device_ok(self) -> bool:
+        return (self.landmarks is not None and self.landmarks.k > 0
+                and self.f64_supported())
+
+    # -- the cached device tile ----------------------------------------------
+
+    def refresh(self) -> dict[int, int]:
+        """Validate/rebuild the ``[B, V]`` tile against the store's
+        token; returns the stable source -> tile-slot mapping (empty
+        when nothing hot / everything stale). The common case is one
+        integer-tuple compare."""
+        token = self.store.hot_token()
+        if token == self._token:
+            return self._slots
+        import jax.numpy as jnp
+
+        token, items = self.store.hot_view()
+        if items:
+            # Device-resident rows stack device-to-device; host rows
+            # (host backends) upload once and then serve from HBM.
+            self._tile = jnp.stack([jnp.asarray(r) for _, r in items])
+            self._slots = {int(s): i for i, (s, _) in enumerate(items)}
+        else:
+            self._tile = None
+            self._slots = {}
+        self._token = token
+        self.tile_rebuilds += 1
+        return self._slots
+
+    def _lm_dev(self):
+        if self._lm_fwd is None:
+            import jax.numpy as jnp
+            from jax.experimental import enable_x64
+
+            with enable_x64():
+                self._lm_fwd = jnp.asarray(self.landmarks.fwd)
+                self._lm_rev = jnp.asarray(self.landmarks.rev)
+        return self._lm_fwd, self._lm_rev
+
+    # -- megabatched lookups --------------------------------------------------
+
+    def exact_pairs(self, slot_idx, dst_idx) -> np.ndarray:
+        """f32 ``[P]`` tile entries for flattened (slot, dst) pairs —
+        one launch, one D2H, padded to a power of two."""
+        gather_pairs, _, _, _ = _kernels()
+        import jax.numpy as jnp
+
+        p = len(slot_idx)
+        pad = _pad_len(p)
+        s = np.zeros(pad, np.int32)
+        s[:p] = slot_idx
+        d = np.zeros(pad, np.int32)
+        d[:p] = dst_idx
+        out = gather_pairs(self._tile, jnp.asarray(s), jnp.asarray(d))
+        return np.asarray(out)[:p]
+
+    def exact_rows(self, slot_idx) -> np.ndarray:
+        """f32 ``[Q, V]`` full rows for the given tile slots."""
+        _, gather_rows, _, _ = _kernels()
+        import jax.numpy as jnp
+
+        q = len(slot_idx)
+        pad = _pad_len(q)
+        s = np.zeros(pad, np.int32)
+        s[:q] = slot_idx
+        out = gather_rows(self._tile, jnp.asarray(s))
+        return np.asarray(out)[:q]
+
+    def landmark_pairs(self, s_idx, t_idx):
+        """RAW f64 ``(lower[P], upper[P])`` bounds for flattened (s, t)
+        pairs — finish through ``widen_bounds``/``finish_estimates``."""
+        _, _, lm_pairs, _ = _kernels()
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+
+        p = len(s_idx)
+        pad = _pad_len(p)
+        s = np.zeros(pad, np.int32)
+        s[:p] = s_idx
+        t = np.zeros(pad, np.int32)
+        t[:p] = t_idx
+        with enable_x64():
+            fwd, rev = self._lm_dev()
+            lo, up = lm_pairs(fwd, rev, jnp.asarray(s), jnp.asarray(t))
+            return np.asarray(lo)[:p], np.asarray(up)[:p]
+
+    def landmark_rows(self, s_idx):
+        """RAW f64 ``(lower[Q, V], upper[Q, V])`` bounds for full-row
+        landmark queries, chunked to bound the [k, chunk, V] temp."""
+        _, _, _, lm_rows = _kernels()
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+
+        lows, ups = [], []
+        with enable_x64():
+            fwd, rev = self._lm_dev()
+            for i in range(0, len(s_idx), _LM_ROW_CHUNK):
+                chunk = s_idx[i:i + _LM_ROW_CHUNK]
+                s = np.zeros(_LM_ROW_CHUNK, np.int32)
+                s[:len(chunk)] = chunk
+                lo, up = lm_rows(fwd, rev, jnp.asarray(s))
+                lows.append(np.asarray(lo)[:len(chunk)])
+                ups.append(np.asarray(up)[:len(chunk)])
+        return np.concatenate(lows), np.concatenate(ups)
+
+    # -- introspection --------------------------------------------------------
+
+    def describe(self) -> dict:
+        ok, reason = available()
+        out = {"available": ok, "reason": reason}
+        if ok:
+            out.update(
+                platform=self.platform(),
+                f64_device_bounds=self.landmark_device_ok(),
+                tile_slots=len(self._slots),
+                tile_rebuilds=self.tile_rebuilds,
+            )
+        return out

@@ -20,6 +20,25 @@ The exact-vs-approximate contract every response carries:
   ``|distance - d(s, t)| <= max_error`` (``max_error`` may be +inf when
   the landmarks carry no information about the pair — the caller sees
   exactly how much the answer is worth).
+- ``stale: true`` (with ``exact: true``) — the distance is bitwise the
+  solver's output for the PRE-update graph; ``max_error`` is then the
+  landmark interval width for the pair (ISSUE 16 satellite): an honest
+  ESTIMATE of how far the served value may drift from the repaired
+  graph's answer, shaped exactly like a certified-shed response (+inf
+  when no landmark index is attached — the estimate is never silently
+  absent, and never silently zero).
+
+Lookup dispatch (ISSUE 16 tentpole): each aggregated batch's lookup
+work — exact hot hits plus landmark bounds — goes through the priced
+planner registry (``planner.LOOKUP_PLANS``). The ``device_lookup`` plan
+megabatches the batch into one kernel launch over the store's device
+tile (``serve/device_query.py``); ``host_lookup`` is the per-source
+tier walk. Answers are bitwise-identical either way (the device path's
+design invariant), so forcing either path via the engine's
+``device_lookup`` tristate reproduces the other bit for bit; tiny
+batches and CPU platforms keep the host path by qualification, and the
+per-batch decision (with its why-line) is kept on
+``engine.last_lookup_decision``.
 
 Concurrency (ISSUE 12): the engine is thread-safe — one re-entrant
 lock serializes the batch pipeline (tier walk, scheduled solve, counter
@@ -61,12 +80,20 @@ import time
 import weakref
 from pathlib import Path
 
+import types
+
 import numpy as np
 
+from paralleljohnson_tpu import planner as _planner
 from paralleljohnson_tpu.observe.live import (
     SLO,
     LogHistogram,
     MetricsRegistry,
+)
+from paralleljohnson_tpu.serve import device_query as _device_query
+from paralleljohnson_tpu.serve.landmarks import (
+    finish_estimates,
+    widen_bounds,
 )
 from paralleljohnson_tpu.utils.telemetry import resolve as _resolve_telemetry
 from paralleljohnson_tpu.utils.telemetry import write_prom_metrics
@@ -109,8 +136,16 @@ class ServeStats:
     rejected: int = 0
     deadline_drops: int = 0
     open_connections: int = 0
+    # Lookup-path accounting (ISSUE 16): which dispatch served each
+    # answered query — the device megabatch or the host tier walk —
+    # plus the width distribution of the device megabatches (the whole
+    # point of aggregating: widths near 1 mean the batching isn't
+    # happening and the launch overhead is pure loss).
+    device_lookups: int = 0
+    host_lookups: int = 0
     hits_by_tier: dict = dataclasses.field(default_factory=dict)
     hist: LogHistogram = dataclasses.field(default_factory=LogHistogram)
+    batch_hist: LogHistogram = dataclasses.field(default_factory=LogHistogram)
 
     def record_latency(self, ms: float) -> None:
         self.hist.record(float(ms))
@@ -136,8 +171,14 @@ class ServeStats:
             "rejected": self.rejected,
             "deadline_drops": self.deadline_drops,
             "open_connections": self.open_connections,
+            "device_lookups": self.device_lookups,
+            "host_lookups": self.host_lookups,
             "hits_by_tier": dict(self.hits_by_tier),
             **{k: round(v, 4) for k, v in self.percentiles().items()},
+            **({} if self.batch_hist.count == 0 else {
+                k: round(v, 4) for k, v in self.batch_hist.percentiles(
+                    (50, 99), key="batch_width_p{p}").items()
+            }),
         }
 
 
@@ -184,6 +225,18 @@ SERVE_PROM_METRICS = (
     ("pjtpu_query_hit_rate", "gauge",
      "Fraction of row lookups served by a store tier (hot/warm/cold)",
      lambda e: e.store.hit_rate()),
+    # Lookup-path dispatch (ISSUE 16): device megabatch vs host walk,
+    # plus the device megabatch width distribution.
+    ("pjtpu_device_lookups_total", "counter",
+     "Queries answered by the device-resident megabatch path (bitwise "
+     "identical to the host walk by design)",
+     lambda e: e.stats.device_lookups),
+    ("pjtpu_host_lookups_total", "counter",
+     "Queries answered by the per-source host tier walk",
+     lambda e: e.stats.host_lookups),
+    ("pjtpu_lookup_batch_width", "histogram",
+     "Width (queries per launch) of device lookup megabatches",
+     lambda e: e.stats.batch_hist),
     # The real latency distribution (ISSUE 12): cumulative _bucket /
     # _sum / _count lines so PromQL histogram_quantile works...
     ("pjtpu_query_latency_ms", "histogram",
@@ -201,6 +254,16 @@ SERVE_PROM_METRICS = (
 )
 
 _MISS_POLICIES = ("solve", "landmark")
+
+# Lookup-path tristate (ISSUE 16): "auto" lets the planner registry
+# choose per batch, "on"/"off" pin the device megabatch / host walk
+# (both answer bitwise-identically — the pin is for benchmarking and
+# for platforms where auto-qualification guesses wrong).
+_DEVICE_LOOKUP_MODES = ("auto", "on", "off")
+
+# rows[] sentinel marking a source whose values arrive from the device
+# megabatch rather than a host row reference.
+_DEVICE_ROW = object()
 
 
 class QueryError(ValueError):
@@ -222,7 +285,8 @@ class QueryEngine:
 
     def __init__(self, graph, store, *, landmarks=None, config=None,
                  miss_policy: str = "solve", metrics=None, slo=None,
-                 stats_interval_s: float = DEFAULT_STATS_INTERVAL_S) -> None:
+                 stats_interval_s: float = DEFAULT_STATS_INTERVAL_S,
+                 device_lookup: str = "auto") -> None:
         import dataclasses as _dc
 
         from paralleljohnson_tpu.config import SolverConfig
@@ -232,6 +296,11 @@ class QueryEngine:
             raise ValueError(
                 f"miss_policy must be one of {_MISS_POLICIES}, "
                 f"got {miss_policy!r}"
+            )
+        if device_lookup not in _DEVICE_LOOKUP_MODES:
+            raise ValueError(
+                f"device_lookup must be one of {_DEVICE_LOOKUP_MODES}, "
+                f"got {device_lookup!r}"
             )
         if miss_policy == "landmark" and landmarks is None:
             raise ValueError(
@@ -256,8 +325,17 @@ class QueryEngine:
         # The stats histogram IS the registry's, so snapshots and prom
         # exports read one set of counts (no drift between surfaces).
         self.stats = ServeStats(
-            hist=self.metrics.histogram("pjtpu_query_latency_ms")
+            hist=self.metrics.histogram("pjtpu_query_latency_ms"),
+            batch_hist=self.metrics.histogram("pjtpu_lookup_batch_width"),
         )
+        # Device-resident lookup path (ISSUE 16): built lazily on first
+        # batch so engines on jax-less hosts never pay an import probe
+        # per query; the unavailability reason is cached for the
+        # planner's why-line.
+        self.device_lookup = device_lookup
+        self._device_path = None
+        self._device_unavail: str | None = None
+        self.last_lookup_decision: dict | None = None
         self.metrics.slo(self.slo, histogram="pjtpu_query_latency_ms")
         # One re-entrant lock serializes the whole batch pipeline: the
         # tier walk + scheduled solve + counters are a critical section
@@ -386,17 +464,38 @@ class QueryEngine:
                         "error": str(e),
                     })
 
+            # Lookup-path dispatch (ISSUE 16): the planner registry
+            # decides per batch whether lookups megabatch over the
+            # device tile or walk the host tiers.
+            device_slots = self._plan_lookup(parsed)
+            n_valid = sum(1 for p in parsed if p is not None)
+            if n_valid:
+                # Aggregated lookup width — the quantity micro-batching
+                # exists to raise (batch_width_p50/p99 in stats).
+                self.stats.batch_hist.record(float(n_valid))
+
             # One row fetch per distinct source; one solve for ALL
             # exact-mode misses (the aggregation the tentpole names).
             rows: dict[int, tuple] = {}
             seen: set[int] = set()
+            device_sources: list[int] = []
             for p in parsed:
                 if p is None or p["source"] in seen:
                     continue
                 seen.add(p["source"])
+                if p["source"] in device_slots:
+                    # The values come from the megabatch below; the
+                    # sentinel keeps the miss/solve logic unchanged.
+                    rows[p["source"]] = (_DEVICE_ROW, "hot")
+                    device_sources.append(p["source"])
+                    continue
                 row, row_tier = self.store.get(p["source"])
                 if row is not None:
                     rows[p["source"]] = (row, row_tier)
+            if device_sources:
+                # Device-path hits must leave the same footprint the
+                # host walk would: one hot hit + an LRU refresh each.
+                self.store.note_hot_hits(device_sources)
             missing_exact = sorted({
                 p["source"] for p in parsed
                 if p is not None and p["source"] not in rows
@@ -417,12 +516,16 @@ class QueryEngine:
                 for s, row in res.rows_by_source().items():
                     rows[s] = (row, "solved")
 
+            # The megabatch: every device-eligible lookup in this batch
+            # flattens into (at most) one launch per query class.
+            pre = self._device_precompute(parsed, rows, device_slots)
+
             for i, p in enumerate(parsed):
                 if p is None:
                     continue
                 with tel.span("query", source=p["source"],
                               many=p["many"]):
-                    responses[i] = self._answer(p, rows)
+                    responses[i] = self._answer(p, rows, pre.get(i))
                 self.stats.queries_total += 1
                 latency_ms = (time.perf_counter() - t_batch) * 1e3
                 self.stats.record_latency(latency_ms)
@@ -434,7 +537,169 @@ class QueryEngine:
                          batches_scheduled=self.stats.batches_scheduled)
         return responses  # type: ignore[return-value]
 
-    def _answer(self, p: dict, rows: dict[int, tuple]) -> dict:
+    # -- lookup-path dispatch (ISSUE 16 tentpole) -----------------------------
+
+    def _device_path_maybe(self):
+        """The lazily built :class:`DeviceQueryPath`, or None with the
+        reason cached in ``_device_unavail``."""
+        if self.device_lookup == "off":
+            self._device_unavail = "disabled (device_lookup='off')"
+            return None
+        if self._device_path is None:
+            if self._device_unavail is not None:
+                return None  # probed and failed; don't re-import per batch
+            ok, reason = _device_query.available()
+            if not ok:
+                self._device_unavail = reason
+                return None
+            self._device_path = _device_query.DeviceQueryPath(
+                self.store, self.landmarks
+            )
+        return self._device_path
+
+    def _plan_lookup(self, parsed) -> dict[int, int]:
+        """Run the planner over ``LOOKUP_PLANS`` for this batch. Returns
+        the source -> tile-slot map to serve from the device (empty map
+        = host walk). The decision (with why-line) lands on
+        ``last_lookup_decision``."""
+        dpath = self._device_path_maybe()
+        slots: dict[int, int] = {}
+        platform = "cpu"
+        if dpath is None:
+            avail, reason = False, self._device_unavail or "unavailable"
+        else:
+            try:
+                slots = dpath.refresh()
+                platform = dpath.platform()
+                if slots:
+                    avail, reason = True, "device tile resident"
+                else:
+                    avail = False
+                    reason = "empty device tile (nothing hot, or all stale)"
+            except Exception as e:  # noqa: BLE001 — degrade, never crash a query
+                slots = {}
+                avail = False
+                reason = f"device path failed: {type(e).__name__}: {e}"
+        n_eligible = sum(
+            1 for p in parsed if p is not None and p["source"] in slots
+        )
+        ctx = types.SimpleNamespace(
+            platform=platform,
+            device_available=avail,
+            device_reason=reason,
+            n_device_eligible=n_eligible,
+            forced_on=self.device_lookup == "on",
+        )
+        decision = _planner.select(
+            _planner.LOOKUP_PLANS, ctx,
+            platform=platform, num_edges=self.graph.num_edges,
+            batch=max(1, n_eligible),
+            config=types.SimpleNamespace(device_lookup=self.device_lookup),
+        )
+        self.last_lookup_decision = decision.as_dict()
+        if decision.chosen.plan.name == "device_lookup":
+            return slots
+        return {}
+
+    def _device_precompute(self, parsed, rows, device_slots) -> dict:
+        """Flatten this batch's device-eligible lookups and run the
+        megabatch: exact (slot, dst) pairs and full rows gather over the
+        tile; landmark misses compute their RAW f64 bounds on-device and
+        finish through the SAME host helpers the host path uses (the
+        bitwise-parity seam — see ``serve/device_query.py``). Returns
+        ``{query_index: ("exact", vals_f64) | ("landmark", est, err)}``."""
+        pre: dict[int, tuple] = {}
+        if not device_slots:
+            return pre
+        dpath = self._device_path
+        lm_dev = dpath.landmark_device_ok()
+        pair_q: list[int] = []
+        pair_seg: list[int] = []
+        pair_slots: list[int] = []
+        pair_dsts: list[int] = []
+        row_q: list[int] = []
+        row_slots: list[int] = []
+        lmp_q: list[int] = []
+        lmp_seg: list[int] = []
+        lmp_s: list[int] = []
+        lmp_t: list[int] = []
+        lmr_q: list[int] = []
+        lmr_s: list[int] = []
+        for i, p in enumerate(parsed):
+            if p is None:
+                continue
+            s, dsts = p["source"], p["dsts"]
+            if s in device_slots:
+                if dsts is None:
+                    row_q.append(i)
+                    row_slots.append(device_slots[s])
+                else:
+                    pair_q.append(i)
+                    pair_seg.append(len(dsts))
+                    pair_slots.extend([device_slots[s]] * len(dsts))
+                    pair_dsts.extend(int(d) for d in dsts)
+            elif lm_dev and s not in rows and p["mode"] == "landmark":
+                # Store miss answered by landmark bounds: the f64 raw
+                # part rides the same launch window (platforms without
+                # real f64 — TPU — fail the probe and these stay host).
+                if dsts is None:
+                    lmr_q.append(i)
+                    lmr_s.append(s)
+                else:
+                    lmp_q.append(i)
+                    lmp_seg.append(len(dsts))
+                    lmp_s.extend([s] * len(dsts))
+                    lmp_t.extend(int(d) for d in dsts)
+        nonneg = (self.landmarks.nonnegative
+                  if self.landmarks is not None else True)
+        if pair_q:
+            flat = dpath.exact_pairs(pair_slots, pair_dsts)
+            off = 0
+            for qi, seg in zip(pair_q, pair_seg):
+                pre[qi] = ("exact",
+                           np.asarray(flat[off:off + seg], np.float64))
+                off += seg
+        if row_q:
+            out = dpath.exact_rows(row_slots)
+            for j, qi in enumerate(row_q):
+                pre[qi] = ("exact", np.asarray(out[j], np.float64))
+        if lmp_q:
+            lo, up = dpath.landmark_pairs(lmp_s, lmp_t)
+            lo, up = widen_bounds(lo, up, nonnegative=nonneg)
+            est, err = finish_estimates(lo, up)
+            off = 0
+            for qi, seg in zip(lmp_q, lmp_seg):
+                pre[qi] = ("landmark", est[off:off + seg],
+                           err[off:off + seg])
+                off += seg
+        if lmr_q:
+            lo, up = dpath.landmark_rows(lmr_s)
+            for j, qi in enumerate(lmr_q):
+                wl, wu = widen_bounds(lo[j], up[j], nonnegative=nonneg)
+                est, err = finish_estimates(wl, wu)
+                pre[qi] = ("landmark", est, err)
+        return pre
+
+    def _stale_error_bound(self, s, dsts, many):
+        """The ISSUE 16 stale-honesty satellite: a landmark-derived
+        ``max_error`` for a stale (pre-update) answer, shaped like a
+        certified-shed response's. The landmark interval width is an
+        honest ESTIMATE of how far the served value can drift from the
+        repaired graph's answer — not a certificate (the index predates
+        the repair too), which is exactly why it rides next to
+        ``stale: true`` instead of replacing it. Without an index the
+        bound is +inf: present, never silently zero."""
+        if self.landmarks is not None and self.landmarks.k > 0:
+            _, err = self.landmarks.estimate_row(s, dsts)
+        else:
+            n = 1 if dsts is not None and not many else (
+                len(dsts) if dsts is not None else self.graph.num_nodes
+            )
+            err = np.full(max(n, 1), np.inf)
+        return [float(e) for e in err] if many else float(err[0])
+
+    def _answer(self, p: dict, rows: dict[int, tuple],
+                pre: tuple | None = None) -> dict:
         s, dsts, many = p["source"], p["dsts"], p["many"]
         out: dict = {"id": p["id"], "source": s}
         # Staleness contract (ISSUE 11): while (or after) an incremental
@@ -451,12 +716,32 @@ class QueryEngine:
             self.stats.stale_answers += 1
             self.metrics.counter("pjtpu_stale_answers").add(1)
         hit = rows.get(s)
-        if hit is not None:
+        device = pre is not None
+        if device and pre[0] == "exact":
+            # Megabatched gather: same f32 bits, same f64 conversion —
+            # tier is "hot" exactly as the host walk would report.
+            vals = pre[1]
+            tier = "hot"
+            self.stats.exact_answers += 1
+            out.update(exact=True, max_error=0.0, tier="hot")
+        elif hit is not None:
             row, tier = hit
             vals = np.asarray(row if dsts is None else row[dsts],
                               np.float64)
             self.stats.exact_answers += 1
             out.update(exact=True, max_error=0.0, tier=tier)
+        elif device and pre[0] == "landmark":
+            # Device-raw + host-finished bounds (bitwise the host path).
+            est, err = pre[1], pre[2]
+            vals = est
+            self.stats.approx_answers += 1
+            tier = "landmark"
+            out.update(
+                exact=False, tier="landmark",
+                max_error=(
+                    [float(e) for e in err] if many else float(err[0])
+                ),
+            )
         else:
             # Landmark path — approximation, always flagged with its
             # certified error bound.
@@ -470,6 +755,16 @@ class QueryEngine:
                     [float(e) for e in err] if many else float(err[0])
                 ),
             )
+        if out.get("stale") and out.get("exact"):
+            # Stale-honesty satellite: the pre-update answer ships with
+            # its drift estimate, never a bare flag.
+            out["max_error"] = self._stale_error_bound(s, dsts, many)
+        if device:
+            self.stats.device_lookups += 1
+            self.metrics.counter("pjtpu_device_lookups").add(1)
+        else:
+            self.stats.host_lookups += 1
+            self.metrics.counter("pjtpu_host_lookups").add(1)
         self.stats.hits_by_tier[tier] = (
             self.stats.hits_by_tier.get(tier, 0) + 1
         )
@@ -569,11 +864,26 @@ class QueryEngine:
                                   metrics=SERVE_PROM_METRICS)
 
     def serve_summary(self) -> dict:
+        if self._device_path is not None:
+            device_path = self._device_path.describe()
+        else:
+            device_path = {
+                "available": False,
+                "reason": self._device_unavail or "not probed yet",
+            }
         return {
             "engine": self.stats.as_dict(),
             "store": self.store.stats(),
             "landmarks": 0 if self.landmarks is None else self.landmarks.k,
             "miss_policy": self.miss_policy,
+            # Lookup-path dispatch (ISSUE 16): the tristate, the device
+            # path's state, and the last planner decision with its
+            # why-line — what `pjtpu top` / bench detail read.
+            "lookup": {
+                "device_lookup": self.device_lookup,
+                "device_path": device_path,
+                "decision": self.last_lookup_decision,
+            },
             # The live view (ISSUE 12): windowed rates, histogram with
             # its full mergeable state, and the SLO burn verdicts —
             # what `pjtpu top` and slo_report read.

@@ -76,6 +76,14 @@ DEFAULT_MAX_INFLIGHT = 8
 DEFAULT_DRAIN_TIMEOUT_S = 10.0
 DEFAULT_RETRY_AFTER_MS = 100
 
+# Micro-batching defaults (ISSUE 16): at most this many queries combine
+# into one engine batch; 0/1 disables combining. The wait window
+# defaults to ZERO — batching emerges from convoy combining (followers
+# enqueue while the leader executes the previous batch), so an idle
+# server never trades latency for width.
+DEFAULT_BATCH_WINDOW = 32
+DEFAULT_BATCH_WAIT_MS = 0.0
+
 # The low-traffic guard on the shed decision (the SRE-workbook caveat:
 # burn-rate math over a handful of events is dominated by any single
 # failure). Shedding engages only when the burning verdict is backed by
@@ -102,6 +110,97 @@ def parse_listen(spec: str) -> tuple[str, int]:
         raise ValueError(f"bad --listen port {port!r}") from None
 
 
+class _BatchSlot:
+    """One request's place in a :class:`MicroBatcher` convoy."""
+
+    __slots__ = ("req", "resp", "exc", "done")
+
+    def __init__(self, req: dict) -> None:
+        self.req = req
+        self.resp: dict | None = None
+        self.exc: BaseException | None = None
+        self.done = False
+
+
+class MicroBatcher:
+    """Leader-follower request combining over one shared engine
+    (ISSUE 16 tentpole: the frontend-side aggregation that gives the
+    device megabatch its width).
+
+    Every submitting thread enqueues a slot, then contends for the TURN
+    lock. The holder (the leader) drains up to ``max_width`` pending
+    slots — its own included — into ONE ``engine.query_batch`` call and
+    marks them done; threads whose slot was served by someone else's
+    batch find it completed the moment they get the turn and leave
+    immediately. The bounded-latency argument: with ``wait_ms=0`` (the
+    default) a lone request takes the turn instantly and runs a
+    width-1 batch — combining costs an idle server NOTHING; under load,
+    width emerges from exactly the time the previous batch was already
+    going to take (the convoy), which is the ISSUE's "bounded
+    micro-batching window, never adding unbounded latency". A nonzero
+    ``wait_ms`` additionally lets the leader sit out one fixed window
+    to accumulate followers — still bounded by construction.
+
+    Exceptions from the engine are stored per slot and re-raised in
+    each submitter's own thread (a poisoned batch fails its members,
+    not the batcher)."""
+
+    def __init__(self, engine, *, max_width: int = DEFAULT_BATCH_WINDOW,
+                 wait_ms: float = DEFAULT_BATCH_WAIT_MS) -> None:
+        if max_width < 1:
+            raise ValueError(f"max_width must be >= 1, got {max_width}")
+        if wait_ms < 0:
+            raise ValueError(f"wait_ms must be >= 0, got {wait_ms}")
+        self.engine = engine
+        self.max_width = int(max_width)
+        self.wait_s = float(wait_ms) / 1e3
+        self._pending: list[_BatchSlot] = []
+        self._lock = threading.Lock()   # guards _pending
+        self._turn = threading.Lock()   # one leader at a time
+        self.batches = 0
+        self.combined = 0  # requests that rode a batch of width > 1
+
+    def submit(self, req: dict) -> dict:
+        """Answer one request through the combining pipeline. Blocks
+        until the request's batch completes; raises whatever the engine
+        raised for that batch."""
+        slot = _BatchSlot(req)
+        with self._lock:
+            self._pending.append(slot)
+        while not slot.done:
+            with self._turn:
+                if slot.done:
+                    break  # a previous leader's batch served us
+                if self.wait_s:
+                    time.sleep(self.wait_s)
+                with self._lock:
+                    batch = self._pending[:self.max_width]
+                    del self._pending[:len(batch)]
+                if batch:
+                    self._execute(batch)
+                # FIFO take: our slot is served within ceil(pos/width)
+                # turns, every one of which does real work — no
+                # spinning, no starvation.
+        if slot.exc is not None:
+            raise slot.exc
+        return slot.resp  # type: ignore[return-value]
+
+    def _execute(self, batch: list[_BatchSlot]) -> None:
+        try:
+            responses = self.engine.query_batch([s.req for s in batch])
+            for s, resp in zip(batch, responses):
+                s.resp = resp
+        except BaseException as e:  # noqa: BLE001 — fail the members, not us
+            for s in batch:
+                s.exc = e
+        finally:
+            self.batches += 1
+            if len(batch) > 1:
+                self.combined += len(batch)
+            for s in batch:
+                s.done = True
+
+
 class ServeFrontend:
     """Threaded socket front end over one shared engine (module doc).
 
@@ -120,7 +219,9 @@ class ServeFrontend:
                  retry_after_ms: int = DEFAULT_RETRY_AFTER_MS,
                  shed_min_events: int = DEFAULT_SHED_MIN_EVENTS,
                  fault_plan=None, heartbeat_file=None,
-                 heartbeat_stale_s: float = 30.0) -> None:
+                 heartbeat_stale_s: float = 30.0,
+                 batch_window: int = DEFAULT_BATCH_WINDOW,
+                 batch_wait_ms: float = DEFAULT_BATCH_WAIT_MS) -> None:
         if shed_policy not in SHED_POLICIES:
             raise ValueError(
                 f"shed_policy must be one of {SHED_POLICIES}, "
@@ -144,6 +245,16 @@ class ServeFrontend:
         self.fault_plan = fault_plan
         self.heartbeat_file = heartbeat_file
         self.heartbeat_stale_s = float(heartbeat_stale_s)
+        # Micro-batching (ISSUE 16): concurrent connections' requests
+        # combine into device-width engine batches; 0/1 = the old
+        # one-request-one-batch path.
+        self.batch_window = int(batch_window)
+        self.batch_wait_ms = float(batch_wait_ms)
+        self.batcher = (
+            MicroBatcher(engine, max_width=self.batch_window,
+                         wait_ms=self.batch_wait_ms)
+            if self.batch_window > 1 else None
+        )
         self._tel = engine._tel
         self._tracker = engine.slo_tracker()
         self._inflight = threading.Semaphore(self.max_inflight)
@@ -425,6 +536,8 @@ class ServeFrontend:
             "open_connections": stats.open_connections,
             "max_connections": self.max_connections,
             "max_inflight": self.max_inflight,
+            "batch_window": self.batch_window,
+            "batch_wait_ms": self.batch_wait_ms,
             "queries_total": stats.queries_total,
             "shed_answers": stats.shed_answers,
             "rejected": stats.rejected,
@@ -544,7 +657,10 @@ class ServeFrontend:
                 req = {**req, "mode": "approx"}
                 shed = True
         try:
-            resp = engine.query_batch([req])[0]
+            if self.batcher is not None:
+                resp = self.batcher.submit(req)
+            else:
+                resp = engine.query_batch([req])[0]
         except QueryError as e:
             resp = {"id": req_id, "error": str(e)}
         except Exception as e:  # noqa: BLE001 — a solve/store failure

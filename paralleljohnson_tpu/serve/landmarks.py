@@ -36,6 +36,87 @@ from paralleljohnson_tpu.utils.checkpoint import graph_digest
 
 LANDMARKS_FILENAME = "landmarks.npz"
 
+# Pivot pickers for :meth:`LandmarkIndex.build` (ISSUE 16 satellite).
+PIVOT_PICKERS = ("uniform", "coverage")
+
+
+def widen_bounds(lower, upper, *, nonnegative: bool):
+    """The f32-slack widening + non-negative clamp, split out of
+    :meth:`LandmarkIndex.bounds_row` so the device-resident query path
+    (``serve/device_query.py``) can compute the RAW min/max/add/sub
+    bounds on-device and finish them through this exact host code —
+    bitwise identity between the lookup paths is then a consequence of
+    running the same instructions, not a numerical accident.
+
+    The triangle inequality is exact for TRUE distances, but the
+    solver's rows are f32 path sums — two independently rounded sums can
+    violate it by a few ULP. Widen both bounds by a small relative
+    tolerance (the ops/pred.py tight-edge idiom) so ``lower <= exact <=
+    upper`` is a contract, not a coin flip; the widening is ~1e-5
+    relative — invisible next to any real approximation gap. The clamp
+    at 0 (non-negative graphs) and +inf values stay exact: no slack
+    applies to them."""
+    tol = 32 * float(np.finfo(np.float32).eps)
+    with np.errstate(invalid="ignore"):  # inf-inf in discarded branches
+        finite_lo = np.isfinite(lower)
+        lower = np.where(
+            finite_lo, lower - tol * (1.0 + np.abs(lower)), lower
+        )
+        finite_up = np.isfinite(upper)
+        upper = np.where(
+            finite_up, upper + tol * (1.0 + np.abs(upper)), upper
+        )
+    if nonnegative:
+        lower = np.maximum(lower, 0.0)
+    return lower, upper
+
+
+def finish_estimates(lower, upper):
+    """``(estimates, max_errors)`` from WIDENED bounds — the serving
+    contract per entry: proven-inf pairs report ``(inf, 0)``, unknown
+    ones ``(inf, inf)``, everything else ``(upper, upper - lower)``.
+    Shared by the host and device lookup paths (same rationale as
+    :func:`widen_bounds`)."""
+    proven_inf = np.isinf(lower) & (lower > 0)
+    est = np.where(proven_inf, np.inf, upper)
+    with np.errstate(invalid="ignore"):
+        gap = upper - lower
+    err = np.where(proven_inf, 0.0,
+                   np.where(np.isfinite(gap), gap, np.inf))
+    return est, err
+
+
+def pick_pivots(graph, k: int, *, seed: int = 0,
+                picker: str = "uniform") -> np.ndarray:
+    """Seeded pivot draw. ``"uniform"`` (the default, unchanged) draws
+    without replacement from all vertices; ``"coverage"`` weights the
+    draw by total degree (in + out + 1) — on power-law graphs the
+    high-degree hubs sit on far more shortest paths, so a pivot set
+    biased toward them tightens the triangle-inequality interval for
+    the same k (the partitioned route's boundary-vertex observation).
+    Both are deterministic for a given (graph, k, seed)."""
+    if picker not in PIVOT_PICKERS:
+        raise ValueError(
+            f"picker must be one of {PIVOT_PICKERS}, got {picker!r}"
+        )
+    v = graph.num_nodes
+    k = max(0, min(int(k), v))
+    if k == 0:
+        return np.zeros(0, np.int64)
+    rng = np.random.default_rng(seed)
+    if picker == "coverage":
+        indptr = np.asarray(graph.indptr, np.int64)
+        out_deg = np.diff(indptr)
+        # Only CSR-owned edges count — the pad tail (indices past
+        # indptr[-1]) belongs to no row and must not skew vertex 0.
+        in_deg = np.bincount(
+            np.asarray(graph.indices[:indptr[-1]], np.int64),
+            minlength=v,
+        )[:v]
+        w = (out_deg + in_deg + 1).astype(np.float64)
+        return np.sort(rng.choice(v, size=k, replace=False, p=w / w.sum()))
+    return np.sort(rng.choice(v, size=k, replace=False))
+
 
 @dataclasses.dataclass
 class Bounds:
@@ -98,18 +179,18 @@ class LandmarkIndex:
 
     @classmethod
     def build(cls, graph, k: int, *, config=None, seed: int = 0,
-              solver=None) -> "LandmarkIndex":
+              solver=None, picker: str = "uniform") -> "LandmarkIndex":
         """Solve ``k`` seeded pivots exactly (forward + reverse graph)
         through the resilient solver — retries, OOM degradation, and the
-        pipeline all apply, exactly like any other solve."""
+        pipeline all apply, exactly like any other solve. ``picker``
+        selects the pivot draw (:func:`pick_pivots`): ``"uniform"``
+        (default, unchanged) or ``"coverage"`` (degree-weighted, for
+        power-law graphs)."""
         from paralleljohnson_tpu.solver import ParallelJohnsonSolver
 
         v = graph.num_nodes
-        k = max(0, min(int(k), v))
-        rng = np.random.default_rng(seed)
-        pivots = np.sort(rng.choice(v, size=k, replace=False)) if k else (
-            np.zeros(0, np.int64)
-        )
+        pivots = pick_pivots(graph, k, seed=seed, picker=picker)
+        k = len(pivots)
         if solver is None:
             solver = ParallelJohnsonSolver(config)
         if k == 0:
@@ -129,18 +210,21 @@ class LandmarkIndex:
         row = self.bounds_row(s, np.array([t], np.int64))
         return Bounds(lower=float(row[0][0]), upper=float(row[1][0]))
 
-    def bounds_row(self, s: int, dsts: np.ndarray | None = None):
-        """Vectorized one-to-many bounds from source ``s``: returns
-        ``(lower[len(dsts)], upper[len(dsts)])`` (all V destinations when
-        ``dsts`` is None)."""
+    def raw_bounds_row(self, s: int, dsts: np.ndarray | None = None):
+        """The pure add/sub/min/max triangle-inequality bounds, BEFORE
+        the f32-slack widening and non-negative clamp (those live in
+        :func:`widen_bounds`). This split is the device-parity seam: the
+        raw part is elementwise adds/subs plus order-independent min/max
+        reductions over values that are never NaN, so a device kernel
+        computing it in f64 is bitwise identical to this numpy code —
+        the finishing always runs on host through the shared helpers."""
         d_s_L = self.rev[:, s]          # [k]  d(s, L)
         d_L_s = self.fwd[:, s]          # [k]  d(L, s)
         fwd_t = self.fwd if dsts is None else self.fwd[:, dsts]  # [k, D]
         rev_t = self.rev if dsts is None else self.rev[:, dsts]  # [k, D]
         n_dst = fwd_t.shape[1]
         if self.k == 0:
-            lower = np.zeros(n_dst) if self.nonnegative else np.full(n_dst, -np.inf)
-            return lower, np.full(n_dst, np.inf)
+            return np.full(n_dst, -np.inf), np.full(n_dst, np.inf)
         with np.errstate(invalid="ignore"):
             upper_c = d_s_L[:, None] + fwd_t        # path s -> L -> t
             # inf + inf = inf is fine; (+inf) + (-anything) never occurs
@@ -152,27 +236,19 @@ class LandmarkIndex:
             # d(s,L) - d(t,L) valid iff d(t,L) finite; vacuous -> -inf.
             b = np.where(np.isfinite(rev_t), d_s_L[:, None] - rev_t, -np.inf)
         lower = np.maximum(np.max(a, axis=0), np.max(b, axis=0))
-        # f32 slack: the triangle inequality is exact for TRUE distances,
-        # but the solver's rows are f32 path sums — two independently
-        # rounded sums can violate it by a few ULP. Widen both bounds by
-        # a small relative tolerance (the ops/pred.py tight-edge idiom)
-        # so `lower <= exact <= upper` is a contract, not a coin flip;
-        # the widening is ~1e-5 relative — invisible next to any real
-        # approximation gap. The clamp at 0 (non-negative graphs) and
-        # +inf values stay exact: no slack applies to them.
-        tol = 32 * float(np.finfo(np.float32).eps)
-        with np.errstate(invalid="ignore"):  # inf-inf in discarded branches
-            finite_lo = np.isfinite(lower)
-            lower = np.where(
-                finite_lo, lower - tol * (1.0 + np.abs(lower)), lower
-            )
-            finite_up = np.isfinite(upper)
-            upper = np.where(
-                finite_up, upper + tol * (1.0 + np.abs(upper)), upper
-            )
-        if self.nonnegative:
-            lower = np.maximum(lower, 0.0)
         return lower, upper
+
+    def bounds_row(self, s: int, dsts: np.ndarray | None = None):
+        """Vectorized one-to-many bounds from source ``s``: returns
+        ``(lower[len(dsts)], upper[len(dsts)])`` (all V destinations when
+        ``dsts`` is None)."""
+        if self.k == 0:
+            n_dst = self.fwd.shape[1] if dsts is None else len(dsts)
+            lower = np.zeros(n_dst) if self.nonnegative else np.full(
+                n_dst, -np.inf)
+            return lower, np.full(n_dst, np.inf)
+        lower, upper = self.raw_bounds_row(s, dsts)
+        return widen_bounds(lower, upper, nonnegative=self.nonnegative)
 
     def estimate(self, s: int, t: int) -> tuple[float, float]:
         """``(estimate, max_error)`` for one pair — the serving contract:
@@ -185,13 +261,7 @@ class LandmarkIndex:
         """Vectorized :meth:`estimate` — ``(estimates, max_errors)``
         arrays for a one-to-many query, same per-entry semantics."""
         lower, upper = self.bounds_row(s, dsts)
-        proven_inf = np.isinf(lower) & (lower > 0)
-        est = np.where(proven_inf, np.inf, upper)
-        with np.errstate(invalid="ignore"):
-            gap = upper - lower
-        err = np.where(proven_inf, 0.0,
-                       np.where(np.isfinite(gap), gap, np.inf))
-        return est, err
+        return finish_estimates(lower, upper)
 
     # -- persistence ---------------------------------------------------------
 

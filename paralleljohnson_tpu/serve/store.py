@@ -97,6 +97,11 @@ class TileStore:
         self._warm: collections.OrderedDict = collections.OrderedDict()
         self._cold_index: dict[int, tuple[int, str]] | None = None
         self._lock = threading.Lock()
+        # Mutation counter for the device-tile view (ISSUE 16): bumped
+        # whenever hot-tier MEMBERSHIP or staleness can change, so a
+        # cached [B, V] device tile can validate itself with one integer
+        # compare instead of re-reading the tier.
+        self._version = 0
         self.hits_hot = 0
         self.hits_warm = 0
         self.hits_cold = 0
@@ -156,6 +161,7 @@ class TileStore:
             raise ValueError(f"tier must be hot/warm, got {tier!r}")
         sources = np.asarray(sources, np.int64)
         with self._lock:
+            self._version += 1
             for i, s in enumerate(sources):
                 s = int(s)
                 row = rows[i]
@@ -217,6 +223,55 @@ class TileStore:
         with self._lock:
             self._cold_index = None
 
+    # -- device-tile view (ISSUE 16: the device-resident query path) ---------
+
+    def hot_token(self):
+        """Opaque freshness token for :meth:`hot_view` snapshots: changes
+        whenever hot membership OR staleness may have changed (covers
+        both manual marks and the on-disk repair marker's mtime key).
+        Compare tokens with ``==`` only."""
+        with self._lock:
+            self._repair_stale()  # refresh the marker's mtime cache key
+            return (self._version, self._stale_cache_key)
+
+    def hot_view(self):
+        """``(token, [(source, row), ...])`` — a snapshot of the hot
+        tier EXCLUDING stale sources, in LRU order (coldest first), with
+        rows exactly as the backend returned them (device-resident for
+        device backends). The token is :meth:`hot_token` at snapshot
+        time: a device tile stacked from this view is valid while the
+        store keeps returning the same token. Stale rows are excluded by
+        construction — a megabatched kernel must never gather a row the
+        host path would have flagged (the host path still serves them,
+        with ``stale: true``)."""
+        with self._lock:
+            stale = self.stale_info()
+            token = (self._version, self._stale_cache_key)
+            if stale == "all":
+                return token, []
+            if stale is None:
+                items = list(self._hot.items())
+            else:
+                items = [(s, r) for s, r in self._hot.items()
+                         if s not in stale]
+            return token, items
+
+    def note_hot_hits(self, sources) -> int:
+        """Account device-path lookups that bypassed :meth:`get`: counts
+        one hot hit and refreshes LRU position per source still in the
+        hot tier (so hit counters and eviction order are identical
+        whichever lookup path served the batch). Returns how many
+        sources were actually hot."""
+        n = 0
+        with self._lock:
+            for s in sources:
+                s = int(s)
+                if s in self._hot:
+                    self._hot.move_to_end(s)
+                    self.hits_hot += 1
+                    n += 1
+        return n
+
     # -- staleness (ISSUE 11: stale-but-servable during repair) --------------
 
     def mark_stale(self, sources) -> None:
@@ -233,11 +288,15 @@ class TileStore:
                 fresh if self._manual_stale is None
                 else self._manual_stale | fresh
             )
+        with self._lock:
+            self._version += 1
 
     def clear_stale(self) -> None:
         """Drop the MANUAL stale marks (the repair-status marker, if
         present on disk, still applies — it records durable fact)."""
         self._manual_stale = None
+        with self._lock:
+            self._version += 1
 
     def _repair_stale(self) -> "set[int] | str | None":
         """The repair-status marker's affected set, mtime-cached so the

@@ -55,6 +55,40 @@ def _default_history() -> str:
     )
 
 
+def _contract_failures(files: list[str]) -> list[dict]:
+    """Fresh rows whose detail declares ``failed`` — e.g. the
+    serve_queries host/device lookup parity break (ISSUE 16). The
+    normalizer rightly drops them from the HISTORY (a failed run is not
+    a measurement), but to the GATE they are an unconditional flunk,
+    not a skip."""
+    out = []
+    for f in files:
+        try:
+            text = Path(f).read_text(encoding="utf-8")
+        except OSError:
+            continue
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(obj, dict):
+                continue
+            detail = obj.get("detail")
+            if isinstance(detail, dict) and "failed" in detail:
+                out.append({
+                    "bench": obj.get("config") or obj.get("bench"),
+                    "backend": obj.get("backend"),
+                    "preset": obj.get("preset"),
+                    "failed": detail["failed"],
+                    "source": f,
+                })
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="grade fresh bench rows against their history; "
@@ -109,6 +143,7 @@ def main(argv: list[str] | None = None) -> int:
               f"{hist.path}", file=sys.stderr)
 
     history = hist.rows()
+    failures = _contract_failures(args.fresh)
     if args.fresh:
         fresh = []
         for f in args.fresh:
@@ -121,7 +156,7 @@ def main(argv: list[str] | None = None) -> int:
     else:
         n = max(0, args.last)
         fresh, history = history[len(history) - n:], history[: len(history) - n]
-    if not fresh:
+    if not fresh and not failures:
         print("bench-regress: nothing to grade (empty history and no "
               "--fresh rows)", file=sys.stderr)
         return 0
@@ -144,7 +179,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.as_json:
         print(json.dumps({
             "graded": graded, "history_rows": len(history),
-            "flagged": flagged, "band": args.band,
+            "flagged": flagged, "contract_failures": failures,
+            "band": args.band,
         }))
     else:
         print(f"bench-regress: graded {graded} row(s) against "
@@ -173,9 +209,15 @@ def main(argv: list[str] | None = None) -> int:
                 f"({f['slowdown']:.2f}x) — roofline: "
                 f"{f['roofline_bound']}"
             )
-        if not flagged:
+        for f in failures:
+            print(
+                f"  CONTRACT FAILURE {f['bench']} [{f['backend']}"
+                + (f"/{f['preset']}" if f.get("preset") else "")
+                + f"]: {f['failed']}"
+            )
+        if not flagged and not failures:
             print("  OK — every graded row is within its noise band")
-    if flagged:
+    if flagged or failures:
         return 1
     if args.update and args.fresh:
         added = sum(int(hist.append(r)) for r in fresh)

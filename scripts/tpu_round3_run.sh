@@ -211,8 +211,15 @@ run 1800 jax-rmat-pipelined python -m paralleljohnson_tpu.cli bench rmat_apsp_pi
 run 900 serve-smoke python scripts/serve_smoke.py
 
 # 4g) the recorded serving bench row (queries/sec + p50/p99 latency in
-#     the detail column — serving performance tracked like kernels)
-run 900 jax-serve-bench python -m paralleljohnson_tpu.cli bench serve_queries --backend jax --preset full --update-baseline BASELINE.md
+#     the detail column — serving performance tracked like kernels).
+#     Since ISSUE 16 the row also carries the host-vs-device lookup
+#     contrast: K >= 16 clients through the MicroBatcher per forced
+#     path, bitwise-identical answers asserted in-bench (a parity
+#     break marks the row failed), walls + speedup + the auto
+#     planner's why-line in detail.lookup — on a TPU backend the
+#     device column is the headline, exact gathers megabatch in f32
+#     while landmark bounds stay host-side (no native f64)
+run 900 jax-serve-queries python -m paralleljohnson_tpu.cli bench serve_queries --backend jax --preset full --update-baseline BASELINE.md
 
 # 4g') traffic-front-end chaos drill (ISSUE 15 tentpole): injected
 #      serve_accept/serve_lookup/serve_solve faults through real

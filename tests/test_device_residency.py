@@ -74,3 +74,178 @@ def test_sssp_row_on_device_and_path_walk(neg_graph):
     path = res.path(0, target)
     assert path == [] or (path[0] == 0 and path[-1] == target)
     assert all(isinstance(v, int) for v in path)
+
+
+# -- device-resident query path (ISSUE 16 tentpole) ---------------------------
+#
+# The serving-tier twin of the residency contract above: megabatched
+# device lookups must be BITWISE equal to the host tier walk, the
+# cached tile must invalidate on evict/stale, and stale rows must never
+# be gatherable from the device.
+
+import json
+
+from paralleljohnson_tpu.serve import (
+    DeviceQueryPath,
+    LandmarkIndex,
+    QueryEngine,
+    TileStore,
+)
+from paralleljohnson_tpu.graphs import erdos_renyi
+
+
+def _serve_cfg(**kw):
+    return SolverConfig(backend="numpy", **kw)
+
+
+def _engine(tmp_path, *, device_lookup, hot_rows=64, landmarks=True, n=48):
+    g = erdos_renyi(n, 0.08, seed=3)
+    cfg = _serve_cfg()
+    lm = LandmarkIndex.build(g, k=4, config=cfg) if landmarks else None
+    store = TileStore(tmp_path, g, hot_rows=hot_rows)
+    return g, QueryEngine(g, store, config=cfg, landmarks=lm,
+                          device_lookup=device_lookup)
+
+
+def _mixed_requests(n, rng):
+    reqs = []
+    for i in range(24):
+        kind = i % 4
+        s = int(rng.integers(0, n))
+        if kind == 0:
+            reqs.append({"id": i, "source": s, "dst": int(rng.integers(0, n))})
+        elif kind == 1:
+            dsts = [int(d) for d in rng.integers(0, n, size=3)]
+            reqs.append({"id": i, "source": s, "dst": dsts})
+        elif kind == 2:
+            reqs.append({"id": i, "source": s})  # full row
+        else:
+            reqs.append({"id": i, "source": s,
+                         "dst": int(rng.integers(0, n)), "mode": "approx"})
+    return reqs
+
+
+def _canon(responses):
+    return json.dumps(responses, sort_keys=True)
+
+
+def test_device_vs_host_bitwise_parity_across_tiers(tmp_path):
+    """Forced-device and forced-host engines over identical stores must
+    answer an exact/landmark/row/pair mix IDENTICALLY — the design
+    invariant the planner's bit-for-bit promise rests on."""
+    rng = np.random.default_rng(0)
+    g, host = _engine(tmp_path / "h", device_lookup="off")
+    _, dev = _engine(tmp_path / "d", device_lookup="on")
+    warm = list(range(0, 48, 2))
+    host.warm(warm)
+    dev.warm(warm)
+    reqs = _mixed_requests(48, rng)
+    a = host.query_batch([dict(r) for r in reqs])
+    b = dev.query_batch([dict(r) for r in reqs])
+    assert _canon(a) == _canon(b)
+    # The device engine actually used the device for the hot sources.
+    assert dev.stats.device_lookups > 0
+    assert host.stats.device_lookups == 0
+    assert host.stats.host_lookups > 0
+
+
+def test_raw_landmark_bounds_bitwise_vs_numpy(tmp_path):
+    """The on-device raw bound kernel against the host raw_bounds_row
+    twin — int64 bit views, not allclose."""
+    g = erdos_renyi(48, 0.08, seed=3)
+    cfg = _serve_cfg()
+    lm = LandmarkIndex.build(g, k=4, config=cfg)
+    store = TileStore(tmp_path, g)
+    path = DeviceQueryPath(store, lm)
+    if not path.landmark_device_ok():
+        pytest.skip("no native f64 on this backend")
+    rng = np.random.default_rng(1)
+    s_idx = rng.integers(0, 48, size=13)
+    t_idx = rng.integers(0, 48, size=13)
+    lo_d, up_d = path.landmark_pairs(s_idx, t_idx)
+    for i, (s, t) in enumerate(zip(s_idx, t_idx)):
+        lo_h, up_h = lm.raw_bounds_row(int(s), np.asarray([int(t)]))
+        assert lo_d[i].tobytes() == lo_h[0].tobytes()
+        assert up_d[i].tobytes() == up_h[0].tobytes()
+    lo_r, up_r = path.landmark_rows(s_idx[:9])
+    for i, s in enumerate(s_idx[:9]):
+        lo_h, up_h = lm.raw_bounds_row(int(s), None)
+        assert lo_r[i].tobytes() == lo_h.tobytes()
+        assert up_r[i].tobytes() == up_h.tobytes()
+
+
+def test_eviction_mid_batch_invalidates_tile(tmp_path):
+    """LRU eviction between batches must rebuild the tile (version
+    token), and evicted sources must answer via host without drift."""
+    g, dev = _engine(tmp_path, device_lookup="on", hot_rows=8)
+    dev.warm(range(8))
+    r0 = dev.query(0, 5)
+    path = dev._device_path_maybe()
+    rebuilds0 = path.tile_rebuilds
+    # Warming 8 more evicts the first 8 from hot (capacity 8).
+    dev.warm(range(8, 16))
+    r1 = dev.query(8, 5)
+    assert path.tile_rebuilds > rebuilds0
+    # Source 0 fell to warm: still answerable, bitwise vs a fresh ask.
+    r2 = dev.query(0, 5)
+    assert r2["distance"] == r0["distance"]
+    assert r1["exact"] and r2["exact"]
+
+
+def test_stale_rows_never_in_device_tile(tmp_path):
+    """A stale-flagged row must leave the tile immediately — the kernel
+    can then never gather it, and the host path (which attaches the
+    stale flag + max_error) owns the answer."""
+    g, dev = _engine(tmp_path, device_lookup="on")
+    dev.warm(range(8))
+    dev.query(1, 3)  # builds the tile
+    path = dev._device_path_maybe()
+    assert 1 in path.refresh()
+    dev.store.mark_stale([1])
+    slots = path.refresh()
+    assert 1 not in slots  # excluded at build, not filtered per query
+    r = dev.query(1, 3)
+    assert r["stale"] is True and "max_error" in r
+
+
+def test_forcing_either_path_reproduces_the_other(tmp_path):
+    """planner contract: device_lookup='on'/'off' answers are
+    interchangeable, and the auto decision records a why-line."""
+    rng = np.random.default_rng(7)
+    g, auto = _engine(tmp_path / "a", device_lookup="auto")
+    _, on = _engine(tmp_path / "b", device_lookup="on")
+    _, off = _engine(tmp_path / "c", device_lookup="off")
+    for e in (auto, on, off):
+        e.warm(range(0, 48, 3))
+    reqs = _mixed_requests(48, rng)
+    outs = [e.query_batch([dict(r) for r in reqs]) for e in (auto, on, off)]
+    assert _canon(outs[0]) == _canon(outs[1]) == _canon(outs[2])
+    d = auto.last_lookup_decision
+    assert d is not None and d["chosen"] in ("host_lookup", "device_lookup")
+    assert d["reason"]
+    assert on.last_lookup_decision["chosen"] == "device_lookup"
+    assert "forced" in on.last_lookup_decision["reason"]
+    assert off.last_lookup_decision["chosen"] == "host_lookup"
+
+
+def test_hit_accounting_identical_across_paths(tmp_path):
+    """Device lookups must keep the store's hit counters and LRU order
+    semantics — note_hot_hits is the bridge."""
+    g, host = _engine(tmp_path / "h", device_lookup="off")
+    _, dev = _engine(tmp_path / "d", device_lookup="on")
+    host.warm(range(16))
+    dev.warm(range(16))
+    reqs = [{"source": i % 16, "dst": (i * 5) % 48} for i in range(20)]
+    host.query_batch([dict(r) for r in reqs])
+    dev.query_batch([dict(r) for r in reqs])
+    assert host.store.hits_hot == dev.store.hits_hot > 0
+
+
+def test_tiny_batch_stays_on_host_under_auto(tmp_path):
+    """Below MIN_DEVICE_LOOKUP_BATCH the auto planner keeps the host
+    walk even where a device exists — no per-query launch tax."""
+    g, auto = _engine(tmp_path, device_lookup="auto")
+    auto.warm(range(8))
+    auto.query(1, 2)  # batch of one
+    d = auto.last_lookup_decision
+    assert d["chosen"] == "host_lookup"

@@ -36,6 +36,7 @@ from paralleljohnson_tpu.observe.live import SLO
 from paralleljohnson_tpu.serve import (
     PROTOCOL,
     LandmarkIndex,
+    MicroBatcher,
     QueryEngine,
     ServeFrontend,
     TileStore,
@@ -594,3 +595,141 @@ def test_sigkill_mid_socket_traffic_leaves_readable_snapshots(tmp_path):
     assert payload["engine"]["queries_total"] >= 3
     live = json.loads((graph_dir / "serve_live.json").read_text())
     assert live["kind"] == "live_metrics"
+
+
+# -- micro-batching (ISSUE 16: convoy combining into device-width batches) ----
+
+
+class _RecordingEngine:
+    """A stand-in engine that records batch widths and echoes ids."""
+
+    def __init__(self, delay_s=0.0):
+        self.widths = []
+        self.delay_s = delay_s
+
+    def query_batch(self, reqs):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.widths.append(len(reqs))
+        return [{"id": r.get("id"), "ok": True} for r in reqs]
+
+
+def _submit_all(mb, n):
+    out = [None] * n
+
+    def worker(i):
+        out[i] = mb.submit({"id": i})
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return out
+
+
+def test_microbatcher_combines_and_routes_by_slot():
+    eng = _RecordingEngine(delay_s=0.005)
+    mb = MicroBatcher(eng, max_width=8, wait_ms=0.0)
+    out = _submit_all(mb, 24)
+    # Every submitter got ITS response back (slot routing, not ids).
+    assert [o["id"] for o in out] == list(range(24))
+    assert sum(eng.widths) == 24
+    # The leader's batch-execution time convoys followers: widths
+    # beyond 1 appear without any configured wait, and `combined`
+    # counts exactly the members of those width>1 batches.
+    assert max(eng.widths) > 1
+    assert mb.combined == sum(w for w in eng.widths if w > 1)
+    assert mb.batches == len(eng.widths)
+
+
+def test_microbatcher_width_cap_is_hard():
+    eng = _RecordingEngine(delay_s=0.01)
+    mb = MicroBatcher(eng, max_width=4, wait_ms=2.0)
+    _submit_all(mb, 17)
+    assert max(eng.widths) <= 4
+    assert sum(eng.widths) == 17
+
+
+def test_microbatcher_single_caller_zero_wait_no_latency_tax():
+    eng = _RecordingEngine()
+    mb = MicroBatcher(eng, max_width=32, wait_ms=0.0)
+    t0 = time.perf_counter()
+    out = mb.submit({"id": 0})
+    dt = time.perf_counter() - t0
+    assert out["id"] == 0 and eng.widths == [1]
+    assert dt < 0.5  # no sleep on the solo path
+
+
+def test_microbatcher_exception_reaches_every_member():
+    class _Boom:
+        def query_batch(self, reqs):
+            raise RuntimeError("store exploded")
+
+    mb = MicroBatcher(_Boom(), max_width=8, wait_ms=1.0)
+    errs = []
+
+    def worker(i):
+        try:
+            mb.submit({"id": i})
+        except RuntimeError as e:
+            errs.append(str(e))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert errs == ["store exploded"] * 6
+
+
+def test_frontend_batches_concurrent_socket_clients(tmp_path):
+    """K concurrent socket clients must land in combined engine batches
+    and still each receive their own (bitwise-correct) answer."""
+    g, engine, frontend = _world(tmp_path, batch_window=8,
+                                 batch_wait_ms=2.0, max_inflight=16)
+    try:
+        n = 12
+        answers = [None] * n
+        gate = threading.Barrier(n)
+
+        def one(i):
+            c = _Client(frontend)
+            try:
+                gate.wait(timeout=10)  # connect first, then fire together
+                answers[i] = c.ask(
+                    {"op": "query", "id": i, "source": i, "dst": (i + 3) % 32})
+            finally:
+                c.close()
+
+        ts = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(a is not None and "error" not in a for a in answers)
+        for i, a in enumerate(answers):
+            assert a["id"] == i
+            assert a["distance"] == engine.query(i, (i + 3) % 32)["distance"]
+        assert frontend.batcher is not None
+        assert frontend.batcher.combined > 0  # some convoys formed
+        # batch_width histogram observed the convoy widths
+        stats = engine.stats.as_dict()
+        assert "batch_width_p50" in stats
+    finally:
+        frontend.drain()
+
+
+def test_frontend_batch_window_one_disables_batching(tmp_path):
+    g, engine, frontend = _world(tmp_path, batch_window=1)
+    try:
+        assert frontend.batcher is None
+        c = _Client(frontend)
+        try:
+            r = c.ask({"op": "query", "source": 1, "dst": 2})
+            assert "distance" in r
+        finally:
+            c.close()
+        assert frontend.health()["batch_window"] == 1
+    finally:
+        frontend.drain()

@@ -430,3 +430,63 @@ def test_planner_dispatch_bench_smoke():
     assert len(d["graphs"]) == 3
     for g in d["graphs"].values():
         assert g["pick"] is not None
+
+
+# -- lookup-path plans (ISSUE 16: host vs device serving dispatch) ------------
+
+
+def _lookup_ctx(**kw):
+    import types
+
+    base = dict(platform="cpu", device_available=True, device_reason="",
+                n_device_eligible=8, forced_on=False)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+def _select_lookup(ctx, *, device_lookup="auto", batch=8):
+    import types
+
+    from paralleljohnson_tpu import planner
+
+    return planner.select(
+        planner.LOOKUP_PLANS, ctx, platform=ctx.platform, num_edges=1000,
+        batch=batch, config=types.SimpleNamespace(device_lookup=device_lookup))
+
+
+def test_lookup_auto_on_cpu_defaults_to_host():
+    d = _select_lookup(_lookup_ctx())
+    assert d.chosen.plan.name == "host_lookup"
+    # The device candidate's why-line must say WHY it lost.
+    cands = {c.plan.name: c for c in d.candidates}
+    assert "measured default" in cands["device_lookup"].reason
+
+
+def test_lookup_forced_device_pins_when_available():
+    d = _select_lookup(_lookup_ctx(forced_on=True), device_lookup="on")
+    assert d.chosen.plan.name == "device_lookup"
+    assert "forced" in d.reason
+
+
+def test_lookup_forced_off_pins_host():
+    d = _select_lookup(_lookup_ctx(), device_lookup="off")
+    assert d.chosen.plan.name == "host_lookup"
+    assert "forced" in d.reason
+
+
+def test_lookup_tiny_batch_disqualifies_device():
+    from paralleljohnson_tpu import planner
+
+    d = _select_lookup(_lookup_ctx(n_device_eligible=1), batch=1)
+    assert d.chosen.plan.name == "host_lookup"
+    cands = {c.plan.name: c for c in d.candidates}
+    assert not cands["device_lookup"].qualified
+    assert str(planner.MIN_DEVICE_LOOKUP_BATCH) in cands["device_lookup"].reason
+
+
+def test_lookup_device_unavailable_reason_surfaces():
+    d = _select_lookup(
+        _lookup_ctx(device_available=False, device_reason="jax unavailable"))
+    assert d.chosen.plan.name == "host_lookup"
+    cands = {c.plan.name: c for c in d.candidates}
+    assert "jax unavailable" in cands["device_lookup"].reason

@@ -358,6 +358,12 @@ def test_bench_emits_serving_row():
     assert detail["slo"]["verdict"] in ("ok", "burn")
     assert detail["slo"]["p99_target_ms"] > 0
     assert 0.0 < detail["hit_rate"] <= 1.0
+    # ISSUE 16: the host-vs-device lookup contrast at K >= 16 clients.
+    lk = detail["lookup"]
+    assert lk["clients"] >= 16
+    assert lk["bitwise_identical"] is True
+    assert lk["wall_host_s"] > 0 and lk["wall_device_s"] > 0
+    assert lk["auto_decision"]["chosen"] in ("host_lookup", "device_lookup")
 
 
 def test_cli_serve_jsonl_loop(tmp_path, capsys):
@@ -581,3 +587,72 @@ def test_serve_prom_histogram_and_burn_gauge(tmp_path):
     assert "pjtpu_query_latency_p50_ms" not in text  # removed (deprecated)
     assert "pjtpu_query_latency_p99_ms" not in text
     assert 'pjtpu_slo_burn_rate{command="serve",slo="serve"}' in text
+
+
+# -- stale-answer honesty + pivot pickers (ISSUE 16 satellites) ---------------
+
+
+def test_stale_exact_answer_carries_max_error(tmp_path):
+    """A stale (pre-update) hit stays bitwise-exact against the OLD
+    graph but must carry a landmark-derived max_error drift estimate —
+    never an unflagged number."""
+    from paralleljohnson_tpu.serve import LandmarkIndex
+
+    g = erdos_renyi(48, 0.08, seed=3)
+    lm = LandmarkIndex.build(g, 4, config=_cfg(), seed=0)
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg(),
+                         landmarks=lm)
+    fresh = engine.query(2, 7)
+    assert fresh["max_error"] == 0.0 and "stale" not in fresh
+    engine.store.mark_stale([2])
+    r = engine.query(2, 7)
+    assert r["stale"] is True and r["exact"] is True
+    assert r["distance"] == fresh["distance"]  # still the old bits
+    assert r["max_error"] >= 0.0  # honest drift estimate attached
+    # Full-row stale answers carry a per-destination bound too.
+    row = engine.query(2)
+    assert row["stale"] is True
+    assert len(row["max_error"]) == 48
+
+
+def test_stale_answer_without_landmarks_reports_inf(tmp_path):
+    """No index -> no drift estimate -> the bound must say so (inf),
+    not silently omit the field."""
+    g = erdos_renyi(32, 0.1, seed=5)
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg())
+    engine.query(1, 3)
+    engine.store.mark_stale([1])
+    r = engine.query(1, 3)
+    assert r["stale"] is True
+    assert r["max_error"] == float("inf") or np.isinf(r["max_error"])
+
+
+def test_coverage_pivot_picker_valid_and_deterministic():
+    from paralleljohnson_tpu.serve import PIVOT_PICKERS, pick_pivots
+
+    assert "coverage" in PIVOT_PICKERS and "uniform" in PIVOT_PICKERS
+    g = erdos_renyi(64, 0.08, seed=9)
+    a = pick_pivots(g, 6, seed=4, picker="coverage")
+    b = pick_pivots(g, 6, seed=4, picker="coverage")
+    u = pick_pivots(g, 6, seed=4, picker="uniform")
+    assert np.array_equal(a, b)  # same seed, same pivots
+    assert len(set(a.tolist())) == 6 and a.min() >= 0 and a.max() < 64
+    assert np.all(np.diff(a) > 0)  # sorted, distinct
+    assert not np.array_equal(a, u) or len(a) == 64  # the flag matters
+    with pytest.raises(ValueError):
+        pick_pivots(g, 6, picker="degree")
+
+
+def test_coverage_picker_bounds_still_certified():
+    """Whatever the picker, the landmark contract holds: lower <= d <=
+    upper with f32 slack."""
+    from paralleljohnson_tpu.serve import LandmarkIndex
+
+    g = erdos_renyi(48, 0.1, seed=2)
+    exact = _exact_matrix(g)
+    lm = LandmarkIndex.build(g, 5, config=_cfg(), seed=1,
+                             picker="coverage")
+    for s in range(0, 48, 7):
+        lower, upper = lm.bounds_row(s)
+        assert np.all(lower <= exact[s] + 1e-6)
+        assert np.all(exact[s] <= upper + 1e-6)

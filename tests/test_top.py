@@ -180,3 +180,41 @@ def test_top_tolerates_missing_sources(tmp_path):
     assert "error" in doc["fleet"]
     text = render_ops(doc)
     assert "FLEET" in text
+
+
+def test_top_lookup_path_line(tmp_path):
+    """ISSUE 16: device/host lookup counters and the aggregated batch
+    width percentiles surface in the gathered document and the render;
+    an engine that never moved either counter keeps the old layout."""
+    import time as _time
+
+    from paralleljohnson_tpu.serve.engine import SERVE_STATS_FILENAME
+
+    d = tmp_path / "graph_feed"
+    d.mkdir(parents=True)
+    now = _time.time()
+    (d / SERVE_STATS_FILENAME).write_text(json.dumps({
+        "ts": now, "pid": 99,
+        "engine": {
+            "queries_total": 60, "errors": 0, "stale_answers": 0,
+            "device_lookups": 41, "host_lookups": 19,
+            "batch_width_p50": 8.0, "batch_width_p99": 16.0,
+            "p50_ms": 1.0, "p50_err_ms": 0.1,
+            "p99_ms": 5.0, "p99_err_ms": 0.5,
+            "hits_by_tier": {"hot": 41},
+        },
+        "store": {"hit_rate": 0.9, "digest": "feed"},
+    }))
+    doc = gather_ops(serve_store=tmp_path, now=now)
+    s = doc["serve"][0]["serve"]
+    assert s["device_lookups"] == 41 and s["host_lookups"] == 19
+    assert s["batch_width_p50"] == 8.0
+    text = render_ops(doc)
+    assert "lookups device 41 / host 19" in text
+    assert "batch-width p50 8.00 p99 16.00" in text
+    payload = json.loads((d / SERVE_STATS_FILENAME).read_text())
+    payload["engine"]["device_lookups"] = 0
+    payload["engine"]["host_lookups"] = 0
+    (d / SERVE_STATS_FILENAME).write_text(json.dumps(payload))
+    text = render_ops(gather_ops(serve_store=tmp_path, now=now))
+    assert "lookups device" not in text
