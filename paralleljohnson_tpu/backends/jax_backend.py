@@ -1522,287 +1522,309 @@ class JaxBackend(Backend):
         )
 
     def bellman_ford(self, dgraph: JaxDeviceGraph, source: int | None) -> KernelResult:
+        """B=1 (SSSP / virtual-source) dispatch through the priced
+        planner registry (ISSUE 17 satellite; the route ladder that
+        survived the round-19 ``multi_source`` conversion is gone):
+        ``planner.select`` over ``SSSP_PLANS`` evaluates the same
+        ``_use_*`` gates the ladder consulted, so with nothing priced
+        the ranking IS the old ladder order and dispatch (therefore
+        distances) is bit-for-bit what the ladder produced. The walk
+        degrades don't-crash exactly like ``multi_source``: an auto
+        plan that raises warns once + disables itself for this backend
+        instance and the next qualified plan serves the solve; a
+        forced plan propagates."""
+        from paralleljohnson_tpu import planner as _planner
+
         v = dgraph.num_nodes
         if source is None:
             dist0 = jnp.zeros(v, self._dtype)
         else:
             dist0 = jnp.full(v, jnp.inf, self._dtype).at[source].set(0.0)
-        max_iter = self.config.max_iterations or v
-        chunk = _edge_chunk_for(1, dgraph.src.shape[0])
-        if self._use_edge_shard(dgraph):
-            from paralleljohnson_tpu.parallel import edge_sharded_bellman_ford
-
-            # Degrade-don't-crash like the fan-out's sharded branches: a
-            # collective failure disables edge sharding for this backend
-            # instance and the single-chip chain below serves the solve.
-            # OOM re-raises (the solver's retry path owns that recovery).
+        ctx = _SsspCtx(
+            backend=self,
+            dgraph=dgraph,
+            source=source,
+            dist0=dist0,
+            max_iter=self.config.max_iterations or v,
+            chunk=_edge_chunk_for(1, dgraph.src.shape[0]),
+        )
+        decision = _planner.select(
+            SSSP_PLANS, ctx,
+            model=self._planner_model(),
+            platform=jax.default_backend(),
+            num_edges=dgraph.num_real_edges,
+            batch=1,
+            config=self.config,
+        )
+        self.last_plan_decision = decision
+        for cand in decision.ranking:
             try:
-                emesh = self._edge_mesh()
-                dist, iters, improving = edge_sharded_bellman_ford(
-                    emesh, dist0, dgraph.src, dgraph.dst, dgraph.weights,
-                    max_iter=max_iter,
-                    edge_chunk=_edge_chunk_for(
-                        1, -(-dgraph.src.shape[0] // emesh.devices.size)
-                    ),
-                    fault_hook=self._shard_fault_hook(),
-                    telemetry=self._telemetry,
-                )
-                iters = int(iters)
-                improving = bool(improving)
-                return KernelResult(
-                    dist=dist,
-                    negative_cycle=improving and max_iter >= v,
-                    converged=not improving,
-                    iterations=iters,
-                    # Each round relaxes the full edge list (across shards).
-                    edges_relaxed=iters * dgraph.num_real_edges,
-                    route="edge-sharded",
-                    cost=self._observe_unavailable(
-                        "edge-sharded",
-                        "sharded collective executables are not "
-                        "cost-instrumented", dgraph,
-                    ),
-                )
-            except Exception as e:
-                if resilience.is_oom_error(e):
+                res = cand.plan.build(ctx)
+            except Exception:
+                if cand.plan.failure is None:
                     raise
-                self._auto_route_failed(
-                    "_edge_shard_disabled",
-                    "edge-sharded Bellman-Ford failed (collective/tunnel "
-                    "failure); falling back to single-chip sweeps for "
-                    "this backend instance",
-                    forced=self.config.edge_shard is True,
-                )
-        if self._use_dia(dgraph):
-            try:
-                lay = self.dia_bundle(dgraph)
-                from paralleljohnson_tpu.ops.dia import dia_fixpoint
+                # Called from this active except block so a forced
+                # flag's bare ``raise`` propagates the original error.
+                cand.plan.failure(self, ctx)
+                continue
+            if res is None:
+                continue
+            decision.params.update(ctx.params)
+            res.plan = decision.as_dict(built=cand.plan.name)
+            return res
+        raise RuntimeError(
+            "planner: every qualified SSSP plan failed (the sweep plan "
+            "is unconditional — this is a bug)"
+        )
 
-                cap = self._traj_cap()
-                traj_bufs = None
-                if cap is not None:
-                    dist, iters, improving, *traj_bufs = _dia_fixpoint_traj(
-                        dist0, lay["w_diag"],
-                        offsets=lay["offsets"], max_iter=max_iter,
-                        traj_cap=cap,
-                    )
-                    dia_fn, dia_kwargs = _dia_fixpoint_traj, dict(
-                        offsets=lay["offsets"], max_iter=max_iter,
-                        traj_cap=cap,
-                    )
-                else:
-                    dist, iters, improving = dia_fixpoint(
-                        dist0, lay["w_diag"],
-                        offsets=lay["offsets"], max_iter=max_iter,
-                    )
-                    dia_fn, dia_kwargs = dia_fixpoint, dict(
-                        offsets=lay["offsets"], max_iter=max_iter,
-                    )
-                iters = int(iters)
-                improving = bool(improving)
-                res = KernelResult(
-                    dist=dist,
-                    negative_cycle=improving and max_iter >= v,
-                    converged=not improving,
-                    iterations=iters,
-                    # Each chained sweep examines every stored diagonal
-                    # entry once (= E: the layout stores all real edges).
-                    edges_relaxed=iters * lay["num_entries"],
-                    route="dia",
-                    cost=self._observe_cost(
-                        "dia", dia_fn, (dist0, lay["w_diag"]),
-                        dia_kwargs, dgraph,
-                    ),
-                )
-                if traj_bufs is not None:
-                    self._attach_trajectory(res, *traj_bufs, dgraph)
-                return res
-            except Exception:
-                self._auto_route_failed(
-                    "_dia_disabled",
-                    "dia stencil route failed on this platform; falling "
-                    "back to the gather routes for this backend instance",
-                    forced=self.config.dia is True,
-                )
-        if self._use_bucket(dgraph) and (
-            source is not None or self.config.bucket is True
-        ):
-            # Bucketed delta-stepping, tried after DIA (which wins when
-            # the labeling qualifies) and before GS: on the irregular
-            # road family it collapses the examined-candidate count GS
-            # pays against the gather floor. "auto" skips the
-            # virtual-source pass (dist0 = all-zeros starts every
-            # vertex active, so bucketing degrades to full sweeps — GS
-            # handles that pass in ~direction-change rounds); a forced
-            # bucket=True runs it anyway via the overflow fallback.
-            try:
-                from paralleljohnson_tpu.ops.bucket import auto_capacity
+    # -- B=1 plan builds (the registry's build hooks; each is the body
+    #    its ladder branch used to hold, verbatim kernels) -------------
 
-                delta = self._bucket_delta(dgraph)
-                # Minimal plan note so kind:"plan" records carry the
-                # resolved bucket width — the sample the delta
-                # auto-tuner compares (observe.tuning).
-                bucket_plan = {
-                    "chosen": "bucket",
-                    "reason": "B=1 chain (bucket route)",
-                    "params": {"delta": float(delta)},
-                }
-                # Generous step budget: converging solves use ~hop-
-                # diameter steps << V; the bucket schedule does NOT
-                # subsume Jacobi rounds, so exhausting it is handed to
-                # the sweep kernel below, which finishes from the
-                # (valid upper bound) distances AND owns the negative-
-                # cycle certificate.
-                max_steps = 2 * max_iter + 64
-                cap = self._traj_cap()
-                bucket_kwargs = dict(
-                    max_steps=max_steps,
-                    capacity=auto_capacity(v, dgraph.max_degree),
-                    max_degree=dgraph.max_degree,
-                    num_real_edges=dgraph.num_real_edges,
-                    edge_chunk=chunk,
-                    traj_cap=cap,
-                )
-                # traj_cap=None compiles the exact uninstrumented loop
-                # (ops.bucket python-branches); the splat is empty then.
-                dist_b, steps, still, ex_hi, ex_lo, *traj_bufs = (
-                    _bucket_kernel(
-                        dist0, dgraph.src, dgraph.dst, dgraph.weights,
-                        dgraph.indptr_dev(),
-                        jnp.asarray(delta, self._dtype),
-                        **bucket_kwargs,
-                    )
-                )
-                steps = int(steps)
-                examined = relax.examined_exact(ex_hi, ex_lo)
-                bucket_cost = self._observe_cost(
-                    "bucket", _bucket_kernel,
-                    (dist0, dgraph.src, dgraph.dst, dgraph.weights,
-                     dgraph.indptr_dev(),
-                     jnp.asarray(delta, self._dtype)),
-                    bucket_kwargs,
-                    dgraph,
-                )
-                if bool(still):
-                    dist_b, it2, improving = _bf_kernel(
-                        dist_b, dgraph.src, dgraph.dst, dgraph.weights,
-                        max_iter=max_iter, edge_chunk=chunk,
-                    )
-                    it2 = int(it2)
-                    improving = bool(improving)
-                    res = KernelResult(
-                        dist=dist_b,
-                        negative_cycle=improving and max_iter >= v,
-                        converged=not improving,
-                        iterations=steps + it2,
-                        edges_relaxed=examined
-                        + it2 * dgraph.num_real_edges,
-                        route="bucket+sweep",
-                        cost=bucket_cost,
-                    )
-                    res.plan = bucket_plan
-                    if traj_bufs:
-                        # The trajectory covers the bucketed steps only
-                        # (the finishing sweep is the uninstrumented
-                        # certifier) — decode at the bucket step count.
-                        self._attach_trajectory(
-                            res, *traj_bufs, dgraph, iterations=steps
-                        )
-                    return res
-                res = KernelResult(
-                    dist=dist_b,
-                    # Empty active+pending masks certify the global
-                    # fixpoint (ops.bucket invariant), so a reachable
-                    # negative cycle is impossible here.
-                    negative_cycle=False,
-                    converged=True,
-                    iterations=steps,
-                    edges_relaxed=examined,
-                    route="bucket",
-                    cost=bucket_cost,
-                )
-                res.plan = bucket_plan
-                if traj_bufs:
-                    self._attach_trajectory(res, *traj_bufs, dgraph)
-                return res
-            except Exception:
-                self._auto_route_failed(
-                    "_bucket_disabled",
-                    "bucketed delta-stepping route failed on this "
-                    "platform; falling back to the gather routes for "
-                    "this backend instance",
-                    forced=self.config.bucket is True,
-                )
-        if self._use_gs(dgraph):
-            try:
-                bundle = dgraph.gs_layout(self.config.gs_block_size)
-                dist0_gs = jnp.full(bundle["v_pad"], jnp.inf, self._dtype)
-                if source is None:
-                    # Virtual source: 0 at every REAL vertex, +inf pads.
-                    dist0_gs = dist0_gs.at[: v].set(0.0)
-                else:
-                    dist0_gs = dist0_gs.at[
-                        int(bundle["rank_host"][source])
-                    ].set(0.0)
-                gs_kwargs = dict(
-                    vb=bundle["vb"], halo=bundle["halo"],
-                    max_outer=max_iter,
-                    inner_cap=self.config.gs_inner_cap,
-                    traj_cap=self._traj_cap(),
-                )
-                # Dirty-window extension (ISSUE 13): exact block
-                # in-adjacency gating instead of the halo window —
-                # value-exact either way, tighter skips; route "gs+dw".
-                gs_in_adj = (
-                    bundle["in_adj"] if self._use_dw(dgraph, 1) else None
-                )
-                gs_route = "gs+dw" if gs_in_adj is not None else "gs"
-                dist, rounds, improving, iters_blk, *traj_bufs = (
-                    _gs_kernel(
-                        dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
-                        bundle["w_blk"], bundle["rank"], gs_in_adj,
-                        **gs_kwargs,
-                    )
-                )
-                iters = int(rounds)
-                improving = bool(improving)
-                res = KernelResult(
-                    dist=dist,
-                    negative_cycle=improving and max_iter >= v,
-                    converged=not improving,
-                    iterations=iters,
-                    edges_relaxed=_gs_examined_exact(
-                        iters_blk, bundle["real_edges_host"], 1,
-                        rounds=iters, inner_cap=self.config.gs_inner_cap,
-                    ),
-                    route=gs_route,
-                    cost=self._observe_cost(
-                        gs_route, _gs_kernel,
-                        (dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
-                         bundle["w_blk"], bundle["rank"], gs_in_adj),
-                        gs_kwargs,
-                        dgraph,
-                    ),
-                )
-                if traj_bufs:
-                    self._attach_trajectory(res, *traj_bufs, dgraph)
-                return res
-            except Exception:
-                self._gs_auto_failed(dgraph)  # re-raises when forced
+    def _sssp_build_edge_sharded(self, ctx) -> KernelResult:
+        from paralleljohnson_tpu.parallel import edge_sharded_bellman_ford
+
+        dgraph, dist0, max_iter = ctx.dgraph, ctx.dist0, ctx.max_iter
+        v = dgraph.num_nodes
+        emesh = self._edge_mesh()
+        dist, iters, improving = edge_sharded_bellman_ford(
+            emesh, dist0, dgraph.src, dgraph.dst, dgraph.weights,
+            max_iter=max_iter,
+            edge_chunk=_edge_chunk_for(
+                1, -(-dgraph.src.shape[0] // emesh.devices.size)
+            ),
+            fault_hook=self._shard_fault_hook(),
+            telemetry=self._telemetry,
+        )
+        iters = int(iters)
+        improving = bool(improving)
+        return KernelResult(
+            dist=dist,
+            negative_cycle=improving and max_iter >= v,
+            converged=not improving,
+            iterations=iters,
+            # Each round relaxes the full edge list (across shards).
+            edges_relaxed=iters * dgraph.num_real_edges,
+            route="edge-sharded",
+            cost=self._observe_unavailable(
+                "edge-sharded",
+                "sharded collective executables are not "
+                "cost-instrumented", dgraph,
+            ),
+        )
+
+    def _sssp_build_dia(self, ctx) -> KernelResult:
+        from paralleljohnson_tpu.ops.dia import dia_fixpoint
+
+        dgraph, dist0, max_iter = ctx.dgraph, ctx.dist0, ctx.max_iter
+        v = dgraph.num_nodes
+        lay = self.dia_bundle(dgraph)
+        cap = self._traj_cap()
         traj_bufs = None
-        if self._use_frontier(dgraph):
-            dist, iters, improving, ex_hi, ex_lo = _bf_frontier_kernel(
+        if cap is not None:
+            dist, iters, improving, *traj_bufs = _dia_fixpoint_traj(
+                dist0, lay["w_diag"],
+                offsets=lay["offsets"], max_iter=max_iter,
+                traj_cap=cap,
+            )
+            dia_fn, dia_kwargs = _dia_fixpoint_traj, dict(
+                offsets=lay["offsets"], max_iter=max_iter,
+                traj_cap=cap,
+            )
+        else:
+            dist, iters, improving = dia_fixpoint(
+                dist0, lay["w_diag"],
+                offsets=lay["offsets"], max_iter=max_iter,
+            )
+            dia_fn, dia_kwargs = dia_fixpoint, dict(
+                offsets=lay["offsets"], max_iter=max_iter,
+            )
+        iters = int(iters)
+        improving = bool(improving)
+        res = KernelResult(
+            dist=dist,
+            negative_cycle=improving and max_iter >= v,
+            converged=not improving,
+            iterations=iters,
+            # Each chained sweep examines every stored diagonal
+            # entry once (= E: the layout stores all real edges).
+            edges_relaxed=iters * lay["num_entries"],
+            route="dia",
+            cost=self._observe_cost(
+                "dia", dia_fn, (dist0, lay["w_diag"]),
+                dia_kwargs, dgraph,
+            ),
+        )
+        if traj_bufs is not None:
+            self._attach_trajectory(res, *traj_bufs, dgraph)
+        return res
+
+    def _sssp_build_bucket(self, ctx) -> KernelResult:
+        from paralleljohnson_tpu.ops.bucket import auto_capacity
+
+        dgraph, dist0 = ctx.dgraph, ctx.dist0
+        max_iter, chunk = ctx.max_iter, ctx.chunk
+        v = dgraph.num_nodes
+        delta = self._bucket_delta(dgraph)
+        # The resolved bucket width rides on the decision params so
+        # kind:"plan" records carry the sample the delta auto-tuner
+        # compares (observe.tuning).
+        ctx.params["delta"] = float(delta)
+        # Generous step budget: converging solves use ~hop-
+        # diameter steps << V; the bucket schedule does NOT
+        # subsume Jacobi rounds, so exhausting it is handed to
+        # the sweep kernel below, which finishes from the
+        # (valid upper bound) distances AND owns the negative-
+        # cycle certificate.
+        max_steps = 2 * max_iter + 64
+        cap = self._traj_cap()
+        bucket_kwargs = dict(
+            max_steps=max_steps,
+            capacity=auto_capacity(v, dgraph.max_degree),
+            max_degree=dgraph.max_degree,
+            num_real_edges=dgraph.num_real_edges,
+            edge_chunk=chunk,
+            traj_cap=cap,
+        )
+        # traj_cap=None compiles the exact uninstrumented loop
+        # (ops.bucket python-branches); the splat is empty then.
+        dist_b, steps, still, ex_hi, ex_lo, *traj_bufs = (
+            _bucket_kernel(
                 dist0, dgraph.src, dgraph.dst, dgraph.weights,
                 dgraph.indptr_dev(),
-                max_iter=max_iter,
-                capacity=self._frontier_capacity(dgraph),
-                max_degree=dgraph.max_degree,
-                num_real_edges=dgraph.num_real_edges,
-                edge_chunk=chunk,
+                jnp.asarray(delta, self._dtype),
+                **bucket_kwargs,
             )
-            edges_relaxed = relax.examined_exact(ex_hi, ex_lo)
-            route = "frontier"
-            cost = self._observe_cost(
+        )
+        steps = int(steps)
+        examined = relax.examined_exact(ex_hi, ex_lo)
+        bucket_cost = self._observe_cost(
+            "bucket", _bucket_kernel,
+            (dist0, dgraph.src, dgraph.dst, dgraph.weights,
+             dgraph.indptr_dev(),
+             jnp.asarray(delta, self._dtype)),
+            bucket_kwargs,
+            dgraph,
+        )
+        if bool(still):
+            dist_b, it2, improving = _bf_kernel(
+                dist_b, dgraph.src, dgraph.dst, dgraph.weights,
+                max_iter=max_iter, edge_chunk=chunk,
+            )
+            it2 = int(it2)
+            improving = bool(improving)
+            res = KernelResult(
+                dist=dist_b,
+                negative_cycle=improving and max_iter >= v,
+                converged=not improving,
+                iterations=steps + it2,
+                edges_relaxed=examined
+                + it2 * dgraph.num_real_edges,
+                route="bucket+sweep",
+                cost=bucket_cost,
+            )
+            if traj_bufs:
+                # The trajectory covers the bucketed steps only
+                # (the finishing sweep is the uninstrumented
+                # certifier) — decode at the bucket step count.
+                self._attach_trajectory(
+                    res, *traj_bufs, dgraph, iterations=steps
+                )
+            return res
+        res = KernelResult(
+            dist=dist_b,
+            # Empty active+pending masks certify the global
+            # fixpoint (ops.bucket invariant), so a reachable
+            # negative cycle is impossible here.
+            negative_cycle=False,
+            converged=True,
+            iterations=steps,
+            edges_relaxed=examined,
+            route="bucket",
+            cost=bucket_cost,
+        )
+        if traj_bufs:
+            self._attach_trajectory(res, *traj_bufs, dgraph)
+        return res
+
+    def _sssp_build_gs(self, ctx) -> KernelResult:
+        dgraph, dist0, max_iter = ctx.dgraph, ctx.dist0, ctx.max_iter
+        source = ctx.source
+        v = dgraph.num_nodes
+        bundle = dgraph.gs_layout(self.config.gs_block_size)
+        dist0_gs = jnp.full(bundle["v_pad"], jnp.inf, self._dtype)
+        if source is None:
+            # Virtual source: 0 at every REAL vertex, +inf pads.
+            dist0_gs = dist0_gs.at[: v].set(0.0)
+        else:
+            dist0_gs = dist0_gs.at[
+                int(bundle["rank_host"][source])
+            ].set(0.0)
+        gs_kwargs = dict(
+            vb=bundle["vb"], halo=bundle["halo"],
+            max_outer=max_iter,
+            inner_cap=self.config.gs_inner_cap,
+            traj_cap=self._traj_cap(),
+        )
+        # Dirty-window extension (ISSUE 13): exact block
+        # in-adjacency gating instead of the halo window —
+        # value-exact either way, tighter skips; route "gs+dw".
+        gs_in_adj = (
+            bundle["in_adj"] if self._use_dw(dgraph, 1) else None
+        )
+        gs_route = "gs+dw" if gs_in_adj is not None else "gs"
+        dist, rounds, improving, iters_blk, *traj_bufs = (
+            _gs_kernel(
+                dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
+                bundle["w_blk"], bundle["rank"], gs_in_adj,
+                **gs_kwargs,
+            )
+        )
+        iters = int(rounds)
+        improving = bool(improving)
+        res = KernelResult(
+            dist=dist,
+            negative_cycle=improving and max_iter >= v,
+            converged=not improving,
+            iterations=iters,
+            edges_relaxed=_gs_examined_exact(
+                iters_blk, bundle["real_edges_host"], 1,
+                rounds=iters, inner_cap=self.config.gs_inner_cap,
+            ),
+            route=gs_route,
+            cost=self._observe_cost(
+                gs_route, _gs_kernel,
+                (dist0_gs, bundle["src_blk"], bundle["dstl_blk"],
+                 bundle["w_blk"], bundle["rank"], gs_in_adj),
+                gs_kwargs,
+                dgraph,
+            ),
+        )
+        if traj_bufs:
+            self._attach_trajectory(res, *traj_bufs, dgraph)
+        return res
+
+    def _sssp_build_frontier(self, ctx) -> KernelResult:
+        dgraph, dist0 = ctx.dgraph, ctx.dist0
+        max_iter, chunk = ctx.max_iter, ctx.chunk
+        dist, iters, improving, ex_hi, ex_lo = _bf_frontier_kernel(
+            dist0, dgraph.src, dgraph.dst, dgraph.weights,
+            dgraph.indptr_dev(),
+            max_iter=max_iter,
+            capacity=self._frontier_capacity(dgraph),
+            max_degree=dgraph.max_degree,
+            num_real_edges=dgraph.num_real_edges,
+            edge_chunk=chunk,
+        )
+        iters = int(iters)
+        improving = bool(improving)
+        return KernelResult(
+            dist=dist,
+            negative_cycle=improving and max_iter >= dgraph.num_nodes,
+            converged=not improving,
+            iterations=iters,
+            edges_relaxed=relax.examined_exact(ex_hi, ex_lo),
+            route="frontier",
+            cost=self._observe_cost(
                 "frontier", _bf_frontier_kernel,
                 (dist0, dgraph.src, dgraph.dst, dgraph.weights,
                  dgraph.indptr_dev()),
@@ -1812,48 +1834,50 @@ class JaxBackend(Backend):
                      num_real_edges=dgraph.num_real_edges,
                      edge_chunk=chunk),
                 dgraph,
+            ),
+        )
+
+    def _sssp_build_sweep(self, ctx) -> KernelResult:
+        # Stays source-major even under fanout_layout="vertex_major":
+        # a [V, 1] vm block wastes 127/128 lanes of the sorted segment
+        # reduction and measures 2-3x SLOWER than the scatter sweep
+        # (CPU, rmat16: 57 ms vm vs 20 ms sm) — the vm layout needs a
+        # wide batch dimension to pay off.
+        dgraph, dist0 = ctx.dgraph, ctx.dist0
+        max_iter, chunk = ctx.max_iter, ctx.chunk
+        traj_bufs = None
+        cap = self._traj_cap()
+        if cap is not None:
+            dist, iters, improving, *traj_bufs = _bf_kernel_traj(
+                dist0, dgraph.src, dgraph.dst, dgraph.weights,
+                max_iter=max_iter, edge_chunk=chunk, traj_cap=cap,
+            )
+            sweep_fn, sweep_kwargs = _bf_kernel_traj, dict(
+                max_iter=max_iter, edge_chunk=chunk, traj_cap=cap
             )
         else:
-            # Stays source-major even under fanout_layout="vertex_major":
-            # a [V, 1] vm block wastes 127/128 lanes of the sorted segment
-            # reduction and measures 2-3x SLOWER than the scatter sweep
-            # (CPU, rmat16: 57 ms vm vs 20 ms sm) — the vm layout needs a
-            # wide batch dimension to pay off.
-            cap = self._traj_cap()
-            if cap is not None:
-                dist, iters, improving, *traj_bufs = _bf_kernel_traj(
-                    dist0, dgraph.src, dgraph.dst, dgraph.weights,
-                    max_iter=max_iter, edge_chunk=chunk, traj_cap=cap,
-                )
-                sweep_fn, sweep_kwargs = _bf_kernel_traj, dict(
-                    max_iter=max_iter, edge_chunk=chunk, traj_cap=cap
-                )
-            else:
-                dist, iters, improving = _bf_kernel(
-                    dist0, dgraph.src, dgraph.dst, dgraph.weights,
-                    max_iter=max_iter, edge_chunk=chunk,
-                )
-                sweep_fn, sweep_kwargs = _bf_kernel, dict(
-                    max_iter=max_iter, edge_chunk=chunk
-                )
-            edges_relaxed = int(iters) * dgraph.num_real_edges
-            route = "sweep"
-            cost = self._observe_cost(
-                "sweep", sweep_fn,
-                (dist0, dgraph.src, dgraph.dst, dgraph.weights),
-                sweep_kwargs,
-                dgraph,
+            dist, iters, improving = _bf_kernel(
+                dist0, dgraph.src, dgraph.dst, dgraph.weights,
+                max_iter=max_iter, edge_chunk=chunk,
+            )
+            sweep_fn, sweep_kwargs = _bf_kernel, dict(
+                max_iter=max_iter, edge_chunk=chunk
             )
         iters = int(iters)
         improving = bool(improving)
         res = KernelResult(
             dist=dist,
-            negative_cycle=improving and max_iter >= v,
+            negative_cycle=improving and max_iter >= dgraph.num_nodes,
             converged=not improving,
             iterations=iters,
-            edges_relaxed=edges_relaxed,
-            route=route,
-            cost=cost,
+            edges_relaxed=iters * dgraph.num_real_edges,
+            route="sweep",
+            cost=self._observe_cost(
+                "sweep", sweep_fn,
+                (dist0, dgraph.src, dgraph.dst, dgraph.weights),
+                sweep_kwargs,
+                dgraph,
+            ),
         )
         if traj_bufs:
             self._attach_trajectory(res, *traj_bufs, dgraph)
@@ -3009,6 +3033,19 @@ class _FanoutCtx:
     params: dict = dataclasses.field(default_factory=dict)
 
 
+@dataclasses.dataclass
+class _SsspCtx:
+    """One B=1 (SSSP / virtual-source) dispatch's context."""
+
+    backend: "JaxBackend"
+    dgraph: JaxDeviceGraph
+    source: int | None
+    dist0: jax.Array
+    max_iter: int
+    chunk: int
+    params: dict = dataclasses.field(default_factory=dict)
+
+
 def _no_edges_axis(ctx) -> bool:
     return "edges" not in ctx.mesh.axis_names
 
@@ -3305,13 +3342,67 @@ FANOUT_PLANS = [
     ),
 ]
 
-# The B=1 (SSSP) and solver-level families, declared for the same
-# registry so pricing, `cli info`, and the bench harness speak one
-# plan vocabulary. Their dispatch sites (``bellman_ford``'s chain and
-# ``ParallelJohnsonSolver._use_partitioned``) consult the SAME
-# predicates these qualifications wrap; converting those loops to the
-# select() walk is the registry's next increment (ROADMAP item 2
-# re-scope note).
+def _qual_sssp_bucket(ctx) -> tuple[bool, str]:
+    if not ctx.backend._use_bucket(ctx.dgraph):
+        return (False, "bucket gate declined")
+    if ctx.source is None and ctx.backend.config.bucket is not True:
+        # "auto" skips the virtual-source pass: dist0 = all-zeros
+        # starts every vertex active, so bucketing degrades to full
+        # sweeps — GS handles that pass in ~direction-change rounds.
+        # A forced bucket=True runs it anyway (overflow fallback).
+        return (False, "virtual-source pass (every vertex starts active)")
+    return (True, "irregular low-degree family where DIA declines")
+
+
+def _fail_sssp_edge_sharded(be, ctx) -> None:
+    # Degrade-don't-crash like the fan-out's sharded branches: a
+    # collective failure disables edge sharding for this backend
+    # instance and the next qualified plan serves the solve. OOM
+    # re-raises (the solver's retry path owns that recovery).
+    import sys
+
+    exc = sys.exc_info()[1]
+    if exc is not None and resilience.is_oom_error(exc):
+        raise
+    be._auto_route_failed(
+        "_edge_shard_disabled",
+        "edge-sharded Bellman-Ford failed (collective/tunnel "
+        "failure); falling back to single-chip sweeps for "
+        "this backend instance",
+        forced=be.config.edge_shard is True,
+    )
+
+
+def _fail_sssp_dia(be, ctx) -> None:
+    be._auto_route_failed(
+        "_dia_disabled",
+        "dia stencil route failed on this platform; falling "
+        "back to the gather routes for this backend instance",
+        forced=be.config.dia is True,
+    )
+
+
+def _fail_sssp_bucket(be, ctx) -> None:
+    be._auto_route_failed(
+        "_bucket_disabled",
+        "bucketed delta-stepping route failed on this "
+        "platform; falling back to the gather routes for "
+        "this backend instance",
+        forced=be.config.bucket is True,
+    )
+
+
+def _fail_sssp_gs(be, ctx) -> None:
+    be._gs_auto_failed(ctx.dgraph)  # re-raises when forced
+
+
+# The B=1 (SSSP) family, declared for the same registry as the fan-out
+# plans so pricing, `cli info`, and the bench harness speak one plan
+# vocabulary. ``bellman_ford`` dispatches through ``select()`` over
+# this list (ISSUE 17 satellite — the ROADMAP item 6 leftover): the
+# qualifications wrap the SAME ``_use_*`` gates the old ladder
+# consulted in the same priority order, so an unpriced ranking is the
+# ladder, bit-for-bit.
 SSSP_PLANS = [
     planner.Plan(
         name="edge-sharded", entry="sssp", priority=10,
@@ -3320,7 +3411,10 @@ SSSP_PLANS = [
             if ctx.backend._use_edge_shard(ctx.dgraph)
             else (False, "single device or frontier-family graph")
         ),
+        build=lambda ctx: ctx.backend._sssp_build_edge_sharded(ctx),
+        failure=_fail_sssp_edge_sharded,
         forced=lambda cfg: cfg.edge_shard is True,
+        force_overrides={"edge_shard": True},
     ),
     planner.Plan(
         name="dia", entry="sssp", priority=20,
@@ -3329,18 +3423,20 @@ SSSP_PLANS = [
             if ctx.backend._use_dia(ctx.dgraph)
             else (False, "dia gate declined")
         ),
+        build=lambda ctx: ctx.backend._sssp_build_dia(ctx),
+        failure=_fail_sssp_dia,
         price_routes=("dia",),
         forced=lambda cfg: cfg.dia is True,
+        force_overrides={"dia": True},
     ),
     planner.Plan(
         name="bucket", entry="sssp", priority=30,
-        qualify=lambda ctx: (
-            (True, "irregular low-degree family where DIA declines")
-            if ctx.backend._use_bucket(ctx.dgraph)
-            else (False, "bucket gate declined")
-        ),
+        qualify=_qual_sssp_bucket,
+        build=lambda ctx: ctx.backend._sssp_build_bucket(ctx),
+        failure=_fail_sssp_bucket,
         price_routes=("bucket", "bucket+sweep"),
         forced=lambda cfg: cfg.bucket is True,
+        force_overrides={"bucket": True},
     ),
     planner.Plan(
         name="gs", entry="sssp", priority=40,
@@ -3349,8 +3445,11 @@ SSSP_PLANS = [
             if ctx.backend._use_gs(ctx.dgraph)
             else (False, "gs gate declined")
         ),
+        build=lambda ctx: ctx.backend._sssp_build_gs(ctx),
+        failure=_fail_sssp_gs,
         price_routes=("gs", "gs+dw"),
         forced=lambda cfg: cfg.gauss_seidel is True,
+        force_overrides={"gauss_seidel": True},
     ),
     planner.Plan(
         name="frontier", entry="sssp", priority=50,
@@ -3359,12 +3458,15 @@ SSSP_PLANS = [
             if ctx.backend._use_frontier(ctx.dgraph)
             else (False, "frontier gate declined")
         ),
+        build=lambda ctx: ctx.backend._sssp_build_frontier(ctx),
         price_routes=("frontier",),
         forced=lambda cfg: cfg.frontier is True,
+        force_overrides={"frontier": True},
     ),
     planner.Plan(
         name="sweep", entry="sssp", priority=60,
         qualify=lambda ctx: (True, "unconditional full-sweep fallback"),
+        build=lambda ctx: ctx.backend._sssp_build_sweep(ctx),
         price_routes=("sweep",),
     ),
 ]

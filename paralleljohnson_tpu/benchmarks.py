@@ -106,6 +106,8 @@ _SIZES = {
                           workers=2,   mini_workers=3,   full_workers=4),
     "incremental_update": dict(n=96,   mini_n=1024,      full_n=4096,
                           k=2,         mini_k=6,         full_k=12),
+    "approx_apsp":   dict(n=256,       mini_n=4096,      full_n=16384,
+                          sources=32,  mini_sources=128, full_sources=256),
 }
 
 
@@ -1537,6 +1539,98 @@ def bench_incremental_update(backend: str, preset: str) -> BenchRecord:
     )
 
 
+def bench_approx_apsp(backend: str, preset: str) -> BenchRecord:
+    """Config 16 (ISSUE 17 tentpole): exact vs certified ``hopset+bf``
+    on the SAME graph and source set, at ε ∈ {0.1, 0.5}. A corridor
+    lattice (aspect 16), not ER: large diameter is the regime the
+    hopset tier exists for — the exact routes sweep to the diameter
+    (~4x a square grid's at equal V/E) while the approximate route
+    pays β hops past the relay seed. Per ε the detail records construction wall,
+    query wall, hopset edge count, the measured max observed error vs
+    the exact matrix, and the certified bound it must sit under — a
+    single entry whose measured error exceeds its certificate lands in
+    ``detail.failed`` and flunks ``bench_regress`` as a contract
+    failure (the certificate is the product; a violation is a bug, not
+    a slow day). ``speedup`` = exact wall / (construction + query):
+    the honest end-to-end ratio, construction un-amortized."""
+    from paralleljohnson_tpu.graphs import grid2d
+    from paralleljohnson_tpu.solver.approx import approx_apsp
+
+    short = max(2, int(np.sqrt(_sz("approx_apsp", "n", preset) / 16)))
+    g = grid2d(16 * short, short, seed=23)
+    n = g.num_nodes
+    n_sources = min(_sz("approx_apsp", "sources", preset), n)
+    rng = np.random.default_rng(11)
+    sources = np.sort(rng.choice(n, size=n_sources, replace=False))
+
+    solver = _solver(backend)
+    solver.solve(g, sources)  # warm (compile) — same discipline as er1k
+    t0 = time.perf_counter()
+    exact_res = solver.solve(g, sources)
+    exact_wall = time.perf_counter() - t0
+    exact_rows = np.asarray(exact_res.matrix, np.float64)
+
+    detail = {
+        "nodes": n, "edges": int(g.num_real_edges),
+        "n_sources": int(n_sources),
+        "exact_wall_s": round(exact_wall, 6),
+        "exact": _routes(exact_res),
+    }
+    wall = exact_wall
+    examined = int(exact_res.stats.edges_relaxed)
+    for eps in (0.1, 0.5):
+        approx_apsp(g, sources, config=solver.config, epsilon=eps)  # warm
+        t0 = time.perf_counter()
+        res = approx_apsp(
+            g, sources, config=solver.config, epsilon=eps
+        )
+        approx_wall = time.perf_counter() - t0
+        est = np.asarray(res.dist, np.float64)
+        err = np.asarray(res.max_error, np.float64)
+        # The certification contract, checked entrywise against the
+        # exact matrix: wherever the certificate is finite the measured
+        # error must sit under it, and a finite exact distance must
+        # never be answered with an uncertified +inf.
+        certified = np.isfinite(err)
+        measured = np.where(
+            np.isfinite(exact_rows) & np.isfinite(est),
+            np.abs(est - exact_rows), 0.0,
+        )
+        violations = int(np.sum(certified & (measured > err)))
+        wrong_inf = int(np.sum(
+            certified & (np.isfinite(exact_rows) != np.isfinite(est))
+        ))
+        key = f"eps_{eps:g}"
+        detail[key] = {
+            "construction_s": round(res.stats["construction_s"], 6),
+            "query_s": round(res.stats["query_s"], 6),
+            "beta": res.stats["beta"],
+            "hopset_edges": res.stats["hopset_edges"],
+            "hopset_converged": res.stats["hopset_converged"],
+            "query_converged": res.stats["query_converged"],
+            "measured_max_error": round(float(measured.max()), 6),
+            "certified_max_bound": (
+                round(float(err[certified].max()), 6)
+                if certified.any() else None
+            ),
+            "certified_frac": round(float(certified.mean()), 6),
+            "speedup": round(exact_wall / max(approx_wall, 1e-9), 3),
+        }
+        if violations or wrong_inf:
+            detail["failed"] = (
+                f"eps={eps:g}: {violations} entries exceed their "
+                f"certified bound, {wrong_inf} reachability "
+                "mismatches under a finite certificate"
+            )
+        if eps == 0.5:
+            wall = approx_wall
+            examined = int(res.stats["edges_examined"])
+    return BenchRecord(
+        "approx_apsp", backend, preset, wall, examined,
+        examined / max(wall, 1e-9), _n_chips(), detail,
+    )
+
+
 CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "er1k_apsp": bench_er1k_apsp,
     "dimacs_ny_bf": bench_dimacs_ny_bf,
@@ -1553,6 +1647,7 @@ CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "serve_overload": bench_serve_overload,
     "distributed_fleet": bench_distributed_fleet,
     "incremental_update": bench_incremental_update,
+    "approx_apsp": bench_approx_apsp,
 }
 
 

@@ -117,6 +117,29 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "beyond the noise band (forced route flags "
                         "always win); false = pure declared priority "
                         "(the pre-registry ladder order)")
+    # Certified approximate tier (ISSUE 17, README "Certified
+    # approximate tier"): the budgeted hopset+bf route and its knobs.
+    p.add_argument("--hopset", default="auto",
+                   choices=["auto", "true", "false"],
+                   help="certified (1+eps) hopset route hopset+bf: auto "
+                        "qualifies it exactly when --error-budget > 0 on "
+                        "a negative-free graph (budget 0 ALWAYS solves "
+                        "exactly), true forces it (still requires a "
+                        "positive budget — fails loud), false disables")
+    p.add_argument("--error-budget", type=float, default=0.0,
+                   metavar="R",
+                   help="per-solve relative error budget (>= 0): the "
+                        "planner admits hopset+bf only when its "
+                        "certified bound can fit the budget; 0 = exact "
+                        "only (default 0)")
+    p.add_argument("--approx-epsilon", type=float, default=0.1,
+                   metavar="E",
+                   help="hopset tier target relative error eps > 0 "
+                        "(drives the hop budget beta ~ log2(V)/eps; "
+                        "default 0.1)")
+    p.add_argument("--approx-beta", type=int, default=None, metavar="B",
+                   help="explicit hop budget for hopset construction "
+                        "and queries (default: auto from V and eps)")
     p.add_argument("--dw-block", type=int, default=None,
                    help="vertices per dirty-window activity bit "
                         "(default: the measured-best fine granularity)")
@@ -283,6 +306,10 @@ def _config(args) -> "SolverConfig":
         stage_deadline_s=args.stage_deadline,
         min_source_batch=args.min_source_batch,
         planner=tristate[args.planner],
+        hopset=tristate[args.hopset],
+        approx_epsilon=args.approx_epsilon,
+        approx_beta=args.approx_beta,
+        error_budget=args.error_budget,
         profile_store=args.profile_store,
         convergence=tristate[args.convergence],
         telemetry=_telemetry(args, args.command),
@@ -295,6 +322,41 @@ def _write_metrics(stats, args) -> None:
 
         write_prom_metrics(stats, args.metrics_file,
                            labels={"command": args.command})
+
+
+def _report_approx(res, args) -> None:
+    """Report an ApproxResult (route hopset+bf): the certified-bound
+    summary instead of the exact SolverStats surface."""
+    fin = np.isfinite(res.max_error)
+    payload = {
+        "shape": list(res.dist.shape),
+        "route": res.route,
+        "exact": bool(res.exact),
+        "certified_frac": round(float(np.mean(fin)), 6),
+        "certified_max_bound": (
+            float(res.max_error[fin].max()) if bool(fin.any()) else 0.0
+        ),
+        **res.stats,
+        "plan": res.plan,
+    }
+    if args.output:
+        np.savez_compressed(args.output, dist=res.dist,
+                            sources=res.sources,
+                            max_error=res.max_error)
+        payload["output"] = args.output
+    if args.as_json:
+        print(json.dumps(payload))
+    else:
+        print(f"distances: {res.dist.shape}, route {res.route} "
+              f"(eps {res.stats.get('epsilon'):g}, beta "
+              f"{res.stats.get('beta')}, "
+              f"{payload['certified_frac']:.1%} certified, max bound "
+              f"{payload['certified_max_bound']:g})")
+        print(f"  construction: {res.stats.get('construction_s', 0) * 1e3:9.2f} ms"
+              f"  query: {res.stats.get('query_s', 0) * 1e3:9.2f} ms")
+        if res.plan:
+            print(f"  planner: chose {res.plan.get('chosen')} — "
+                  f"{res.plan.get('reason')}")
 
 
 def _report(res, args) -> None:
@@ -486,12 +548,17 @@ def main(argv: list[str] | None = None) -> int:
                               "(default: 0 = none; --miss-policy "
                               "landmark implies 16)")
     p_serve.add_argument("--miss-policy", default="solve",
-                         choices=["solve", "landmark"],
+                         choices=["solve", "landmark", "hopset"],
                          help="store miss on an unsolved source: "
                               "'solve' schedules one exact batch "
                               "through the resilient solver; 'landmark' "
                               "answers immediately with (estimate, "
-                              "max_error) bounds")
+                              "max_error) bounds; 'hopset' answers with "
+                              "the (1+eps) hopset tier's certified "
+                              "bounds (implies building/loading a "
+                              "hopset; composes with the landmark "
+                              "interval when one is attached — the "
+                              "tighter certified bound wins)")
     p_serve.add_argument("--hot-rows", type=int, default=None,
                          help="hot-tier capacity in rows (device-"
                               "resident; default 128)")
@@ -511,11 +578,14 @@ def main(argv: list[str] | None = None) -> int:
                               "identical answers), 'on'/'off' force "
                               "one path (default: auto)")
     p_serve.add_argument("--landmark-picker", default="uniform",
-                         choices=["uniform", "coverage"],
+                         choices=["uniform", "coverage", "boundary"],
                          help="pivot picker for a freshly built "
-                              "landmark index: 'coverage' weights "
-                              "candidates by degree (hub coverage), "
-                              "'uniform' is the reproducible default")
+                              "landmark index or hopset: 'coverage' "
+                              "weights candidates by degree (hub "
+                              "coverage), 'boundary' samples partition-"
+                              "frontier vertices (corridor/mesh "
+                              "graphs), 'uniform' is the reproducible "
+                              "default")
     p_serve.add_argument("--batch-window", type=int, default=None,
                          metavar="W",
                          help="micro-batch up to W concurrent socket "
@@ -566,14 +636,19 @@ def main(argv: list[str] | None = None) -> int:
                               "up to its own deadline for a slot) "
                               "instead of queueing unboundedly (default 8)")
     p_serve.add_argument("--shed-policy", default="landmark",
-                         choices=["landmark", "reject", "off"],
+                         choices=["landmark", "hopset", "priced",
+                                  "reject", "off"],
                          help="overload shedding when the SLO burn alert "
-                              "fires: 'landmark' downgrades exact-MISS "
-                              "queries to flagged {shed: true, exact: "
-                              "false, max_error: ...} landmark answers "
-                              "(hits still answer exactly; implies a "
-                              "landmark index), 'reject' turns misses "
-                              "into overloaded rejections, 'off' never "
+                              "fires: 'landmark'/'hopset' downgrade "
+                              "exact-MISS queries to that certified "
+                              "tier's flagged {shed: true, exact: "
+                              "false, max_error: ...} answers (hits "
+                              "still answer exactly; each implies its "
+                              "index), 'priced' orders the two "
+                              "certified tiers by predicted per-query "
+                              "cost and rejects only when neither "
+                              "exists, 'reject' turns misses into "
+                              "overloaded rejections, 'off' never "
                               "sheds (default landmark)")
     p_serve.add_argument("--drain-timeout", type=float, default=10.0,
                          metavar="SECONDS",
@@ -975,11 +1050,58 @@ def main(argv: list[str] | None = None) -> int:
                                 "(lookup.auto_decision)",
                 },
                 "landmark_picker": (
-                    "--landmark-picker uniform|coverage — coverage "
-                    "weights pivot sampling by vertex degree (hub "
-                    "coverage for skewed graphs); uniform stays the "
-                    "reproducible default"
+                    "--landmark-picker uniform|coverage|boundary — "
+                    "coverage weights pivot sampling by vertex degree "
+                    "(hub coverage for skewed graphs), boundary samples "
+                    "partition-frontier vertices (corridor/mesh graphs); "
+                    "uniform stays the reproducible default"
                 ),
+                # The certified approximate tier (ISSUE 17): a (1+eps)
+                # hopset answers APSP batches past the exact-scale wall
+                # with a certified per-answer error bound.
+                "approximate_tier": {
+                    "flags": "--hopset [--approx-epsilon E] "
+                             "[--approx-beta B] [--error-budget R] "
+                             "[--miss-policy hopset] "
+                             "[--shed-policy hopset|priced]",
+                    "route": (
+                        "hopset+bf: beta-bounded-hop Bellman-Ford over "
+                        "the graph seeded with pivot-relay rows; the "
+                        "planner qualifies it only under a finite "
+                        "--error-budget and auto-picks the cheapest "
+                        "exact route at budget 0"
+                    ),
+                    "certificate": (
+                        "every hopset answer carries exact=false plus a "
+                        "finite per-entry max_error (converged batches "
+                        "certify to f32 rounding; unconverged batches "
+                        "certify via pivot-closure relay bounds); "
+                        "unreachable is never silently bounded — "
+                        "unproven infinity reports max_error inf"
+                    ),
+                    "composition": (
+                        "when both a landmark interval and a hopset "
+                        "interval cover the same answer the engine "
+                        "intersects them — the tighter certified bound "
+                        "wins, never an unflagged estimate"
+                    ),
+                    "construction": (
+                        "k ~ sqrt(V) pivots (uniform/coverage/boundary "
+                        "picker), beta-bounded forward+reverse pivot "
+                        "rows built by the ordinary relax sweeps; fleet "
+                        "construction shards pivots over workers and is "
+                        "bitwise-identical to a single worker; persisted "
+                        "digest-guarded as hopset.npz next to "
+                        "landmarks.npz"
+                    ),
+                    "pricing": (
+                        "hopset+bf appears in cost_observatory."
+                        "priced_routes beside the exact routes (explicit "
+                        "unpriced marker until profiled) — the exact-vs-"
+                        "approx price comparison the budgeted planner "
+                        "consults"
+                    ),
+                },
                 # The traffic front end (ISSUE 15, README "Traffic
                 # front end"): socket serving with designed overload
                 # behavior — admission bounds, deadline drops,
@@ -987,7 +1109,8 @@ def main(argv: list[str] | None = None) -> int:
                 "listen": {
                     "command": "pjtpu serve <graph> --listen HOST:PORT "
                                "[--max-connections N] [--max-inflight "
-                               "N] [--shed-policy landmark|reject|off] "
+                               "N] [--shed-policy landmark|hopset|"
+                               "priced|reject|off] "
                                "[--drain-timeout S]",
                     "protocol": (
                         "newline-delimited JSON over TCP; one header "
@@ -1307,6 +1430,30 @@ def main(argv: list[str] | None = None) -> int:
                             )
                     except Exception:  # noqa: BLE001 — report, don't die
                         entry["landmarks_persisted"] = "unreadable"
+                hs_f = d / "hopset.npz"
+                if hs_f.exists():
+                    # Persisted approximate tier (ISSUE 17): report the
+                    # knobs that define the certificate without loading
+                    # the row matrices.
+                    try:
+                        with np.load(hs_f) as z:
+                            _piv = z["pivots"]
+                            _rng = np.arange(len(_piv))
+                            _edges = int(
+                                np.isfinite(z["fwd"]).sum()
+                                + np.isfinite(z["rev"]).sum()
+                                - np.isfinite(z["fwd"][_rng, _piv]).sum()
+                                - np.isfinite(z["rev"][_rng, _piv]).sum()
+                            ) if len(_piv) else 0
+                            entry["hopset_persisted"] = {
+                                "epsilon": float(z["epsilon"]),
+                                "beta": int(z["beta"]),
+                                "k": int(len(_piv)),
+                                "edges": _edges,
+                                "converged": bool(z["converged"]),
+                            }
+                    except Exception:  # noqa: BLE001 — report, don't die
+                        entry["hopset_persisted"] = "unreadable"
                 if entry:
                     entry["dir"] = str(d)
                     stores.append(entry)
@@ -1505,6 +1652,26 @@ def main(argv: list[str] | None = None) -> int:
                 print(json.dumps(payload) if args.as_json else
                       f"{args.reduce}: {vals}")
                 return 0
+            if ((cfg.error_budget > 0 or cfg.hopset is True)
+                    and not args.predecessors):
+                # Budgeted solve (ISSUE 17): the planner arbitrates
+                # exact vs the certified hopset+bf tier. Budget 0
+                # never reaches here — exact is the only honest
+                # answer, and the ordinary path below serves it.
+                from paralleljohnson_tpu.solver.approx import (
+                    ApproxResult,
+                    solve_with_budget,
+                )
+
+                with device_trace(args.profile, cfg.telemetry):
+                    res, _decision = solve_with_budget(
+                        g, sources, config=cfg, telemetry=cfg.telemetry
+                    )
+                if isinstance(res, ApproxResult):
+                    _report_approx(res, args)
+                else:
+                    _report(res, args)
+                return 0
             with device_trace(args.profile, cfg.telemetry):
                 res = ParallelJohnsonSolver(cfg).solve(
                     g, sources=sources, predecessors=args.predecessors
@@ -1551,10 +1718,42 @@ def main(argv: list[str] | None = None) -> int:
                         g, k, config=cfg, picker=args.landmark_picker)
                     if store.ckpt is not None:
                         landmarks.save(store.ckpt.dir)
+            # The certified approximate tier (ISSUE 17): load-or-build
+            # the persisted hopset exactly like the landmark index —
+            # digest-guarded, knob-mismatch means rebuild. 'priced'
+            # shedding runs on whichever certified tiers exist, so it
+            # does not force a build by itself.
+            hopset = None
+            if (args.miss_policy == "hopset"
+                    or (args.listen and args.shed_policy == "hopset")
+                    or cfg.hopset is True):
+                from paralleljohnson_tpu.ops.hopset import (
+                    Hopset,
+                    build_hopset,
+                )
+
+                if store.ckpt is not None:
+                    hopset = Hopset.load(
+                        store.ckpt.dir, expect_digest=store.digest
+                    )
+                    if (hopset is not None
+                            and (hopset.epsilon != cfg.approx_epsilon
+                                 or (cfg.approx_beta is not None
+                                     and hopset.beta != cfg.approx_beta))):
+                        hopset = None  # stale knobs: rebuild
+                if hopset is None:
+                    hopset = build_hopset(
+                        g, epsilon=cfg.approx_epsilon,
+                        beta=cfg.approx_beta,
+                        picker=args.landmark_picker,
+                        telemetry=cfg.telemetry,
+                    )
+                    if store.ckpt is not None:
+                        hopset.save(store.ckpt.dir)
             from paralleljohnson_tpu.observe.live import SLO
 
             engine = QueryEngine(
-                g, store, landmarks=landmarks, config=cfg,
+                g, store, landmarks=landmarks, hopset=hopset, config=cfg,
                 miss_policy=args.miss_policy,
                 device_lookup=args.device_lookup,
                 slo=SLO(name="serve", latency_ms=args.slo_p99_ms,

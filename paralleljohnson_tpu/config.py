@@ -208,6 +208,27 @@ class SolverConfig:
         enables it whenever the mesh has >1 device and the frontier path
         is not active (frontier is work-optimal on low-degree graphs);
         True forces (given >1 device), False keeps single-chip sweeps.
+      hopset: the certified (1+ε) approximate tier's dispatch switch
+        (ISSUE 17, ``solver.approx`` route tag ``hopset+bf``; ROADMAP
+        item 5). ``"auto"`` (the default): ``solve_with_budget``
+        qualifies the hopset route exactly when the caller's
+        ``error_budget`` is > 0 and the graph is negative-free — a zero
+        budget ALWAYS solves exactly. True forces the hopset plan
+        (budget still must be > 0 — forcing an approximation under a
+        zero budget is a contract violation and fails loud); False
+        disqualifies it everywhere.
+      approx_epsilon: the hopset tier's target relative error ε (> 0).
+        Drives the hop budget β = ``ops.hopset.auto_beta(V, ε)`` and is
+        recorded with every certificate; the per-answer bound served is
+        always the MEASURED interval, never this target.
+      approx_beta: explicit hop budget for hopset construction and
+        queries (>= 2); None = auto from (V, ε). More hops = tighter
+        rows and later cap, at β sweeps of cost.
+      error_budget: per-solve relative error budget (>= 0) for
+        ``solver.approx.solve_with_budget``: the planner may pick
+        ``hopset+bf`` only when its certified bound can fit the budget;
+        0 (the default) pins exact. This is the serving tier's knob —
+        plain ``solve()`` never consults it.
       checkpoint_dir: if set, per-source-batch distance rows are saved here
         and resumed after preemption (SURVEY.md §5 checkpoint/resume).
       pipeline_depth: max fan-out batches in flight in the double-buffered
@@ -335,6 +356,10 @@ class SolverConfig:
     dw_block: int | None = None
     pred_extraction: bool | str = "auto"
     edge_shard: bool | str = "auto"
+    hopset: bool | str = "auto"
+    approx_epsilon: float = 0.1
+    approx_beta: int | None = None
+    error_budget: float = 0.0
     checkpoint_dir: str | None = None
     pipeline_depth: int | None = None
     compilation_cache_dir: str | None = None
@@ -457,6 +482,23 @@ class SolverConfig:
         if self.edge_shard not in (True, False, "auto"):
             raise ValueError(
                 f"edge_shard must be True/False/'auto', got {self.edge_shard!r}"
+            )
+        if self.hopset not in (True, False, "auto"):
+            raise ValueError(
+                f"hopset must be True/False/'auto', got {self.hopset!r}"
+            )
+        if not self.approx_epsilon > 0:
+            raise ValueError(
+                f"approx_epsilon must be > 0, got {self.approx_epsilon!r}"
+            )
+        if self.approx_beta is not None and self.approx_beta < 2:
+            raise ValueError(
+                "approx_beta must be >= 2 (or None = auto), got "
+                f"{self.approx_beta!r}"
+            )
+        if not self.error_budget >= 0:
+            raise ValueError(
+                f"error_budget must be >= 0, got {self.error_budget!r}"
             )
         if self.retry_attempts < 1:
             raise ValueError(

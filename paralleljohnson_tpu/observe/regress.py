@@ -47,6 +47,14 @@ DEFAULT_MIN_HISTORY = 2
 DEFAULT_ITER_BAND = 0.25
 DEFAULT_ITER_ABS_FLOOR = 2
 
+# Hopset size band (ISSUE 17): a hopset's edge count is a DETERMINISTIC
+# function of (graph, ε, k, β, seed, picker) — same shape bucket, same
+# knobs, fatter hopset means the construction changed, not the weather.
+# The band exists only to tolerate intentional small re-tunes riding a
+# shape bucket; growth past it flags as a size regression.
+DEFAULT_SIZE_BAND = 0.10
+DEFAULT_SIZE_ABS_FLOOR = 64
+
 
 def history_key(row: dict) -> tuple:
     return (
@@ -182,6 +190,40 @@ def _planner_rows(obj: dict, source: str | None) -> list[dict]:
     }]
 
 
+def _hopset_rows(obj: dict, source: str | None) -> list[dict]:
+    """Rows from ``kind: "hopset"`` profile records (ISSUE 17): one
+    construction measurement keyed by the graph's pow2 shape bucket and
+    the ε it was built for. The regression axis is the construction
+    wall; β and the hopset edge count ride in detail — the edge count
+    is ALSO graded (``kind: "size"`` flags) because a fatter hopset
+    slows every query downstream even when construction stayed fast.
+    Re-ingesting the same profiles.jsonl is idempotent (ts-ignored
+    dedup in ``BenchHistory.append``)."""
+    wall = obj.get("construction_s")
+    if not isinstance(wall, (int, float)) or wall <= 0:
+        return []
+    bench = (
+        f"hopset:V{_pow2_up(obj.get('nodes'))}"
+        f":E{_pow2_up(obj.get('edges'))}"
+        f":eps{obj.get('epsilon')}"
+    )
+    return [{
+        "bench": bench,
+        "backend": "jax",
+        "platform": obj.get("platform", "unknown"),
+        "preset": obj.get("picker"),
+        "wall_s": float(wall),
+        "detail": {
+            "beta": obj.get("beta"),
+            "k": obj.get("k"),
+            "hopset_edges": obj.get("hopset_edges"),
+            "converged": bool(obj.get("converged")),
+            "edges_examined": obj.get("edges_examined"),
+        },
+        "source": source,
+    }]
+
+
 def normalize_record(obj: dict, *, source: str | None = None) -> list[dict]:
     """Normalize ONE parsed measurement object into history rows.
 
@@ -189,12 +231,15 @@ def normalize_record(obj: dict, *, source: str | None = None) -> list[dict]:
     a ``pjtpu bench`` BenchRecord line (config/backend/preset/wall_s);
     a driver metric payload (metric/value/detail); the committed
     ``BENCH_r0*.json`` wrapper (its ``parsed`` field is the payload);
-    a profile store's ``kind: "plan"`` planner-decision record.
+    a profile store's ``kind: "plan"`` planner-decision record or
+    ``kind: "hopset"`` construction record.
     Unrecognized objects yield [] — ingestion skips, never crashes."""
     if not isinstance(obj, dict):
         return []
     if obj.get("kind") == "plan":
         return _planner_rows(obj, source)
+    if obj.get("kind") == "hopset":
+        return _hopset_rows(obj, source)
     if "bench" in obj and "wall_s" in obj:
         row = dict(obj)
         row.setdefault("source", source)
@@ -276,6 +321,12 @@ def _iterations_of(row: dict):
     return int(it) if isinstance(it, (int, float)) and it > 0 else None
 
 
+def _hopset_edges_of(row: dict):
+    """A row's hopset edge count (``kind:"hopset"`` ingests)."""
+    n = (row.get("detail") or {}).get("hopset_edges")
+    return int(n) if isinstance(n, (int, float)) and n > 0 else None
+
+
 def detect_regressions(
     fresh: list[dict],
     history: list[dict],
@@ -300,9 +351,13 @@ def detect_regressions(
     observatory was on) are ALSO graded on iterations-to-converge
     against the key's iteration history under the tighter ``iter_band``
     (``kind: "iterations"``) — a route converging slower is a perf bug
-    even when wall noise hides it."""
+    even when wall noise hides it. Rows carrying ``hopset_edges``
+    (``kind:"hopset"`` ingests) are graded on edge count under the
+    tighter size band (``kind: "size"``) — a fatter hopset slows every
+    downstream query even when construction stayed fast."""
     by_key: dict[tuple, list[float]] = {}
     iters_by_key: dict[tuple, list[int]] = {}
+    size_by_key: dict[tuple, list[int]] = {}
     for row in history:
         w = row.get("wall_s")
         if isinstance(w, (int, float)) and w > 0:
@@ -310,6 +365,9 @@ def detect_regressions(
         it = _iterations_of(row)
         if it is not None:
             iters_by_key.setdefault(history_key(row), []).append(it)
+        n = _hopset_edges_of(row)
+        if n is not None:
+            size_by_key.setdefault(history_key(row), []).append(n)
     flagged = []
     for row in fresh:
         w = row.get("wall_s")
@@ -329,6 +387,24 @@ def detect_regressions(
                 "history_n": len(hist),
                 "roofline_bound": _roofline_of(row, profile_records),
             })
+        n = _hopset_edges_of(row)
+        shist = size_by_key.get(history_key(row))
+        if n is not None and shist and len(shist) >= min_history:
+            sbase = statistics.median(shist)
+            if (
+                n > sbase * (1.0 + DEFAULT_SIZE_BAND)
+                and (n - sbase) > DEFAULT_SIZE_ABS_FLOOR
+            ):
+                flagged.append({
+                    **row,
+                    "kind": "size",
+                    "hopset_edges": n,
+                    "baseline_edges": sbase,
+                    "slowdown": n / sbase,
+                    "band": DEFAULT_SIZE_BAND,
+                    "history_n": len(shist),
+                    "roofline_bound": _roofline_of(row, profile_records),
+                })
         it = _iterations_of(row)
         ihist = iters_by_key.get(history_key(row))
         if it is None or not ihist or len(ihist) < min_history:

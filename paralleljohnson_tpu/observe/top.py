@@ -137,6 +137,13 @@ def _gather_serve(root: Path, now: float, stale_after_s: float) -> list[dict]:
                 "batch_width_p50": engine.get("batch_width_p50"),
                 "batch_width_p99": engine.get("batch_width_p99"),
                 "hits_by_tier": engine.get("hits_by_tier"),
+                # Certified approximate tier (ISSUE 17): how much of
+                # the answer stream is flagged approximate, how much
+                # of that the hopset tier served, and the attached
+                # hopset's provenance knobs.
+                "approx_answers": engine.get("approx_answers"),
+                "hopset_answers": engine.get("hopset_answers"),
+                "hopset": stats.get("hopset"),
                 "p50_ms": engine.get("p50_ms"),
                 "p50_err_ms": engine.get("p50_err_ms"),
                 "p99_ms": engine.get("p99_ms"),
@@ -301,6 +308,21 @@ def _render_serve(lines: list[str], entries: list[dict]) -> None:
                 f"rejected {_fmt(s.get('rejected'))}   "
                 f"deadline-drops {_fmt(s.get('deadline_drops'))}   "
                 f"conns {_fmt(s.get('open_connections'))}"
+            )
+        # Approximate-tier line only when a hopset is attached or an
+        # approximate answer was actually served (ISSUE 17) — exact-
+        # only engines keep the compact layout.
+        if s.get("hopset") or s.get("approx_answers"):
+            h = s.get("hopset") or {}
+            lines.append(
+                f"  approx {_fmt(s.get('approx_answers'))} "
+                f"(hopset {_fmt(s.get('hopset_answers'))})   "
+                f"hopset eps {_fmt(h.get('epsilon'))} "
+                f"beta {_fmt(h.get('beta'), 0)} "
+                f"k {_fmt(h.get('k'), 0)} "
+                f"edges {_fmt(h.get('edges'), 0)}"
+                + ("" if h.get("converged") is None
+                   else f" converged {_fmt(h.get('converged'))}")
             )
         # Lookup-path line only once a path counter moved (older
         # snapshots and idle engines keep the compact layout).

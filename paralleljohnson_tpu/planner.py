@@ -74,6 +74,7 @@ KNOWN_ROUTES = (
     "pallas-vm", "gs", "gs+dw", "dia", "bucket", "bucket+sweep",
     "frontier", "fw", "fw-tile", "dense-squaring", "dense-iterate",
     "condensed+fw", "incremental-repair", "lookup-host", "lookup-device",
+    "hopset+bf",
 )
 
 

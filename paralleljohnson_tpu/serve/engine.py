@@ -124,6 +124,10 @@ class ServeStats:
     queries_total: int = 0
     exact_answers: int = 0
     approx_answers: int = 0
+    # Certified approximate tier split (ISSUE 17): how many of the
+    # approximate answers came from the hopset tier (composed hopset +
+    # landmark bounds, tighter wins) vs the plain landmark walk.
+    hopset_answers: int = 0
     errors: int = 0
     batches_scheduled: int = 0
     solved_sources: int = 0
@@ -163,6 +167,7 @@ class ServeStats:
             "queries_total": self.queries_total,
             "exact_answers": self.exact_answers,
             "approx_answers": self.approx_answers,
+            "hopset_answers": self.hopset_answers,
             "errors": self.errors,
             "batches_scheduled": self.batches_scheduled,
             "solved_sources": self.solved_sources,
@@ -197,6 +202,19 @@ SERVE_PROM_METRICS = (
     ("pjtpu_query_approx_total", "counter",
      "Queries answered from the landmark index (with max_error)",
      lambda e: e.stats.approx_answers),
+    # Certified approximate tier (ISSUE 17): every counted answer is
+    # flagged exact: false and carries a certified max_error.
+    ("pjtpu_approx_answers_total", "counter",
+     "Queries answered by a certified approximate tier (landmark or "
+     "hopset) — every one flagged exact: false with a max_error",
+     lambda e: e.stats.approx_answers),
+    ("pjtpu_hopset_answers_total", "counter",
+     "Queries answered by the hopset tier (composed hopset + landmark "
+     "bounds, tighter wins)",
+     lambda e: e.stats.hopset_answers),
+    ("pjtpu_hopset_edges", "gauge",
+     "Edges in the attached (1+eps) hopset (0 = no hopset attached)",
+     lambda e: 0 if e.hopset is None else e.hopset.num_hopset_edges),
     ("pjtpu_serve_batches_scheduled_total", "counter",
      "Exact solve batches the engine scheduled for store misses",
      lambda e: e.stats.batches_scheduled),
@@ -253,7 +271,7 @@ SERVE_PROM_METRICS = (
      lambda e: e.metrics.slo_burn_gauge(), "slo"),
 )
 
-_MISS_POLICIES = ("solve", "landmark")
+_MISS_POLICIES = ("solve", "landmark", "hopset")
 
 # Lookup-path tristate (ISSUE 16): "auto" lets the planner registry
 # choose per batch, "on"/"off" pin the device megabatch / host walk
@@ -283,7 +301,8 @@ class QueryEngine:
     live ``serve_stats.json`` rewrite for checkpoint-backed stores
     (started lazily with the first served batch; 0 disables)."""
 
-    def __init__(self, graph, store, *, landmarks=None, config=None,
+    def __init__(self, graph, store, *, landmarks=None, hopset=None,
+                 config=None,
                  miss_policy: str = "solve", metrics=None, slo=None,
                  stats_interval_s: float = DEFAULT_STATS_INTERVAL_S,
                  device_lookup: str = "auto") -> None:
@@ -307,9 +326,24 @@ class QueryEngine:
                 "miss_policy='landmark' requires a LandmarkIndex "
                 "(build one or switch to miss_policy='solve')"
             )
+        if miss_policy == "hopset" and hopset is None:
+            raise ValueError(
+                "miss_policy='hopset' requires a Hopset (build one with "
+                "ops.hopset.build_hopset or switch to miss_policy='solve')"
+            )
+        if (hopset is not None and getattr(store, "digest", None)
+                and getattr(hopset, "digest", None)
+                and hopset.digest != store.digest):
+            # Same contract as Hopset.load's expect_digest: a hopset
+            # built for another graph must never bound this one.
+            raise ValueError(
+                "hopset graph digest does not match the store's graph "
+                f"({hopset.digest[:12]}... != {store.digest[:12]}...)"
+            )
         self.graph = graph
         self.store = store
         self.landmarks = landmarks
+        self.hopset = hopset
         self.miss_policy = miss_policy
         base = config or SolverConfig()
         self.config = _dc.replace(
@@ -388,11 +422,23 @@ class QueryEngine:
         if mode == "exact":
             mode = "solve"
         elif mode == "approx":
-            mode = "landmark"
+            # Generic "any certified tier": landmark when attached
+            # (the hopset tier composes it in anyway), else hopset.
+            if self.landmarks is not None:
+                mode = "landmark"
+            elif self.hopset is not None:
+                mode = "hopset"
+            else:
+                raise QueryError(
+                    "mode 'approx' needs a certified tier "
+                    "(landmark index or hopset)"
+                )
         if mode not in _MISS_POLICIES:
             raise QueryError(f"bad mode {req.get('mode')!r}")
         if mode == "landmark" and self.landmarks is None:
             raise QueryError("mode 'approx' needs a landmark index")
+        if mode == "hopset" and self.hopset is None:
+            raise QueryError("mode 'hopset' needs an attached hopset")
         return {"id": req.get("id"), "source": source, "dsts": dsts,
                 "many": many, "mode": mode}
 
@@ -680,6 +726,21 @@ class QueryEngine:
                 pre[qi] = ("landmark", est, err)
         return pre
 
+    def _hopset_estimate(self, s, dsts):
+        """The hopset tier's ``(estimates, max_errors)``: the hopset's
+        certified interval intersected with the landmark index's (when
+        one is attached) — the composition rule: tighter wins PER
+        ENTRY, both factors are certified, so the intersection is too.
+        Finished through the same inf-aware helper as every certified
+        tier (proven-inf -> (inf, 0); unknown -> (inf, inf) — an
+        unreachable pair is never silently bounded)."""
+        lower, upper = self.hopset.bounds_row(s, dsts)
+        if self.landmarks is not None and self.landmarks.k > 0:
+            lm_lo, lm_up = self.landmarks.bounds_row(s, dsts)
+            lower = np.maximum(lower, lm_lo)
+            upper = np.minimum(upper, lm_up)
+        return finish_estimates(lower, upper)
+
     def _stale_error_bound(self, s, dsts, many):
         """The ISSUE 16 stale-honesty satellite: a landmark-derived
         ``max_error`` for a stale (pre-update) answer, shaped like a
@@ -738,6 +799,22 @@ class QueryEngine:
             tier = "landmark"
             out.update(
                 exact=False, tier="landmark",
+                max_error=(
+                    [float(e) for e in err] if many else float(err[0])
+                ),
+            )
+        elif p["mode"] == "hopset":
+            # Hopset tier (ISSUE 17): certified interval from the
+            # (1+eps) hopset composed with the landmark interval when
+            # an index is also attached — tighter wins per entry, and
+            # the answer is flagged exactly like a landmark one.
+            est, err = self._hopset_estimate(s, dsts)
+            vals = est
+            self.stats.approx_answers += 1
+            self.stats.hopset_answers += 1
+            tier = "hopset"
+            out.update(
+                exact=False, tier="hopset",
                 max_error=(
                     [float(e) for e in err] if many else float(err[0])
                 ),
@@ -875,6 +952,16 @@ class QueryEngine:
             "engine": self.stats.as_dict(),
             "store": self.store.stats(),
             "landmarks": 0 if self.landmarks is None else self.landmarks.k,
+            # Approximate-tier provenance (ISSUE 17): what `pjtpu top`
+            # and `pjtpu info --serve-store` report about the attached
+            # hopset (None = exact + landmark tiers only).
+            "hopset": None if self.hopset is None else {
+                "epsilon": float(self.hopset.epsilon),
+                "beta": int(self.hopset.beta),
+                "k": int(self.hopset.k),
+                "edges": int(self.hopset.num_hopset_edges),
+                "converged": bool(self.hopset.converged),
+            },
             "miss_policy": self.miss_policy,
             # Lookup-path dispatch (ISSUE 16): the tristate, the device
             # path's state, and the last planner decision with its

@@ -69,7 +69,12 @@ from paralleljohnson_tpu.serve.engine import (
 
 PROTOCOL = "pjtpu-serve/1"
 
-SHED_POLICIES = ("landmark", "reject", "off")
+# Shedding tiers (ISSUE 15, extended by ISSUE 17's hopset tier):
+# "landmark" / "hopset" degrade exact misses to that certified tier,
+# "priced" orders the two certified tiers by predicted per-query
+# serving cost (the priced-shedding clause — reject only when neither
+# tier exists), "reject" answers overloaded, "off" disables shedding.
+SHED_POLICIES = ("landmark", "hopset", "priced", "reject", "off")
 
 DEFAULT_MAX_CONNECTIONS = 64
 DEFAULT_MAX_INFLIGHT = 8
@@ -232,6 +237,17 @@ class ServeFrontend:
                 "shed_policy='landmark' needs a LandmarkIndex on the "
                 "engine (build one, or pick shed_policy='reject'/'off')"
             )
+        if shed_policy == "hopset" and getattr(engine, "hopset", None) is None:
+            raise ValueError(
+                "shed_policy='hopset' needs a Hopset on the engine "
+                "(build one, or pick shed_policy='reject'/'off')"
+            )
+        if (shed_policy == "priced" and engine.landmarks is None
+                and getattr(engine, "hopset", None) is None):
+            raise ValueError(
+                "shed_policy='priced' needs at least one certified tier "
+                "on the engine (a LandmarkIndex or a Hopset)"
+            )
         if max_connections < 1 or max_inflight < 1:
             raise ValueError("max_connections and max_inflight must be >= 1")
         self.engine = engine
@@ -268,6 +284,10 @@ class ServeFrontend:
         self._shutdown_requested = threading.Event()
         self.shed_active = False
         self.address: tuple[str, int] | None = None
+        # Priced shedding (ISSUE 17): the degrade tier's query mode,
+        # resolved lazily at the first shed (the cost model fit reads
+        # the profile store once) and cached with its why-line.
+        self._shed_mode_cached: tuple[str, str] | None = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -515,6 +535,61 @@ class ServeFrontend:
                 self.engine.metrics.counter("pjtpu_slo_shed_transitions").add(1)
         return self.shed_active
 
+    def _shed_mode(self) -> str:
+        """The query mode a shed exact-miss degrades to: ``"approx"``
+        (landmark tier) or ``"hopset"``. For ``shed_policy="priced"``
+        the certified tiers are ordered by predicted per-query serving
+        cost from the profile store's calibration (``lookup-host`` vs
+        ``hopset+bf`` route records); with nothing priced the hopset
+        wins when attached — its composed interval is at least as tight
+        as the landmark one by construction. Resolved once per process
+        (the fit reads the store) and cached with its why-line."""
+        if self.shed_policy == "landmark":
+            return "approx"
+        if self.shed_policy == "hopset":
+            return "hopset"
+        if self._shed_mode_cached is not None:
+            return self._shed_mode_cached[0]
+        engine = self.engine
+        tiers = []  # (mode, priced route tag) in default preference order
+        if getattr(engine, "hopset", None) is not None:
+            tiers.append(("hopset", "hopset+bf"))
+        if engine.landmarks is not None:
+            tiers.append(("approx", "lookup-host"))
+        mode, why = tiers[0][0], "unpriced: declared tier order"
+        if len(tiers) > 1:
+            try:
+                from paralleljohnson_tpu.observe import current_platform
+                from paralleljohnson_tpu.observe.costs import (
+                    resolve_profile_dir,
+                )
+                from paralleljohnson_tpu.observe.store import (
+                    CostModel,
+                    ProfileStore,
+                )
+
+                store_dir = resolve_profile_dir(
+                    getattr(engine.config, "profile_store", None)
+                )
+                if store_dir:
+                    model = CostModel.fit(ProfileStore(store_dir))
+                    platform = current_platform()
+                    priced = []
+                    for m, route in tiers:
+                        pred = model.predict(
+                            route, num_edges=engine.graph.num_edges,
+                            batch=1, platform=platform,
+                        )
+                        if pred is not None:
+                            priced.append((float(pred["predicted_s"]), m))
+                    if priced:
+                        cost, mode = min(priced)
+                        why = f"priced: {mode} predicts {cost:.4g}s/query"
+            except Exception:  # noqa: BLE001 — pricing must never block a shed
+                pass
+        self._shed_mode_cached = (mode, why)
+        return mode
+
     def health(self) -> dict:
         """The liveness document (``{"op": "health"}``): admission
         gauges, shedding state, and — when a solve heartbeat file is
@@ -533,6 +608,11 @@ class ServeFrontend:
             "draining": self._draining.is_set(),
             "shedding": self.shed_active,
             "shed_policy": self.shed_policy,
+            "shed_tier": (
+                None if self._shed_mode_cached is None
+                else {"mode": self._shed_mode_cached[0],
+                      "reason": self._shed_mode_cached[1]}
+            ),
             "open_connections": stats.open_connections,
             "max_connections": self.max_connections,
             "max_inflight": self.max_inflight,
@@ -651,10 +731,11 @@ class ServeFrontend:
                         "retry_after_ms": self.retry_after_ms,
                     })
                     return
-                # Certified degrade: the landmark answer is flagged
-                # exact=false AND shed=true, and carries max_error —
-                # never an unflagged approximation.
-                req = {**req, "mode": "approx"}
+                # Certified degrade: the landmark/hopset answer is
+                # flagged exact=false AND shed=true, and carries
+                # max_error — never an unflagged approximation. The
+                # tier is the policy's (priced under "priced").
+                req = {**req, "mode": self._shed_mode()}
                 shed = True
         try:
             if self.batcher is not None:

@@ -36,8 +36,10 @@ from paralleljohnson_tpu.utils.checkpoint import graph_digest
 
 LANDMARKS_FILENAME = "landmarks.npz"
 
-# Pivot pickers for :meth:`LandmarkIndex.build` (ISSUE 16 satellite).
-PIVOT_PICKERS = ("uniform", "coverage")
+# Pivot pickers for :meth:`LandmarkIndex.build` (ISSUE 16 satellite;
+# "boundary" added by ISSUE 17 — the partitioned route's ready-made
+# high-coverage set, ROADMAP item 3).
+PIVOT_PICKERS = ("uniform", "coverage", "boundary")
 
 
 def widen_bounds(lower, upper, *, nonnegative: bool):
@@ -86,15 +88,53 @@ def finish_estimates(lower, upper):
     return est, err
 
 
+def boundary_vertices(graph, *, labels=None, seed: int = 0) -> np.ndarray:
+    """The partitioned route's boundary-vertex set: endpoints of edges
+    whose two ends carry different partition labels. ``labels`` is an
+    ``int[V]`` partition labeling (``solver.partitioned``'s attach-time
+    labels when the caller has them); None computes a fresh seeded
+    ``partition_by_pivots`` labeling — deterministic for (graph, seed).
+    Empty when the graph condenses to one part (no cross edges)."""
+    from paralleljohnson_tpu.solver.partitioned import (
+        auto_num_parts,
+        partition_by_pivots,
+    )
+
+    v = graph.num_nodes
+    if labels is None:
+        labels = partition_by_pivots(graph, auto_num_parts(v), seed=seed)
+    labels = np.asarray(labels)
+    if labels.shape != (v,):
+        raise ValueError(
+            f"labels must be shape ({v},), got {labels.shape}"
+        )
+    e = graph.num_real_edges
+    src = graph.src[:e]
+    dst = graph.indices[:e]
+    cross = labels[src] != labels[dst]
+    mask = np.zeros(v, bool)
+    mask[src[cross]] = True
+    mask[dst[cross]] = True
+    return np.flatnonzero(mask).astype(np.int64)
+
+
 def pick_pivots(graph, k: int, *, seed: int = 0,
-                picker: str = "uniform") -> np.ndarray:
+                picker: str = "uniform", labels=None) -> np.ndarray:
     """Seeded pivot draw. ``"uniform"`` (the default, unchanged) draws
     without replacement from all vertices; ``"coverage"`` weights the
     draw by total degree (in + out + 1) — on power-law graphs the
     high-degree hubs sit on far more shortest paths, so a pivot set
     biased toward them tightens the triangle-inequality interval for
     the same k (the partitioned route's boundary-vertex observation).
-    Both are deterministic for a given (graph, k, seed)."""
+    ``"boundary"`` (ISSUE 17, ROADMAP item 3) draws from that
+    observation's LITERAL set — the partitioned route's boundary
+    vertices (:func:`boundary_vertices`, using the caller's partition
+    ``labels`` when given, else a fresh seeded labeling): every
+    cross-part shortest path passes through one, so they cover pairs a
+    degree heuristic can miss on low-degree road-like graphs; when the
+    boundary set is smaller than k (a one-part graph has none) the draw
+    falls back to ``coverage``. All three are deterministic for a given
+    (graph, k, seed[, labels])."""
     if picker not in PIVOT_PICKERS:
         raise ValueError(
             f"picker must be one of {PIVOT_PICKERS}, got {picker!r}"
@@ -104,6 +144,16 @@ def pick_pivots(graph, k: int, *, seed: int = 0,
     if k == 0:
         return np.zeros(0, np.int64)
     rng = np.random.default_rng(seed)
+    if picker == "boundary":
+        try:
+            boundary = boundary_vertices(graph, labels=labels, seed=seed)
+        except ValueError:
+            raise
+        except Exception:  # noqa: BLE001 — labeling failure degrades, never crashes
+            boundary = np.zeros(0, np.int64)
+        if len(boundary) >= k:
+            return np.sort(rng.choice(boundary, size=k, replace=False))
+        picker = "coverage"
     if picker == "coverage":
         indptr = np.asarray(graph.indptr, np.int64)
         out_deg = np.diff(indptr)
@@ -179,17 +229,21 @@ class LandmarkIndex:
 
     @classmethod
     def build(cls, graph, k: int, *, config=None, seed: int = 0,
-              solver=None, picker: str = "uniform") -> "LandmarkIndex":
+              solver=None, picker: str = "uniform",
+              labels=None) -> "LandmarkIndex":
         """Solve ``k`` seeded pivots exactly (forward + reverse graph)
         through the resilient solver — retries, OOM degradation, and the
         pipeline all apply, exactly like any other solve. ``picker``
         selects the pivot draw (:func:`pick_pivots`): ``"uniform"``
-        (default, unchanged) or ``"coverage"`` (degree-weighted, for
-        power-law graphs)."""
+        (default, unchanged), ``"coverage"`` (degree-weighted, for
+        power-law graphs), or ``"boundary"`` (the partitioned route's
+        boundary-vertex set; ``labels`` optionally supplies attach-time
+        partition labels)."""
         from paralleljohnson_tpu.solver import ParallelJohnsonSolver
 
         v = graph.num_nodes
-        pivots = pick_pivots(graph, k, seed=seed, picker=picker)
+        pivots = pick_pivots(graph, k, seed=seed, picker=picker,
+                             labels=labels)
         k = len(pivots)
         if solver is None:
             solver = ParallelJohnsonSolver(config)

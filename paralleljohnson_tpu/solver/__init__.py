@@ -18,5 +18,6 @@ __all__ = [
     "ValidationError",
 ]
 
-# solver.partitioned (condense-solve-expand condensed+fw route) is
-# imported lazily at its dispatch site — it builds device arrays.
+# solver.partitioned (condense-solve-expand condensed+fw route) and
+# solver.approx (the certified hopset+bf tier) are imported lazily at
+# their dispatch sites — both build device arrays.

@@ -203,6 +203,17 @@ def main(argv: list[str] | None = None) -> int:
                     f"roofline: {f['roofline_bound']}"
                 )
                 continue
+            if f.get("kind") == "size":
+                # Hopset size regression (ISSUE 17): the shortcut set
+                # got fatter for the same shape bucket + knobs — every
+                # downstream query pays for it, wall noise or not.
+                print(
+                    f"  REGRESSION (size) {key}: "
+                    f"{f['hopset_edges']} hopset edges vs median "
+                    f"{f['baseline_edges']:.0f} over "
+                    f"{f['history_n']} runs ({f['slowdown']:.2f}x)"
+                )
+                continue
             print(
                 f"  REGRESSION {key}: {f['wall_s']:.4f}s vs median "
                 f"{f['baseline_s']:.4f}s over {f['history_n']} runs "

@@ -283,6 +283,15 @@ run 1200 jax-dirty-window python -m paralleljohnson_tpu.cli bench dirty_window -
 #     noise band), distances bitwise-checked per route
 run 1200 jax-planner-dispatch python -m paralleljohnson_tpu.cli bench planner_dispatch --backend jax --preset full --update-baseline BASELINE.md
 
+# 4n) certified approximate tier (ISSUE 17 tentpole): exact vs
+#     hopset+bf at eps in {0.1, 0.5} on the corridor lattice — detail
+#     carries construction/query walls, the hopset edge count, and the
+#     measured max error, which must sit under the certified bound
+#     (a violation lands in detail.failed and flunks bench-regress as
+#     a contract failure); the eps=0.5 speedup is the number that
+#     prices the approximate tier against the exact-scale wall
+run 1200 jax-approx-apsp python -m paralleljohnson_tpu.cli bench approx_apsp --backend jax --preset full --update-baseline BASELINE.md
+
 # 5) driver metric (should reflect the blocked kernel now)
 run 1200 bench.py python bench.py
 

@@ -1,0 +1,520 @@
+"""Certified (1+ε) hopset tier tests (ISSUE 17, ROADMAP item 5).
+
+The approximate-tier contract under test:
+- every ``hopset+bf`` answer row carries a per-entry certified bound:
+  wherever ``max_error`` is finite, ``|estimate - exact| <= max_error``
+  AND the finiteness of the estimate matches the truth — an unreachable
+  pair is never silently bounded (unproven infinity reports
+  ``max_error = inf``, proven infinity reports 0);
+- ``bounded_hop_rows`` outputs are real-path upper bounds, exact (to
+  f32 rounding) when the sweep converged, and seeding with real-path
+  rows preserves both properties;
+- the budget arbitration (``solve_with_budget``) picks an exact plan at
+  budget 0 ALWAYS, admits ``hopset+bf`` only under a positive budget on
+  a negative-free graph, and a forced ``hopset=True`` with budget 0
+  fails loud;
+- fleet-sharded construction is bitwise-identical to the single-worker
+  build;
+- persistence is digest-guarded (wrong graph -> rebuild, never serve);
+- the serving integration (QueryEngine hopset tier, frontend shed
+  policies, regress ingestion) honors the same flags.
+"""
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import ParallelJohnsonSolver, SolverConfig
+from paralleljohnson_tpu.graphs import CSRGraph, erdos_renyi, grid2d
+from paralleljohnson_tpu.ops import hopset as hs
+from paralleljohnson_tpu.solver.approx import (
+    approx_apsp,
+    fleet_build_hopset,
+    hopset_record,
+    solve_with_budget,
+)
+
+from conftest import oracle_apsp
+
+
+def _cfg(**kw) -> SolverConfig:
+    return SolverConfig(backend="numpy", **kw)
+
+
+def _assert_certified(est, err, exact, *, context=""):
+    """The certification invariant, entrywise over [B, V] arrays."""
+    certified = np.isfinite(err)
+    # Wherever a finite bound is claimed, reachability must be truthful:
+    # a certified-finite estimate of an unreachable pair (or a certified
+    # infinity on a reachable one) is a contract violation.
+    finite_agrees = np.isfinite(exact) == np.isfinite(est)
+    assert bool(np.all(finite_agrees[certified])), (
+        f"{context}: certified entry with wrong finiteness"
+    )
+    both = certified & np.isfinite(exact) & np.isfinite(est)
+    gap = np.abs(est[both] - exact[both])
+    assert bool(np.all(gap <= err[both])), (
+        f"{context}: measured error {gap.max():g} exceeds certified "
+        f"bound (worst bound {err[both][np.argmax(gap)]:g})"
+    )
+
+
+# -- the certificate invariant ------------------------------------------------
+
+
+def test_certificates_hold_on_grid():
+    g = grid2d(8, 8, seed=1)
+    sources = np.array([0, 7, 31, 63], np.int64)
+    exact = oracle_apsp(g)[sources]
+    res = approx_apsp(g, sources, config=_cfg(), epsilon=0.5)
+    assert res.dist.shape == (4, 64)
+    assert np.all(np.isfinite(res.max_error))  # connected graph: all certified
+    _assert_certified(res.dist, res.max_error, exact, context="grid 8x8")
+    # d(s, s) = 0 must survive the estimate finishing (the midpoint of
+    # a [0, f32-tol] interval is allowed, but no more).
+    assert np.allclose(res.dist[np.arange(4), sources], 0.0, atol=1e-4)
+
+
+@pytest.mark.parametrize("seed,n,p,eps", [
+    (0, 24, 0.15, 0.5),
+    (1, 40, 0.08, 0.5),
+    (2, 40, 0.08, 0.1),
+    (3, 60, 0.05, 0.5),
+    (4, 30, 0.02, 0.5),   # sparse enough to disconnect
+    (5, 16, 0.30, 0.25),
+])
+def test_certificates_hold_randomized(seed, n, p, eps):
+    g = erdos_renyi(n, p, seed=seed)
+    exact = oracle_apsp(g)
+    res = approx_apsp(g, None, config=_cfg(), epsilon=eps)
+    _assert_certified(
+        res.dist, res.max_error, exact,
+        context=f"er(n={n}, p={p}, seed={seed}, eps={eps})",
+    )
+
+
+def test_unreachable_never_silently_bounded():
+    # Two components: certified answers across them must be PROVEN
+    # infinite (est inf, err 0) or unproven (err inf) — never a finite
+    # estimate with a finite bound.
+    a = grid2d(4, 4, seed=2)
+    s, d, w = a.src, a.indices[: a.num_real_edges], a.weights[: a.num_real_edges]
+    g = CSRGraph.from_edges(
+        np.concatenate([s, s + 16]),
+        np.concatenate([d, d + 16]),
+        np.concatenate([w, w]),
+        32,
+    )
+    res = approx_apsp(g, np.arange(16, dtype=np.int64), config=_cfg(),
+                      epsilon=0.5)
+    cross = res.dist[:, 16:]
+    cross_err = res.max_error[:, 16:]
+    certified = np.isfinite(cross_err)
+    assert bool(np.all(np.isinf(cross[certified])))
+    exact = oracle_apsp(g)[:16]
+    _assert_certified(res.dist, res.max_error, exact, context="2 components")
+
+
+def test_converged_result_is_exact_to_f32():
+    # Tiny graph: beta >= diameter, the query sweep converges, the
+    # answer is the exact distance up to f32 rounding (and says so).
+    g = grid2d(4, 4, seed=5)
+    exact = oracle_apsp(g)
+    res = approx_apsp(g, None, config=_cfg(), epsilon=0.5)
+    assert res.converged
+    assert res.exact  # "exact to f32 rounding" contract property
+    assert np.allclose(res.dist, exact, rtol=1e-5, atol=1e-5)
+    assert np.all(res.max_error[np.isfinite(res.max_error)] < 1e-2)
+
+
+# -- bounded-hop rows: real-path upper bounds, seeding, determinism -----------
+
+
+def test_bounded_hop_rows_upper_bounds():
+    g = erdos_renyi(32, 0.1, seed=7)
+    exact = oracle_apsp(g)
+    sources = np.array([0, 5, 31], np.int64)
+    rows, iters, converged, examined = hs.bounded_hop_rows(
+        g, sources, beta=4
+    )
+    assert rows.shape == (3, 32)
+    # Every finite entry is a real <=4-hop path length: >= the true
+    # distance (f32 slack), and d(s,s) = 0.
+    fin = np.isfinite(rows)
+    assert np.all(rows[fin] >= exact[sources][fin] - 1e-4)
+    assert np.allclose(rows[np.arange(3), sources], 0.0)
+    assert examined > 0
+
+
+def test_bounded_hop_rows_converged_is_exact():
+    g = grid2d(5, 5, seed=3)
+    exact = oracle_apsp(g)
+    sources = np.arange(25, dtype=np.int64)
+    rows, _, converged, _ = hs.bounded_hop_rows(g, sources, beta=64)
+    assert converged
+    assert np.allclose(rows, exact, rtol=1e-5, atol=1e-5)
+
+
+def test_seed_rows_preserve_fixpoint_and_invariant():
+    g = grid2d(6, 6, seed=9)
+    sources = np.array([0, 17, 35], np.int64)
+    plain, _, conv_a, _ = hs.bounded_hop_rows(g, sources, beta=64)
+    assert conv_a
+    # Seed with the hopset relay (real path lengths): the fixpoint is
+    # unchanged, and a partial sweep stays an upper bound of it.
+    hop = hs.build_hopset(g, epsilon=0.5, k=4, beta=8, seed=0)
+    seed = hop.relay_rows(sources)
+    seeded, _, conv_b, _ = hs.bounded_hop_rows(
+        g, sources, beta=64, seed_rows=seed
+    )
+    assert conv_b
+    np.testing.assert_allclose(seeded, plain, rtol=1e-6, atol=1e-6)
+    partial, _, _, _ = hs.bounded_hop_rows(
+        g, sources, beta=4, seed_rows=seed
+    )
+    fin = np.isfinite(partial)
+    assert np.all(partial[fin] >= plain[fin] - 1e-4)
+
+
+def test_relay_rows_are_real_path_lengths():
+    g = erdos_renyi(40, 0.1, seed=11)
+    exact = oracle_apsp(g)
+    hop = hs.build_hopset(g, epsilon=0.5, seed=0)
+    sources = np.array([0, 13, 39], np.int64)
+    relay = hop.relay_rows(sources)
+    fin = np.isfinite(relay)
+    assert np.all(relay[fin] >= exact[sources][fin] - 1e-3)
+
+
+def test_bounds_row_brackets_truth():
+    g = erdos_renyi(36, 0.12, seed=13)
+    exact = oracle_apsp(g)
+    hop = hs.build_hopset(g, epsilon=0.3, seed=1)
+    for s in (0, 18, 35):
+        lower, upper = hop.bounds_row(s)
+        fin = np.isfinite(exact[s])
+        assert np.all(lower[fin] <= exact[s][fin] + 1e-3)
+        cap = np.isfinite(upper)
+        assert np.all(exact[s][cap & fin] <= upper[cap & fin] + 1e-3)
+
+
+# -- budget arbitration -------------------------------------------------------
+
+
+def test_budget_zero_always_picks_exact():
+    g = grid2d(6, 6, seed=4)
+    res, decision = solve_with_budget(g, config=_cfg(), error_budget=0.0)
+    assert decision.chosen.plan.name == "exact"
+    assert res.plan["chosen"] == "exact"
+    assert not res.plan.get("degraded")
+    # The exact result IS the solver's answer, bitwise.
+    ref = ParallelJohnsonSolver(_cfg()).solve(g)
+    np.testing.assert_array_equal(
+        np.asarray(res.matrix), np.asarray(ref.matrix)
+    )
+
+
+def test_positive_budget_picks_hopset():
+    g = grid2d(6, 6, seed=4)
+    res, decision = solve_with_budget(g, config=_cfg(), error_budget=0.5)
+    assert decision.chosen.plan.name == "hopset+bf"
+    assert res.plan["chosen"] == "hopset+bf"
+    assert res.route == "hopset+bf"
+    assert np.all(np.isfinite(res.max_error))  # connected: fully certified
+    _assert_certified(res.dist, res.max_error, oracle_apsp(g),
+                      context="budgeted solve")
+
+
+def test_forced_hopset_with_zero_budget_fails_loud():
+    g = grid2d(4, 4, seed=4)
+    with pytest.raises(ValueError, match="error_budget"):
+        solve_with_budget(g, config=_cfg(hopset=True), error_budget=0.0)
+
+
+def test_hopset_false_pins_exact_despite_budget():
+    g = grid2d(4, 4, seed=4)
+    res, _ = solve_with_budget(g, config=_cfg(hopset=False),
+                               error_budget=0.5)
+    assert res.plan["chosen"] == "exact"
+
+
+def test_negative_weights_disqualify_hopset(tiny_graph):
+    res, decision = solve_with_budget(tiny_graph, config=_cfg(),
+                                      error_budget=0.5)
+    assert res.plan["chosen"] == "exact"
+    reasons = {c["plan"]: c["reason"]
+               for c in res.plan["candidates"]}
+    assert "negative" in reasons["hopset+bf"]
+
+
+def test_approx_apsp_rejects_negative_weights(tiny_graph):
+    with pytest.raises(ValueError, match="non-negative"):
+        approx_apsp(tiny_graph, None, config=_cfg(), epsilon=0.5)
+
+
+# -- fleet-sharded construction ----------------------------------------------
+
+
+def test_fleet_build_bitwise_identical(tmp_path):
+    g = erdos_renyi(48, 0.08, seed=17)
+    single = hs.build_hopset(g, epsilon=0.5, k=8, beta=8, seed=3)
+    fleet = fleet_build_hopset(
+        tmp_path, g, n_workers=3, epsilon=0.5, k=8, beta=8, seed=3
+    )
+    np.testing.assert_array_equal(fleet.pivots, single.pivots)
+    np.testing.assert_array_equal(fleet.fwd, single.fwd)
+    np.testing.assert_array_equal(fleet.rev, single.rev)
+    assert fleet.converged == single.converged
+    assert fleet.beta == single.beta
+    assert fleet.digest == single.digest
+    # examined is telemetry, not part of the bitwise contract: the
+    # batched single build counts iterations over the whole pivot
+    # batch, per-shard sweeps count their own — both must be real.
+    assert fleet.edges_examined > 0 and single.edges_examined > 0
+
+
+def test_fleet_build_single_worker_degenerate(tmp_path):
+    g = grid2d(5, 5, seed=19)
+    single = hs.build_hopset(g, epsilon=0.3, k=5, beta=6, seed=0)
+    fleet = fleet_build_hopset(
+        tmp_path, g, n_workers=1, epsilon=0.3, k=5, beta=6, seed=0
+    )
+    np.testing.assert_array_equal(fleet.fwd, single.fwd)
+    np.testing.assert_array_equal(fleet.rev, single.rev)
+
+
+# -- persistence --------------------------------------------------------------
+
+
+def test_save_load_roundtrip_and_digest_guard(tmp_path):
+    g = grid2d(5, 5, seed=21)
+    hop = hs.build_hopset(g, epsilon=0.4, k=5, beta=8, seed=0)
+    hop.save(tmp_path)
+    back = hs.Hopset.load(tmp_path, expect_digest=hop.digest)
+    assert back is not None
+    np.testing.assert_array_equal(back.fwd, hop.fwd)
+    np.testing.assert_array_equal(back.rev, hop.rev)
+    np.testing.assert_array_equal(back.pivots, hop.pivots)
+    assert back.epsilon == hop.epsilon
+    assert back.beta == hop.beta
+    assert back.converged == hop.converged
+    # Wrong graph: load refuses (None), it never serves the wrong
+    # graph's shortcuts.
+    assert hs.Hopset.load(tmp_path, expect_digest="deadbeef") is None
+    assert hs.Hopset.load(tmp_path / "absent") is None
+
+
+def test_wrong_graph_hopset_refused_by_query():
+    g1 = grid2d(5, 5, seed=1)
+    g2 = grid2d(5, 5, seed=2)
+    hop = hs.build_hopset(g1, epsilon=0.5, seed=0)
+    with pytest.raises(ValueError, match="digest"):
+        approx_apsp(g2, None, config=_cfg(), hopset=hop)
+
+
+# -- pivot pickers ------------------------------------------------------------
+
+
+def test_boundary_picker_deterministic_and_certified():
+    g = grid2d(8, 4, seed=23)
+    a = hs.build_hopset(g, epsilon=0.5, k=6, beta=8, seed=5,
+                        picker="boundary")
+    b = hs.build_hopset(g, epsilon=0.5, k=6, beta=8, seed=5,
+                        picker="boundary")
+    np.testing.assert_array_equal(a.pivots, b.pivots)
+    assert a.picker == "boundary"
+    exact = oracle_apsp(g)
+    res = approx_apsp(g, None, config=_cfg(), hopset=a)
+    _assert_certified(res.dist, res.max_error, exact,
+                      context="boundary picker")
+
+
+# -- config validation --------------------------------------------------------
+
+
+def test_config_validates_approx_knobs():
+    with pytest.raises(ValueError, match="approx_epsilon"):
+        SolverConfig(approx_epsilon=0.0)
+    with pytest.raises(ValueError, match="approx_beta"):
+        SolverConfig(approx_beta=1)
+    with pytest.raises(ValueError, match="error_budget"):
+        SolverConfig(error_budget=-0.1)
+    with pytest.raises(ValueError, match="hopset"):
+        SolverConfig(hopset="yes")
+    with pytest.raises(ValueError, match="error_budget"):
+        solve_with_budget(grid2d(3, 3), config=_cfg(), error_budget=-1.0)
+
+
+def test_auto_beta_clamps():
+    assert hs.auto_beta(2, 10.0) == hs.BETA_MIN
+    assert hs.auto_beta(1 << 20, 1e-6) == hs.BETA_MAX
+    assert hs.BETA_MIN <= hs.auto_beta(4096, 0.5) <= hs.BETA_MAX
+
+
+# -- serving integration ------------------------------------------------------
+
+
+def test_engine_hopset_tier(tmp_path):
+    from paralleljohnson_tpu.serve import QueryEngine, TileStore
+
+    g = grid2d(6, 6, seed=25)
+    exact = oracle_apsp(g)
+    hop = hs.build_hopset(g, epsilon=0.5, seed=0)
+    engine = QueryEngine(
+        g, TileStore(tmp_path, g), hopset=hop, config=_cfg(),
+        miss_policy="hopset",
+    )
+    try:
+        for s, t in [(0, 35), (17, 3), (5, 5)]:
+            r = engine.query(s, t, mode="hopset")
+            assert r["exact"] is False
+            assert r["tier"] == "hopset"
+            assert np.isfinite(r["max_error"])
+            assert abs(r["distance"] - exact[s, t]) <= r["max_error"]
+        # Generic "approx" falls back to the hopset tier when no
+        # landmark index is attached.
+        r = engine.query(1, 2, mode="approx")
+        assert r["tier"] == "hopset"
+        summary = engine.serve_summary()
+        assert summary["engine"]["hopset_answers"] == 4
+        assert summary["engine"]["approx_answers"] == 4
+        assert summary["hopset"]["epsilon"] == 0.5
+        assert summary["hopset"]["k"] == hop.k
+    finally:
+        engine.close()
+
+
+def test_engine_hopset_digest_guard(tmp_path):
+    from paralleljohnson_tpu.serve import QueryEngine, TileStore
+
+    g1 = grid2d(5, 5, seed=1)
+    g2 = grid2d(5, 5, seed=2)
+    hop = hs.build_hopset(g1, epsilon=0.5, seed=0)
+    with pytest.raises(ValueError, match="digest"):
+        QueryEngine(g2, TileStore(tmp_path, g2), hopset=hop,
+                    config=_cfg(), miss_policy="hopset")
+
+
+def test_engine_hopset_policy_needs_hopset(tmp_path):
+    from paralleljohnson_tpu.serve import QueryEngine, TileStore
+
+    g = grid2d(4, 4, seed=1)
+    with pytest.raises(ValueError, match="hopset"):
+        QueryEngine(g, TileStore(tmp_path, g), config=_cfg(),
+                    miss_policy="hopset")
+
+
+def test_frontend_shed_policy_validation(tmp_path):
+    from paralleljohnson_tpu.serve import (
+        QueryEngine,
+        ServeFrontend,
+        TileStore,
+    )
+
+    g = grid2d(4, 4, seed=1)
+    engine = QueryEngine(g, TileStore(tmp_path, g), config=_cfg())
+    try:
+        with pytest.raises(ValueError, match="hopset"):
+            ServeFrontend(engine, shed_policy="hopset")
+        with pytest.raises(ValueError, match="certified tier"):
+            ServeFrontend(engine, shed_policy="priced")
+    finally:
+        engine.close()
+
+
+# -- the CLI surface ----------------------------------------------------------
+
+
+def test_cli_budgeted_solve(capsys, tmp_path):
+    import json
+
+    from paralleljohnson_tpu.cli import main
+
+    out_file = str(tmp_path / "approx.npz")
+    assert main(["solve", "grid:rows=6,cols=6,seed=1", "--backend",
+                 "numpy", "--error-budget", "0.5", "--approx-epsilon",
+                 "0.5", "--json", "--output", out_file]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["route"] == "hopset+bf"
+    assert out["exact"] in (True, False)  # converged tiny graph may be
+    assert out["plan"]["chosen"] == "hopset+bf"
+    assert out["certified_frac"] == 1.0
+    with np.load(out_file) as z:
+        assert z["dist"].shape == (36, 36)
+        assert np.all(np.isfinite(z["max_error"]))
+
+
+def test_cli_budget_zero_stays_exact(capsys):
+    import json
+
+    from paralleljohnson_tpu.cli import main
+
+    assert main(["solve", "grid:rows=5,cols=5,seed=1", "--backend",
+                 "numpy", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "route" not in out  # the ordinary exact payload
+    assert out["edges_relaxed"] > 0
+
+
+def test_cli_forced_hopset_zero_budget_is_an_error(capsys):
+    from paralleljohnson_tpu.cli import main
+
+    assert main(["solve", "grid:rows=4,cols=4,seed=1", "--backend",
+                 "numpy", "--hopset", "true"]) == 1
+    assert "error_budget" in capsys.readouterr().err
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_regress_ingests_hopset_records():
+    from paralleljohnson_tpu.observe.regress import (
+        BenchHistory,
+        detect_regressions,
+        normalize_record,
+    )
+
+    g = grid2d(6, 6, seed=27)
+    hop = hs.build_hopset(g, epsilon=0.5, seed=0)
+    rec = hopset_record(hop, g, platform="cpu")
+    assert rec["kind"] == "hopset"
+    rows = normalize_record(rec, source="test")
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["bench"].startswith("hopset:")
+    assert "eps0.5" in row["bench"]
+    assert row["wall_s"] == rec["construction_s"]
+    assert row["detail"]["hopset_edges"] == rec["hopset_edges"]
+    # A hopset that got fat (same knobs, 2x the edges) must flag a
+    # size regression against the history.
+    history = []
+    for i in range(3):
+        h = dict(row)
+        h["detail"] = dict(row["detail"])
+        h["wall_s"] = row["wall_s"] + i * 1e-6  # distinct sigs
+        history.append(h)
+    fat = dict(row)
+    fat["detail"] = dict(row["detail"],
+                         hopset_edges=2 * max(64, row["detail"]["hopset_edges"]))
+    flags = detect_regressions([fat], history)
+    assert any(f["kind"] == "size" for f in flags)
+    assert not detect_regressions([row], history)
+
+
+def test_hopset_answers_counted_in_prom_metrics(tmp_path):
+    from paralleljohnson_tpu.serve import QueryEngine, TileStore
+    from paralleljohnson_tpu.serve.engine import SERVE_PROM_METRICS
+
+    g = grid2d(5, 5, seed=29)
+    hop = hs.build_hopset(g, epsilon=0.5, seed=0)
+    engine = QueryEngine(g, TileStore(tmp_path, g), hopset=hop,
+                         config=_cfg(), miss_policy="hopset")
+    try:
+        engine.query(0, 24, mode="hopset")
+        by_name = {
+            m[0]: next(x for x in m if callable(x))(engine)
+            for m in SERVE_PROM_METRICS
+        }
+        assert by_name["pjtpu_hopset_answers_total"] == 1
+        assert by_name["pjtpu_hopset_edges"] == hop.num_hopset_edges
+    finally:
+        engine.close()
