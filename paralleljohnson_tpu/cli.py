@@ -530,7 +530,10 @@ def main(argv: list[str] | None = None) -> int:
              "queries in (stdin or --queries), one JSON answer line "
              "per query out (README 'Query serving')",
     )
-    p_serve.add_argument("graph", help="path or loader spec")
+    p_serve.add_argument("graph", nargs="?", default=None,
+                         help="path or loader spec (omit with --route: "
+                              "the router serves from the fleet's "
+                              "replicas, not a graph of its own)")
     p_serve.add_argument("--store-dir", default=None, metavar="DIR",
                          help="solve/checkpoint directory the tile store "
                               "attaches to (finished or in-progress; "
@@ -667,6 +670,54 @@ def main(argv: list[str] | None = None) -> int:
                               "on a near-idle server must not degrade "
                               "the next answer (default 20; 0 disables "
                               "the guard)")
+    # Replicated serve fleet (ISSUE 18, README "Replicated serve
+    # fleet"): replicas heartbeat-register into a fleet dir; a thin
+    # router mode consistent-hashes sources to the owning replica.
+    p_serve.add_argument("--max-inflight-per-client", type=int,
+                         default=None, metavar="N",
+                         help="per-client fairness cap UNDER "
+                              "--max-inflight: a client (request "
+                              "client_id, else peer address) past N "
+                              "in-flight gets {\"error\": \"overloaded\", "
+                              "\"client_limited\": true} while other "
+                              "clients keep flowing (default: off)")
+    p_serve.add_argument("--http", action="store_true",
+                         help="speak minimal HTTP/1.1 instead of "
+                              "newline-delimited JSON on --listen: POST "
+                              "/query (body = one protocol line, same "
+                              "answer doc back), GET /healthz (200/503 "
+                              "by solve-heartbeat freshness); overload "
+                              "maps to 429 + Retry-After")
+    p_serve.add_argument("--fleet-dir", default=None, metavar="DIR",
+                         help="register this replica in a serve-fleet "
+                              "directory: an atomically-heartbeated "
+                              "membership record under serve/replicas/ "
+                              "(stale-by-age = ejected from routing); "
+                              "requires --listen")
+    p_serve.add_argument("--replica-id", default=None, metavar="ID",
+                         help="membership record name under --fleet-dir "
+                              "(default: replica-<pid>)")
+    p_serve.add_argument("--replica-heartbeat", type=float, default=1.0,
+                         metavar="SECONDS",
+                         help="membership heartbeat interval (default 1; "
+                              "readers eject records stale by several "
+                              "intervals)")
+    p_serve.add_argument("--route", default=None, metavar="FLEET_DIR",
+                         help="router mode: forward pjtpu-serve/1 lines "
+                              "to the owning replica of FLEET_DIR's "
+                              "consistent-hash table (published "
+                              "atomically as serve/routing.json with a "
+                              "monotonic epoch); on replica death "
+                              "(stale heartbeat or connection refused) "
+                              "re-publishes the table minus the corpse "
+                              "and retries — bounded attempts, then an "
+                              "explicit unavailable error. Uses "
+                              "--listen for the bind address")
+    p_serve.add_argument("--replica-stale", type=float, default=None,
+                         metavar="SECONDS",
+                         help="router/top: eject replicas whose "
+                              "membership record is older than this "
+                              "(default: 5)")
     _add_common(p_serve)
 
     p_top = sub.add_parser(
@@ -683,6 +734,12 @@ def main(argv: list[str] | None = None) -> int:
     p_top.add_argument("--coordinator-dir", default=None, metavar="DIR",
                        help="fleet coordinator directory (lease table, "
                             "worker heartbeats, metrics/<worker>.json)")
+    p_top.add_argument("--fleet-dir", default=None, metavar="DIR",
+                       help="serve-fleet directory (serve/replicas/*.json "
+                            "membership heartbeats + routing.json): merge "
+                            "per-replica histograms/SLO burn into one "
+                            "service-level verdict with per-replica "
+                            "breakdown; dead/stale replicas flagged")
     p_top.add_argument("--once", action="store_true",
                        help="print one view and exit (default: refresh "
                             "every --interval seconds until interrupted)")
@@ -849,10 +906,11 @@ def main(argv: list[str] | None = None) -> int:
 
         from paralleljohnson_tpu.observe.top import gather_ops, render_ops
 
-        if args.serve_store is None and args.coordinator_dir is None:
+        if (args.serve_store is None and args.coordinator_dir is None
+                and args.fleet_dir is None):
             print(
-                "error: pjtpu top needs --serve-store and/or "
-                "--coordinator-dir (nothing to watch)",
+                "error: pjtpu top needs --serve-store, --fleet-dir, "
+                "and/or --coordinator-dir (nothing to watch)",
                 file=sys.stderr,
             )
             return 1
@@ -861,6 +919,7 @@ def main(argv: list[str] | None = None) -> int:
                 doc = gather_ops(
                     serve_store=args.serve_store,
                     coordinator_dir=args.coordinator_dir,
+                    serve_fleet=args.fleet_dir,
                     stale_after_s=args.stale_after,
                 )
                 if args.as_json:
@@ -1685,6 +1744,46 @@ def main(argv: list[str] | None = None) -> int:
                 )
             _report(res, args)
         elif args.command == "serve":
+            if args.route:
+                # Router mode (ISSUE 18): no graph, no engine — just
+                # the consistent-hash forwarder over the fleet's
+                # membership records. ``--listen`` picks the bind
+                # address (ephemeral port by default so drills can
+                # parse the announce line).
+                from paralleljohnson_tpu.serve import (
+                    PROTOCOL,
+                    FleetRouter,
+                    parse_listen,
+                )
+
+                host, port = parse_listen(args.listen or "127.0.0.1:0")
+                router = FleetRouter(
+                    args.route, host=host, port=port,
+                    stale_after_s=(args.replica_stale
+                                   if args.replica_stale is not None
+                                   else 5.0),
+                    retry_after_ms=args.retry_after_ms,
+                ).start()
+                table = router.table
+                print(json.dumps({
+                    "listening": f"{router.address()[0]}:"
+                                 f"{router.address()[1]}",
+                    "host": router.address()[0],
+                    "port": router.address()[1],
+                    "protocol": PROTOCOL,
+                    "router": True,
+                    "fleet_dir": str(args.route),
+                    "epoch": (table.epoch if table is not None else 0),
+                }), flush=True)
+                router.run_until_shutdown()
+                return 0
+            if args.graph is None:
+                print(
+                    "error: pjtpu serve requires a GRAPH positional "
+                    "(or --route FLEET_DIR for router mode)",
+                    file=sys.stderr,
+                )
+                return 1
             from paralleljohnson_tpu.serve import (
                 DEFAULT_HOT_ROWS,
                 DEFAULT_WARM_ROWS,
@@ -1788,6 +1887,11 @@ def main(argv: list[str] | None = None) -> int:
                     shed_min_events=args.shed_min_events,
                     fault_plan=cfg.fault_plan,
                     heartbeat_file=args.heartbeat_file,
+                    max_inflight_per_client=args.max_inflight_per_client,
+                    http=args.http,
+                    fleet_dir=args.fleet_dir,
+                    replica_id=args.replica_id,
+                    fleet_heartbeat_s=args.replica_heartbeat,
                 ).start()
                 # The announce line scripts/chaos drills parse for the
                 # bound (possibly ephemeral) port.
@@ -1800,6 +1904,8 @@ def main(argv: list[str] | None = None) -> int:
                     "shed_policy": args.shed_policy,
                     "max_connections": args.max_connections,
                     "max_inflight": args.max_inflight,
+                    "replica_id": frontend.replica_id,
+                    "http": args.http,
                 }), flush=True)
                 frontend.run_until_shutdown()
                 if getattr(args, "metrics_file", None):

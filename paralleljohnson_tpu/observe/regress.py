@@ -47,6 +47,14 @@ DEFAULT_MIN_HISTORY = 2
 DEFAULT_ITER_BAND = 0.25
 DEFAULT_ITER_ABS_FLOOR = 2
 
+# Re-route lapse band (ISSUE 18): how long a killed replica's sources
+# stay dark is the serve fleet's graded axis — a slower failover is a
+# robustness regression even when the bench wall looks fine. The band
+# is WIDE (50%) and the absolute floor generous (0.5 s) because the
+# lapse is quantised by heartbeat/refresh clocks, not compute.
+DEFAULT_REROUTE_BAND = 0.50
+DEFAULT_REROUTE_ABS_FLOOR_S = 0.5
+
 # Hopset size band (ISSUE 17): a hopset's edge count is a DETERMINISTIC
 # function of (graph, ε, k, β, seed, picker) — same shape bucket, same
 # knobs, fatter hopset means the construction changed, not the weather.
@@ -327,6 +335,12 @@ def _hopset_edges_of(row: dict):
     return int(n) if isinstance(n, (int, float)) and n > 0 else None
 
 
+def _reroute_lapse_of(row: dict):
+    """A row's kill-to-reroute lapse (``serve_fleet`` rows, ISSUE 18)."""
+    s = (row.get("detail") or {}).get("reroute_lapse_s")
+    return float(s) if isinstance(s, (int, float)) and s > 0 else None
+
+
 def detect_regressions(
     fresh: list[dict],
     history: list[dict],
@@ -354,10 +368,15 @@ def detect_regressions(
     even when wall noise hides it. Rows carrying ``hopset_edges``
     (``kind:"hopset"`` ingests) are graded on edge count under the
     tighter size band (``kind: "size"``) — a fatter hopset slows every
-    downstream query even when construction stayed fast."""
+    downstream query even when construction stayed fast. ``serve_fleet``
+    rows carrying ``detail.reroute_lapse_s`` are graded on the
+    kill-to-reroute lapse (``kind: "reroute"``) under a wide band with
+    a heartbeat-clock absolute floor — a slower failover flags the gate
+    even when the bench wall is quiet."""
     by_key: dict[tuple, list[float]] = {}
     iters_by_key: dict[tuple, list[int]] = {}
     size_by_key: dict[tuple, list[int]] = {}
+    reroute_by_key: dict[tuple, list[float]] = {}
     for row in history:
         w = row.get("wall_s")
         if isinstance(w, (int, float)) and w > 0:
@@ -368,6 +387,9 @@ def detect_regressions(
         n = _hopset_edges_of(row)
         if n is not None:
             size_by_key.setdefault(history_key(row), []).append(n)
+        lapse = _reroute_lapse_of(row)
+        if lapse is not None:
+            reroute_by_key.setdefault(history_key(row), []).append(lapse)
     flagged = []
     for row in fresh:
         w = row.get("wall_s")
@@ -403,6 +425,24 @@ def detect_regressions(
                     "slowdown": n / sbase,
                     "band": DEFAULT_SIZE_BAND,
                     "history_n": len(shist),
+                    "roofline_bound": _roofline_of(row, profile_records),
+                })
+        lapse = _reroute_lapse_of(row)
+        rhist = reroute_by_key.get(history_key(row))
+        if lapse is not None and rhist and len(rhist) >= min_history:
+            rbase = statistics.median(rhist)
+            if (
+                lapse > rbase * (1.0 + DEFAULT_REROUTE_BAND)
+                and (lapse - rbase) > DEFAULT_REROUTE_ABS_FLOOR_S
+            ):
+                flagged.append({
+                    **row,
+                    "kind": "reroute",
+                    "reroute_lapse_s": lapse,
+                    "baseline_lapse_s": rbase,
+                    "slowdown": lapse / rbase,
+                    "band": DEFAULT_REROUTE_BAND,
+                    "history_n": len(rhist),
                     "roofline_bound": _roofline_of(row, profile_records),
                 })
         it = _iterations_of(row)

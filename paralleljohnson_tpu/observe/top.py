@@ -230,10 +230,140 @@ def _gather_fleet(coord_dir: Path, now: float, stale_after_s: float) -> dict:
     }
 
 
+def _gather_serve_fleet(fleet_dir: Path, now: float,
+                        stale_after_s: float) -> dict:
+    """Merged serve-fleet view (ISSUE 18): membership records under
+    ``<fleet>/serve/replicas/`` + the published ``routing.json``, joined
+    into one service-level document. Per-replica counters sum; the
+    per-replica latency histograms MERGE (same log-bucket geometry by
+    construction — round 17's design goal), so the fleet p50/p99 carry
+    the same one-bucket error bound as any single replica's. Dead/stale
+    replicas stay in the document, flagged, but never contribute to the
+    merge. Absent/torn files degrade — never a crash."""
+    from paralleljohnson_tpu.observe.live import LogHistogram
+    from paralleljohnson_tpu.serve import fleet as fleet_mod
+
+    doc: dict = {"dir": str(fleet_dir), "routing": None,
+                 "replicas": {}, "merged": None}
+    routing = _read_json(fleet_mod.routing_path(fleet_dir))
+    if routing is not None:
+        doc["routing"] = {
+            "epoch": routing.get("epoch"),
+            "vnodes": routing.get("vnodes"),
+            "replicas": sorted(routing.get("replicas") or {}),
+        }
+        _flag_stale(doc["routing"], routing.get("ts"), now, stale_after_s)
+    records = fleet_mod.read_replicas(
+        fleet_dir, stale_after_s=stale_after_s, now=now
+    )
+    counter_keys = ("queries_total", "exact_answers", "approx_answers",
+                    "hopset_answers", "errors", "stale_answers",
+                    "shed_answers", "rejected", "deadline_drops",
+                    "client_limited", "open_connections")
+    merged_hist = None
+    merge_error = None
+    counters: dict = {}
+    slo_bad = 0.0
+    slo_events = 0.0
+    burning = False
+    objective = None
+    alive = 0
+    for rec in records:
+        rid = rec.get("replica_id") or "?"
+        stats = rec.get("stats") or {}
+        live = rec.get("live") or {}
+        entry = {
+            "host": rec.get("host"),
+            "port": rec.get("port"),
+            "pid": rec.get("pid"),
+            "torn": bool(rec.get("torn")),
+            "age_s": rec.get("age_s"),
+            "stale": bool(rec.get("stale", True)),
+            "queries_total": stats.get("queries_total"),
+            "p50_ms": stats.get("p50_ms"),
+            "p99_ms": stats.get("p99_ms"),
+            "p99_err_ms": stats.get("p99_err_ms"),
+            "shed_answers": stats.get("shed_answers"),
+            "rejected": stats.get("rejected"),
+            "client_limited": stats.get("client_limited"),
+            "open_connections": stats.get("open_connections"),
+        }
+        doc["replicas"][rid] = entry
+        if entry["stale"]:
+            continue  # flagged corpse: shown, never merged
+        alive += 1
+        for k in counter_keys:
+            v = stats.get(k)
+            if isinstance(v, (int, float)):
+                counters[k] = counters.get(k, 0) + v
+        serve_slo = (live.get("slos") or {}).get("serve") or {}
+        if serve_slo:
+            slo_bad += float(serve_slo.get("bad_total") or 0.0)
+            slo_events += float(serve_slo.get("events_total") or 0.0)
+            burning = burning or bool(serve_slo.get("burning"))
+            objective = objective or serve_slo.get("objective")
+        hist_doc = (((live.get("histograms") or {})
+                     .get("pjtpu_query_latency_ms") or {}).get("hist"))
+        if hist_doc and merge_error is None:
+            try:
+                h = LogHistogram.from_dict(hist_doc)
+                merged_hist = (h if merged_hist is None
+                               else merged_hist.merge(h))
+            except (ValueError, TypeError, KeyError) as e:
+                # Geometry guard: mismatched bucketings must never
+                # silently corrupt the merged percentiles — degrade to
+                # per-replica data with the reason on the document.
+                merge_error = str(e)
+                merged_hist = None
+    merged: dict = {"replicas_live": alive,
+                    "replicas_total": len(records),
+                    "counters": counters}
+    if merge_error is not None:
+        merged["histogram_merge_error"] = merge_error
+    elif merged_hist is not None and merged_hist.count:
+        merged.update({
+            k: round(v, 4)
+            for k, v in merged_hist.percentiles((50, 99)).items()
+        })
+    slo: dict = {"burning": burning, "bad_total": slo_bad,
+                 "events_total": slo_events}
+    if slo_events > 0:
+        slo["availability"] = round(1.0 - slo_bad / slo_events, 6)
+    if objective:
+        slo["objective"] = objective
+        target = objective.get("latency_ms")
+        pct = objective.get("latency_pct", 99.0)
+        if (target is not None and merge_error is None
+                and merged_hist is not None and merged_hist.count):
+            pr = merged_hist.percentile(pct)
+            slo["latency"] = {
+                "pct": pct,
+                "observed_ms": round(pr["value"], 4),
+                "max_error_ms": round(pr["max_error"], 4),
+                "target_ms": target,
+                # The honest tri-state (round 17): a bucket bound that
+                # straddles the target says so instead of picking a side.
+                "met": (True if pr["upper"] <= target
+                        else False if pr["lower"] > target
+                        else "within-error-bound"),
+            }
+    # One service-level verdict: a burning replica or a missed merged
+    # latency target degrades the whole fleet's word.
+    lat_met = (slo.get("latency") or {}).get("met")
+    merged["verdict"] = ("burning" if burning
+                         else "degraded" if lat_met is False
+                         else "no-replicas" if alive == 0
+                         else "ok")
+    merged["slo"] = slo
+    doc["merged"] = merged
+    return doc
+
+
 def gather_ops(
     *,
     serve_store: str | Path | None = None,
     coordinator_dir: str | Path | None = None,
+    serve_fleet: str | Path | None = None,
     stale_after_s: float = 15.0,
     now: float | None = None,
 ) -> dict:
@@ -245,6 +375,7 @@ def gather_ops(
         "ts": now,
         "stale_after_s": float(stale_after_s),
         "serve": [],
+        "serve_fleet": None,
         "fleet": None,
         "repairs": [],
     }
@@ -256,6 +387,9 @@ def gather_ops(
             {"dir": e["dir"], **e["repair"]}
             for e in entries if "repair" in e
         ]
+    if serve_fleet is not None:
+        doc["serve_fleet"] = _gather_serve_fleet(Path(serve_fleet), now,
+                                                 stale_after_s)
     if coordinator_dir is not None:
         doc["fleet"] = _gather_fleet(Path(coordinator_dir), now,
                                      stale_after_s)
@@ -348,6 +482,68 @@ def _render_serve(lines: list[str], entries: list[dict]) -> None:
             )
 
 
+def _render_serve_fleet(lines: list[str], doc: dict) -> None:
+    merged = doc.get("merged") or {}
+    slo = merged.get("slo") or {}
+    lat = slo.get("latency") or {}
+    counters = merged.get("counters") or {}
+    lines.append(
+        f"SERVE-FLEET {doc.get('dir')}  "
+        f"[{_fmt(merged.get('replicas_live'), 0)}/"
+        f"{_fmt(merged.get('replicas_total'), 0)} live]  "
+        f"verdict {merged.get('verdict', '-').upper()}"
+    )
+    routing = doc.get("routing")
+    if routing:
+        lines.append(
+            f"  routing epoch {_fmt(routing.get('epoch'), 0)} "
+            f"vnodes {_fmt(routing.get('vnodes'), 0)} over "
+            f"{len(routing.get('replicas') or [])} replicas "
+            f"[{_staleness(routing)}]"
+        )
+    if merged.get("histogram_merge_error"):
+        lines.append(
+            f"  merged percentiles unavailable "
+            f"(geometry guard): {merged['histogram_merge_error']}"
+        )
+    else:
+        lines.append(
+            f"  merged queries {_fmt(counters.get('queries_total'))}   "
+            f"p50 {_fmt(merged.get('p50_ms'))}"
+            f"±{_fmt(merged.get('p50_err_ms'))} ms   "
+            f"p99 {_fmt(merged.get('p99_ms'))}"
+            f"±{_fmt(merged.get('p99_err_ms'))} ms   "
+            f"shed {_fmt(counters.get('shed_answers'))}   "
+            f"rejected {_fmt(counters.get('rejected'))}   "
+            f"client-limited {_fmt(counters.get('client_limited'))}"
+        )
+    if slo:
+        lines.append(
+            f"  SLO fleet: {'BURNING' if slo.get('burning') else 'ok'} "
+            f"(bad {_fmt(slo.get('bad_total'), 0)}/"
+            f"{_fmt(slo.get('events_total'), 0)}"
+            + (f", availability {_fmt(slo.get('availability'), 4)}"
+               if slo.get("availability") is not None else "")
+            + ")"
+            + (f"   p{_fmt(lat.get('pct'), 0)} "
+               f"{_fmt(lat.get('observed_ms'))} ms "
+               f"(±{_fmt(lat.get('max_error_ms'))}) vs target "
+               f"{_fmt(lat.get('target_ms'))} ms -> {lat.get('met')}"
+               if lat else "")
+        )
+    for rid, r in (doc.get("replicas") or {}).items():
+        addr = f"{r.get('host')}:{r.get('port')}" if r.get("port") else "-"
+        flag = ("TORN" if r.get("torn")
+                else _staleness(r))
+        lines.append(
+            f"  {rid:<14} {addr:<22} [{flag}]  "
+            f"queries {_fmt(r.get('queries_total'))}   "
+            f"p99 {_fmt(r.get('p99_ms'))}"
+            f"±{_fmt(r.get('p99_err_ms'))} ms   "
+            f"conns {_fmt(r.get('open_connections'))}"
+        )
+
+
 def _render_fleet(lines: list[str], fleet: dict) -> None:
     lines.append(f"FLEET {fleet.get('dir')}")
     if "error" in fleet:
@@ -412,6 +608,8 @@ def render_ops(doc: dict) -> str:
     ]
     if doc.get("serve"):
         _render_serve(lines, doc["serve"])
+    if doc.get("serve_fleet"):
+        _render_serve_fleet(lines, doc["serve_fleet"])
     if doc.get("fleet"):
         _render_fleet(lines, doc["fleet"])
     if doc.get("repairs"):
@@ -419,6 +617,7 @@ def render_ops(doc: dict) -> str:
     if len(lines) == 1:
         lines.append(
             "nothing to show — point --serve-store at a checkpoint/store "
-            "directory and/or --coordinator-dir at a fleet directory"
+            "directory, --fleet-dir at a serve-fleet directory, and/or "
+            "--coordinator-dir at a fleet directory"
         )
     return "\n".join(lines)

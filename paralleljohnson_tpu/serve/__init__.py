@@ -23,6 +23,14 @@ from paralleljohnson_tpu.serve.engine import (
     SERVE_STATS_FILENAME,
     ServeStats,
 )
+from paralleljohnson_tpu.serve.fleet import (
+    ReplicaRegistration,
+    RoutingTable,
+    live_replicas,
+    publish_routing,
+    read_replicas,
+    read_routing,
+)
 from paralleljohnson_tpu.serve.frontend import (
     DEFAULT_BATCH_WAIT_MS,
     DEFAULT_BATCH_WINDOW,
@@ -32,6 +40,7 @@ from paralleljohnson_tpu.serve.frontend import (
     ServeFrontend,
     parse_listen,
 )
+from paralleljohnson_tpu.serve.router import FleetRouter
 from paralleljohnson_tpu.serve.landmarks import (
     Bounds,
     LandmarkIndex,
@@ -54,12 +63,15 @@ __all__ = [
     "DEFAULT_SLO",
     "DEFAULT_WARM_ROWS",
     "DeviceQueryPath",
+    "FleetRouter",
     "LandmarkIndex",
     "MicroBatcher",
     "PIVOT_PICKERS",
     "PROTOCOL",
     "QueryEngine",
     "QueryError",
+    "ReplicaRegistration",
+    "RoutingTable",
     "SERVE_PROM_METRICS",
     "SERVE_STATS_FILENAME",
     "SHED_POLICIES",
@@ -67,7 +79,11 @@ __all__ = [
     "ServeStats",
     "TileStore",
     "finish_estimates",
+    "live_replicas",
     "parse_listen",
     "pick_pivots",
+    "publish_routing",
+    "read_replicas",
+    "read_routing",
     "widen_bounds",
 ]

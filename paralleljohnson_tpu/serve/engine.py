@@ -139,6 +139,9 @@ class ServeStats:
     shed_answers: int = 0
     rejected: int = 0
     deadline_drops: int = 0
+    # Per-client fairness (ISSUE 18): requests rejected at a client's
+    # own in-flight cap while the rest of the fleet kept flowing.
+    client_limited: int = 0
     open_connections: int = 0
     # Lookup-path accounting (ISSUE 16): which dispatch served each
     # answered query — the device megabatch or the host tier walk —
@@ -175,6 +178,7 @@ class ServeStats:
             "shed_answers": self.shed_answers,
             "rejected": self.rejected,
             "deadline_drops": self.deadline_drops,
+            "client_limited": self.client_limited,
             "open_connections": self.open_connections,
             "device_lookups": self.device_lookups,
             "host_lookups": self.host_lookups,
@@ -237,6 +241,10 @@ SERVE_PROM_METRICS = (
      "Requests dropped because they could not start before their "
      "deadline_ms (rejected without touching the engine)",
      lambda e: e.stats.deadline_drops),
+    ("pjtpu_client_limited_total", "counter",
+     "Requests rejected at their client's per-key in-flight cap "
+     "(fairness: the hog is limited while other clients keep flowing)",
+     lambda e: e.stats.client_limited),
     ("pjtpu_open_connections", "gauge",
      "Client connections currently open on the socket frontend",
      lambda e: e.stats.open_connections),
@@ -547,6 +555,23 @@ class QueryEngine:
                 if p is not None and p["source"] not in rows
                 and p["mode"] == "solve"
             })
+            if missing_exact and self.store.refresh_cold_if_changed():
+                # Live-fleet awareness (ISSUE 18): another process —
+                # a solve worker or a sibling replica — committed
+                # manifest increments since we attached. Re-check the
+                # misses against the refreshed cold index before paying
+                # for a solve; an in-flight fleet solve's batches turn
+                # our misses into cold hits. The check is one stat()
+                # per manifest, and only on the (already-expensive)
+                # miss path — the hot path never touches the disk.
+                still_missing = []
+                for s in missing_exact:
+                    row, row_tier = self.store.get(s)
+                    if row is not None:
+                        rows[s] = (row, row_tier)
+                    else:
+                        still_missing.append(s)
+                missing_exact = still_missing
             if missing_exact:
                 batch = np.asarray(missing_exact, np.int64)
                 with tel.span("serve_solve", n_sources=len(batch)):

@@ -58,6 +58,7 @@ response line, in order, on the connection that sent it.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
@@ -226,7 +227,11 @@ class ServeFrontend:
                  fault_plan=None, heartbeat_file=None,
                  heartbeat_stale_s: float = 30.0,
                  batch_window: int = DEFAULT_BATCH_WINDOW,
-                 batch_wait_ms: float = DEFAULT_BATCH_WAIT_MS) -> None:
+                 batch_wait_ms: float = DEFAULT_BATCH_WAIT_MS,
+                 max_inflight_per_client: int | None = None,
+                 http: bool = False,
+                 fleet_dir=None, replica_id: str | None = None,
+                 fleet_heartbeat_s: float = 1.0) -> None:
         if shed_policy not in SHED_POLICIES:
             raise ValueError(
                 f"shed_policy must be one of {SHED_POLICIES}, "
@@ -250,6 +255,8 @@ class ServeFrontend:
             )
         if max_connections < 1 or max_inflight < 1:
             raise ValueError("max_connections and max_inflight must be >= 1")
+        if max_inflight_per_client is not None and max_inflight_per_client < 1:
+            raise ValueError("max_inflight_per_client must be >= 1 (or None)")
         self.engine = engine
         self.host, self.port = host, int(port)
         self.max_connections = int(max_connections)
@@ -271,9 +278,29 @@ class ServeFrontend:
                          wait_ms=self.batch_wait_ms)
             if self.batch_window > 1 else None
         )
+        # Per-client fairness (ISSUE 18): an optional per-client-key
+        # in-flight cap UNDER the global semaphore. None = the round-20
+        # globally-FIFO behavior, unchanged.
+        self.max_inflight_per_client = (
+            None if max_inflight_per_client is None
+            else int(max_inflight_per_client)
+        )
+        # HTTP/1.1 adaptation (ISSUE 18): same listener, same admission
+        # path, request bodies are protocol lines.
+        self.http = bool(http)
+        # Fleet membership (ISSUE 18): heartbeat-registered replica
+        # record in <fleet_dir>/serve/replicas/.
+        self.fleet_dir = fleet_dir
+        self.replica_id = (
+            str(replica_id) if replica_id else f"replica-{os.getpid()}"
+        )
+        self.fleet_heartbeat_s = float(fleet_heartbeat_s)
+        self._registration = None
         self._tel = engine._tel
         self._tracker = engine.slo_tracker()
         self._inflight = threading.Semaphore(self.max_inflight)
+        self._client_lock = threading.Lock()
+        self._client_slots: dict[str, threading.Semaphore] = {}
         self._stats_lock = threading.Lock()
         self._conn_lock = threading.Lock()
         self._conns: dict[socket.socket, threading.Thread] = {}
@@ -305,7 +332,8 @@ class ServeFrontend:
         # must distinguish "zero shedding happened" (counter at 0) from
         # "this was never a traffic front end" (counter absent).
         for name in ("pjtpu_shed_answers", "pjtpu_rejected",
-                     "pjtpu_deadline_drops", "pjtpu_slo_shed_transitions"):
+                     "pjtpu_deadline_drops", "pjtpu_slo_shed_transitions",
+                     "pjtpu_client_limited"):
             self.engine.metrics.counter(name)
         self._publish_open(0)
         # Store-backed engines publish the live-metrics snapshot beside
@@ -316,6 +344,18 @@ class ServeFrontend:
                 self.engine.store.ckpt.dir / SERVE_LIVE_FILENAME,
                 interval_s=self.engine.stats_interval_s,
             )
+        # Fleet membership: heartbeat the bound address + live metrics
+        # into the fleet dir so routers/top/slo_report see this replica.
+        if self.fleet_dir is not None:
+            from paralleljohnson_tpu.serve.fleet import ReplicaRegistration
+
+            self._registration = ReplicaRegistration(
+                self.fleet_dir, self.replica_id,
+                host=self.address[0], port=self.address[1],
+                graph_digest=self.engine.store.digest,
+                interval_s=self.fleet_heartbeat_s,
+                payload_fn=self._fleet_payload,
+            ).start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="pj-serve-accept", daemon=True
         )
@@ -326,6 +366,18 @@ class ServeFrontend:
                         max_inflight=self.max_inflight,
                         shed_policy=self.shed_policy)
         return self
+
+    def _fleet_payload(self) -> dict:
+        """Merged into every membership heartbeat: serve counters + a
+        full live-metrics snapshot, so the fleet dir alone feeds the
+        top/slo_report fleet view (histograms merge by construction)."""
+        return {
+            "protocol": PROTOCOL,
+            "http": self.http,
+            "shed_policy": self.shed_policy,
+            "stats": self.engine.stats.as_dict(),
+            "live": self.engine.metrics.snapshot(),
+        }
 
     def run_until_shutdown(self, *, install_signal_handlers: bool = True) -> int:
         """Block until SIGTERM/SIGINT (or :meth:`request_shutdown`),
@@ -354,6 +406,10 @@ class ServeFrontend:
             self._stopped.wait(self.drain_timeout_s + 5.0)
             return
         self._draining.set()
+        # Leave the fleet first: removing the membership record stops
+        # routers sending NEW traffic here while in-flight work finishes.
+        if self._registration is not None:
+            self._registration.stop(deregister=True)
         self._tel.event("serve_drain", open_connections=len(self._conns),
                         drain_timeout_s=self.drain_timeout_s)
         ls = self._listener
@@ -464,19 +520,51 @@ class ServeFrontend:
         except OSError:
             return False
 
+    _HTTP_METHODS = (b"GET ", b"POST ", b"HEAD ", b"PUT ", b"DELETE ",
+                     b"OPTIONS ", b"PATCH ")
+
+    def _sniff_http(self, sock: socket.socket) -> bool:
+        """Classify one accepted connection in ``--http`` mode. HTTP
+        clients talk first (a method token within milliseconds);
+        ``pjtpu-serve/1`` clients — the fleet router's forwards
+        included — wait for the server header line. So: peek briefly,
+        and anything that is not an HTTP request line (including
+        silence) falls back to the line protocol. An ``--http`` replica
+        therefore still serves routed fleet traffic."""
+        try:
+            sock.settimeout(0.25)
+            first = sock.recv(8, socket.MSG_PEEK)
+        except (TimeoutError, socket.timeout, OSError):
+            return False
+        finally:
+            try:
+                sock.settimeout(None)
+            except OSError:
+                pass
+        return any(first.startswith(m[: len(first)]) and first
+                   for m in self._HTTP_METHODS)
+
     def _handle_connection(self, sock: socket.socket) -> None:
         try:
+            try:
+                peer = sock.getpeername()[0]
+            except OSError:
+                peer = None
+            if self.http and self._sniff_http(sock):
+                self._serve_http(sock, peer)
+                return
             self._send_line(sock, {
                 "protocol": PROTOCOL,
                 "graph_digest": self.engine.store.digest,
                 "shed_policy": self.shed_policy,
                 "max_inflight": self.max_inflight,
+                "replica_id": self.replica_id,
             })
             reader = sock.makefile("r", encoding="utf-8", newline="\n")
             for line in reader:
                 if not line.strip():
                     continue
-                self._handle_request(sock, line)
+                self._handle_request(sock, line, peer)
         except (OSError, ValueError):
             pass  # client went away / socket force-closed mid-drain
         finally:
@@ -605,6 +693,7 @@ class ServeFrontend:
         doc = {
             "ok": not self._draining.is_set(),
             "protocol": PROTOCOL,
+            "replica_id": self.replica_id,
             "draining": self._draining.is_set(),
             "shedding": self.shed_active,
             "shed_policy": self.shed_policy,
@@ -637,7 +726,8 @@ class ServeFrontend:
             doc["heartbeat"] = hb
         return doc
 
-    def _handle_request(self, sock: socket.socket, line: str) -> None:
+    def _handle_request(self, sock: socket.socket, line: str,
+                        peer: str | None = None) -> None:
         try:
             req = json.loads(line)
             if not isinstance(req, dict):
@@ -646,15 +736,45 @@ class ServeFrontend:
             self.engine.note_failed_requests(1)
             self._send_line(sock, {"error": f"bad request line: {e}"})
             return
+        self._send_line(sock, self._process_request(req, peer))
+
+    def _client_key(self, req: dict, peer: str | None) -> str:
+        """Fairness identity: the request's ``client_id`` when the
+        client declares one, else the peer address — so an undeclared
+        hog is still one key, not anonymous."""
+        cid = req.get("client_id")
+        if cid is not None:
+            return f"id:{cid}"
+        return f"peer:{peer}" if peer else "peer:?"
+
+    def _client_slot(self, key: str) -> threading.Semaphore:
+        with self._client_lock:
+            sem = self._client_slots.get(key)
+            if sem is None:
+                sem = threading.Semaphore(self.max_inflight_per_client)
+                self._client_slots[key] = sem
+            return sem
+
+    def _count_client_limited(self) -> None:
+        with self._stats_lock:
+            self.engine.stats.client_limited += 1
+        self.engine.metrics.counter("pjtpu_client_limited").add(1)
+        # A fairness rejection spends error budget like any other
+        # rejection — the hog's requests are still failed requests.
+        self.engine.metrics.observe_slo(self.engine.slo.name, None, ok=False)
+
+    def _process_request(self, req: dict, peer: str | None = None) -> dict:
+        """Admission + answer for one parsed request object; always
+        returns a response document, never raises. Shared by the JSONL
+        socket path and the HTTP adaptation — one admission policy,
+        two framings."""
         if req.get("op") == "health":
-            self._send_line(sock, {"id": req.get("id"), **self.health()})
-            return
+            return {"id": req.get("id"), **self.health()}
         req_id = req.get("id")
         if self._draining.is_set():
             self._count_rejection()
-            self._send_line(sock, {"id": req_id, "error": "draining",
-                                   "retry_after_ms": self.retry_after_ms})
-            return
+            return {"id": req_id, "error": "draining",
+                    "retry_after_ms": self.retry_after_ms}
         arrival = time.perf_counter()
         deadline_ms = req.pop("deadline_ms", None)
         if deadline_ms is not None:
@@ -662,55 +782,62 @@ class ServeFrontend:
                 deadline_ms = float(deadline_ms)
             except (TypeError, ValueError):
                 self.engine.note_failed_requests(1)
-                self._send_line(sock, {
-                    "id": req_id, "error": f"bad deadline_ms {deadline_ms!r}",
-                })
-                return
+                return {"id": req_id,
+                        "error": f"bad deadline_ms {deadline_ms!r}"}
 
-        # Admission: a free in-flight slot or an explicit answer — a
-        # deadline-carrying request may wait for a slot up to its own
-        # patience (the bounded queue IS the deadline), everyone else
-        # is rejected immediately rather than queued.
-        acquired = self._inflight.acquire(blocking=False)
-        if not acquired and deadline_ms is not None:
-            remaining = deadline_ms / 1e3 - (time.perf_counter() - arrival)
-            if remaining > 0:
-                acquired = self._inflight.acquire(timeout=remaining)
-        if not acquired:
-            if deadline_ms is not None:
-                self._count_rejection(deadline=True)
-                self._send_line(sock, {
-                    "id": req_id, "error": "deadline",
-                    "deadline_ms": deadline_ms,
-                    "waited_ms": round(
-                        (time.perf_counter() - arrival) * 1e3, 3),
-                })
-            else:
-                self._count_rejection()
-                self._send_line(sock, {
-                    "id": req_id, "error": "overloaded",
-                    "reason": "max_inflight",
-                    "retry_after_ms": self.retry_after_ms,
-                })
-            return
+        # Per-client fairness: the hog is rejected at ITS cap with an
+        # explicit client_limited flag while other clients' requests
+        # keep reaching the global semaphore below — one flooding
+        # client can no longer occupy every in-flight slot.
+        client_sem = None
+        if self.max_inflight_per_client is not None:
+            client_sem = self._client_slot(self._client_key(req, peer))
+            if not client_sem.acquire(blocking=False):
+                self._count_client_limited()
+                return {"id": req_id, "error": "overloaded",
+                        "reason": "max_inflight_per_client",
+                        "client_limited": True,
+                        "retry_after_ms": self.retry_after_ms}
         try:
-            # The slot may have freed exactly at the deadline: re-check
-            # before the engine sees the request.
-            if deadline_ms is not None and (
-                    (time.perf_counter() - arrival) * 1e3 > deadline_ms):
-                self._count_rejection(deadline=True)
-                self._send_line(sock, {
-                    "id": req_id, "error": "deadline",
-                    "deadline_ms": deadline_ms,
-                    "waited_ms": round(
-                        (time.perf_counter() - arrival) * 1e3, 3),
-                })
-                return
-            self._answer(sock, req)
+            # Admission: a free in-flight slot or an explicit answer — a
+            # deadline-carrying request may wait for a slot up to its own
+            # patience (the bounded queue IS the deadline), everyone else
+            # is rejected immediately rather than queued.
+            acquired = self._inflight.acquire(blocking=False)
+            if not acquired and deadline_ms is not None:
+                remaining = (deadline_ms / 1e3
+                             - (time.perf_counter() - arrival))
+                if remaining > 0:
+                    acquired = self._inflight.acquire(timeout=remaining)
+            if not acquired:
+                if deadline_ms is not None:
+                    self._count_rejection(deadline=True)
+                    return {"id": req_id, "error": "deadline",
+                            "deadline_ms": deadline_ms,
+                            "waited_ms": round(
+                                (time.perf_counter() - arrival) * 1e3, 3)}
+                self._count_rejection()
+                return {"id": req_id, "error": "overloaded",
+                        "reason": "max_inflight",
+                        "retry_after_ms": self.retry_after_ms}
+            try:
+                # The slot may have freed exactly at the deadline:
+                # re-check before the engine sees the request.
+                if deadline_ms is not None and (
+                        (time.perf_counter() - arrival) * 1e3 > deadline_ms):
+                    self._count_rejection(deadline=True)
+                    return {"id": req_id, "error": "deadline",
+                            "deadline_ms": deadline_ms,
+                            "waited_ms": round(
+                                (time.perf_counter() - arrival) * 1e3, 3)}
+                return self._answer_doc(req)
+            finally:
+                self._inflight.release()
         finally:
-            self._inflight.release()
+            if client_sem is not None:
+                client_sem.release()
 
-    def _answer(self, sock: socket.socket, req: dict) -> None:
+    def _answer_doc(self, req: dict) -> dict:
         engine = self.engine
         req_id = req.get("id")
         shed = False
@@ -725,12 +852,9 @@ class ServeFrontend:
             if not is_hit:
                 if self.shed_policy == "reject":
                     self._count_rejection()
-                    self._send_line(sock, {
-                        "id": req_id, "error": "overloaded",
-                        "reason": "shedding", "shed": True,
-                        "retry_after_ms": self.retry_after_ms,
-                    })
-                    return
+                    return {"id": req_id, "error": "overloaded",
+                            "reason": "shedding", "shed": True,
+                            "retry_after_ms": self.retry_after_ms}
                 # Certified degrade: the landmark/hopset answer is
                 # flagged exact=false AND shed=true, and carries
                 # max_error — never an unflagged approximation. The
@@ -754,7 +878,105 @@ class ServeFrontend:
             with self._stats_lock:
                 engine.stats.shed_answers += 1
             engine.metrics.counter("pjtpu_shed_answers").add(1)
-        self._send_line(sock, resp)
+        return resp
+
+    # -- HTTP/1.1 adaptation (ISSUE 18) --------------------------------------
+
+    _HTTP_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                     429: "Too Many Requests",
+                     500: "Internal Server Error",
+                     503: "Service Unavailable", 504: "Gateway Timeout"}
+
+    @staticmethod
+    def _http_status_for(resp: dict) -> int:
+        err = resp.get("error")
+        if err is None:
+            return 200
+        if err == "overloaded":
+            return 429
+        if err == "draining":
+            return 503
+        if err == "deadline":
+            return 504
+        if str(err).startswith("internal"):
+            return 500
+        return 400
+
+    def _send_http(self, sock: socket.socket, status: int, doc: dict,
+                   *, extra_headers: tuple = ()) -> None:
+        body = (json.dumps(doc) + "\n").encode("utf-8")
+        head = [f"HTTP/1.1 {status} {self._HTTP_REASONS.get(status, 'OK')}",
+                "Content-Type: application/json",
+                f"Content-Length: {len(body)}"]
+        head.extend(extra_headers)
+        sock.sendall(("\r\n".join(head) + "\r\n\r\n").encode("ascii") + body)
+
+    def _serve_http(self, sock: socket.socket, peer: str | None) -> None:
+        """Minimal HTTP/1.1 framing over the same admission path, for
+        commodity load balancers: ``POST /query`` carries one protocol
+        line as its JSON body and returns the same answer document;
+        ``GET /healthz`` maps the health op to 200/503 by the solve
+        heartbeat's freshness. Overload answers 429 + ``Retry-After``.
+        Stdlib request-line + header parsing; keep-alive until the
+        client closes or sends ``Connection: close``."""
+        reader = sock.makefile("rb")
+        while True:
+            reqline = reader.readline(8192)
+            if not reqline or not reqline.strip():
+                return
+            try:
+                method, path, _version = (
+                    reqline.decode("ascii").split(None, 2))
+            except (UnicodeDecodeError, ValueError):
+                self._send_http(sock, 400, {"error": "bad request line"})
+                return
+            headers: dict[str, str] = {}
+            while True:
+                hline = reader.readline(8192)
+                if not hline or hline in (b"\r\n", b"\n"):
+                    break
+                if b":" in hline:
+                    k, v = hline.split(b":", 1)
+                    headers[k.strip().lower().decode("latin-1")] = (
+                        v.strip().decode("latin-1"))
+            try:
+                length = int(headers.get("content-length", "0"))
+            except ValueError:
+                self._send_http(sock, 400, {"error": "bad content-length"})
+                return
+            body = reader.read(length) if length > 0 else b""
+            if len(body) < length:
+                return  # truncated body: client went away mid-request
+            method = method.upper()
+            if method == "GET" and path in ("/healthz", "/health"):
+                doc = self.health()
+                hb = doc.get("heartbeat")
+                ok = doc["ok"] and (hb is None or hb.get("fresh", False))
+                self._send_http(sock, 200 if ok else 503, doc)
+            elif method == "POST" and path == "/query":
+                try:
+                    req = json.loads(body.decode("utf-8"))
+                    if not isinstance(req, dict):
+                        raise ValueError("body must be a JSON object")
+                except (UnicodeDecodeError, ValueError) as e:
+                    self.engine.note_failed_requests(1)
+                    self._send_http(sock, 400,
+                                    {"error": f"bad request line: {e}"})
+                else:
+                    resp = self._process_request(req, peer)
+                    status = self._http_status_for(resp)
+                    extra = []
+                    retry_ms = resp.get("retry_after_ms")
+                    if status in (429, 503) and retry_ms is not None:
+                        secs = max(1, (int(retry_ms) + 999) // 1000)
+                        extra.append(f"Retry-After: {secs}")
+                    self._send_http(sock, status, resp,
+                                    extra_headers=tuple(extra))
+            else:
+                self._send_http(sock, 404,
+                                {"error": f"no route {method} {path}"})
+            if headers.get("connection", "").lower() == "close":
+                return
 
 
 def write_final_snapshot(engine) -> None:

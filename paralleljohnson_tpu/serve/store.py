@@ -45,7 +45,11 @@ from pathlib import Path
 
 import numpy as np
 
-from paralleljohnson_tpu.utils.checkpoint import BatchCheckpointer, graph_digest
+from paralleljohnson_tpu.utils.checkpoint import (
+    MANIFEST_NAME,
+    BatchCheckpointer,
+    graph_digest,
+)
 
 # Tier capacities (rows). Hot is device memory — keep it a small working
 # set; warm is host RAM (a [V] f32 row at V=2^20 is 4 MB, so the default
@@ -114,6 +118,12 @@ class TileStore:
         self._manual_stale: "set[int] | str | None" = None
         self._stale_cache_key = None
         self._stale_cached: "set[int] | str | None" = None
+        # Live-fleet manifest watch (ISSUE 18): (path, mtime_ns, size)
+        # per backing manifest, captured at attach.
+        # refresh_cold_if_changed() compares against it with one stat()
+        # per manifest — called from the miss path only, so the hot
+        # path never touches the disk.
+        self._manifest_watch_key = self._manifest_key()
 
     # -- lookup --------------------------------------------------------------
 
@@ -220,8 +230,54 @@ class TileStore:
     def invalidate_cold_index(self) -> None:
         """Re-read the manifest on next cold lookup — call after a solver
         appended new batches to the backing directory."""
+        key = self._manifest_key()
         with self._lock:
             self._cold_index = None
+            # Our own commit is not "news": fold it into the watch key
+            # so the next refresh_cold_if_changed() only fires on a
+            # manifest some OTHER process has grown since.
+            self._manifest_watch_key = key
+
+    def _manifest_key(self):
+        """(path, mtime_ns, size) per backing manifest — the fleet
+        manifest AND the growth dir's batch manifest for sharded dirs,
+        just the batch manifest for plain checkpoint dirs."""
+        if self.ckpt is None:
+            return None
+        paths = {Path(self.ckpt.dir) / MANIFEST_NAME}
+        fleet_manifest = getattr(self.ckpt, "manifest_path", None)
+        if fleet_manifest is not None:
+            paths.add(Path(fleet_manifest))
+        key = []
+        for p in sorted(paths):
+            try:
+                st = p.stat()
+                key.append((str(p), st.st_mtime_ns, st.st_size))
+            except OSError:
+                key.append((str(p), None, None))
+        return tuple(key)
+
+    def refresh_cold_if_changed(self) -> bool:
+        """Live-fleet awareness (ISSUE 18): re-scan the backing
+        directory's manifests and drop the cold index iff some OTHER
+        process committed batches since attach (or since our own last
+        invalidate). One ``stat`` per manifest file — call from the
+        miss path, where a changed manifest can turn a scheduled solve
+        into a cold hit. Returns whether the cold tier GAINED sources —
+        a stat change alone is not news (the first cold lookup lazily
+        creates an empty manifest, and our own commits fold into the
+        watch key via :meth:`invalidate_cold_index`)."""
+        if self.ckpt is None:
+            return False
+        key = self._manifest_key()
+        with self._lock:
+            if key == self._manifest_watch_key:
+                return False
+            self._manifest_watch_key = key
+            old = set(self._cold_sources())
+            self._cold_index = None
+            new = set(self._cold_sources())
+            return bool(new - old)
 
     # -- device-tile view (ISSUE 16: the device-resident query path) ---------
 

@@ -203,6 +203,17 @@ def main(argv: list[str] | None = None) -> int:
                     f"roofline: {f['roofline_bound']}"
                 )
                 continue
+            if f.get("kind") == "reroute":
+                # Failover regression (ISSUE 18): the serve fleet left
+                # a killed replica's sources dark for longer — a
+                # robustness bug even when the bench wall looks fine.
+                print(
+                    f"  REGRESSION (reroute) {key}: "
+                    f"{f['reroute_lapse_s']:.2f}s kill-to-reroute vs "
+                    f"median {f['baseline_lapse_s']:.2f}s over "
+                    f"{f['history_n']} runs ({f['slowdown']:.2f}x)"
+                )
+                continue
             if f.get("kind") == "size":
                 # Hopset size regression (ISSUE 17): the shortcut set
                 # got fatter for the same shape bucket + knobs — every

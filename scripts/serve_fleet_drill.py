@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""serve-fleet: the replicated serve fleet's chaos drill (ISSUE 18).
+
+PR 15's chaos drill proved ONE front end degrades instead of dying.
+This drill proves the FLEET holds the same line when a whole replica
+disappears: three real ``pjtpu serve`` subprocesses register into a
+shared fleet directory via heartbeated membership records, an
+in-process :class:`FleetRouter` forwards concurrent socket clients to
+the consistent-hash owner, and mid-traffic one replica is SIGKILLed
+without ceremony. Assertions (all graded by
+:func:`paralleljohnson_tpu.benchmarks.bench_serve_fleet` — the bench IS
+the drill, so CI regression-grades the same numbers this script
+gates on):
+
+- the router re-publishes the routing table minus the corpse and the
+  dead replica's sources answer again within one heartbeat lapse
+  (``reroute_lapse_s`` under the ``stale_after + 2s`` budget);
+- the routing epoch advances monotonically across the failover and the
+  corpse owns nothing in the re-published table;
+- zero hung clients — every request gets exactly one response line or
+  an explicit admission error (``overloaded`` / ``unavailable`` / ...);
+- zero unflagged approximations, and every non-shed answer is verified
+  BITWISE against the direct solve's matrix (misrouted queries are only
+  colder, never wrong);
+- the surviving replicas' latency histograms merge into one
+  service-level SLO verdict (the ``pjtpu top --fleet-dir`` view) and
+  that merged verdict is in-SLO.
+
+Run standalone (CPU, seconds):  python scripts/serve_fleet_drill.py
+Staged in scripts/tpu_round3_run.sh as ``serve-fleet-drill``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--backend", default="numpy",
+                        help="solver backend for replicas + oracle "
+                             "(default: numpy — pure-CPU drill)")
+    parser.add_argument("--preset", default="smoke",
+                        choices=("smoke", "mini", "full"),
+                        help="bench size preset (default: smoke)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the full bench detail as JSON")
+    args = parser.parse_args()
+
+    from paralleljohnson_tpu.benchmarks import bench_serve_fleet
+
+    t0 = time.monotonic()
+    rec = bench_serve_fleet(args.backend, args.preset)
+    d = rec.detail
+    if args.as_json:
+        print(json.dumps(d, indent=1, default=str))
+    failures = d.get("failed") or []
+    for f in failures:
+        print("FAIL:", f)
+    if failures:
+        print(f"FAIL serve-fleet: {len(failures)} failures")
+        return 1
+    print(
+        f"PASS serve-fleet in {time.monotonic() - t0:.1f}s: "
+        f"{d['replicas']} replicas / {d['clients']} clients, "
+        f"1 SIGKILLed; re-routed in {d['reroute_lapse_s']}s "
+        f"(budget {d['reroute_budget_s']}s), "
+        f"epoch {d['epoch_before']} -> {d['epoch_after']}, "
+        f"{d['answered']} bitwise-exact answers "
+        f"({d['rejected']} rejected, {d['shed_answers']} shed), "
+        f"merged p99 {d['p99_ms']}±{d['p99_err_ms']} ms, "
+        f"fleet verdict {d['verdict']!r}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -198,6 +198,126 @@ def report_shed_events(path: Path, out=sys.stdout) -> None:
                   file=out)
 
 
+def report_fleet(root: Path, out=sys.stdout) -> None:
+    """Replicated-serve-fleet post-mortem (ISSUE 18): when membership
+    records sit under ``<fleet>/serve/replicas/*.json``, merge every
+    readable replica's latency histogram and SLO burn into ONE
+    service-level verdict — the offline twin of ``pjtpu top
+    --fleet-dir``. Torn records are flagged and skipped; stale records
+    (heartbeats that stopped) are flagged but still merged, because a
+    post-mortem reads dead fleets by construction. Geometry mismatches
+    degrade to a per-replica listing, never a crash."""
+    if not root.is_dir():
+        return
+    records: dict[Path, list[Path]] = {}
+    for p in sorted(root.rglob("*.json")):
+        if (p.parent.name == "replicas"
+                and p.parent.parent.name == "serve"):
+            records.setdefault(p.parent.parent.parent, []).append(p)
+    import time as _time
+
+    now = _time.time()
+    for fleet_dir, paths in sorted(records.items()):
+        rows = []
+        for p in paths:
+            try:
+                rec = json.loads(p.read_text(encoding="utf-8"))
+            except (OSError, ValueError):
+                rows.append({"replica_id": p.stem, "torn": True})
+                continue
+            if rec.get("kind") != "serve_replica":
+                continue
+            ts = rec.get("ts")
+            interval = rec.get("heartbeat_interval_s") or 1.0
+            age = (now - ts) if isinstance(ts, (int, float)) else None
+            rec["age_s"] = age
+            rec["stale"] = age is None or age > max(5.0, 5.0 * interval)
+            rows.append(rec)
+        if not rows:
+            continue
+        routing = None
+        rp = fleet_dir / "serve" / "routing.json"
+        try:
+            routing = json.loads(rp.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            pass
+        print(f"\n{fleet_dir} — serve fleet, {len(rows)} replica "
+              f"record(s)", file=out)
+        if routing:
+            print(f"  routing epoch {routing.get('epoch')}  vnodes "
+                  f"{routing.get('vnodes')}  members "
+                  f"{sorted((routing.get('replicas') or {}))}", file=out)
+        merged_hist = None
+        merge_error = None
+        bad = events = 0.0
+        burning = False
+        objective = None
+        merged_n = 0
+        for rec in sorted(rows, key=lambda r: str(r.get("replica_id"))):
+            rid = rec.get("replica_id")
+            if rec.get("torn"):
+                print(f"  replica {rid:<22} TORN record", file=out)
+                continue
+            flag = " STALE" if rec.get("stale") else ""
+            snap = rec.get("live") if isinstance(rec.get("live"), dict) \
+                else {}
+            hists = snap.get("histograms") or {}
+            h = hists.get("pjtpu_query_latency_ms") or {}
+            q = ((snap.get("counters") or {})
+                 .get("pjtpu_queries") or {}).get("total")
+            print(f"  replica {str(rid):<22} pid {rec.get('pid')}  "
+                  f"age {_fmt(rec.get('age_s'), 1)}s{flag}  "
+                  f"queries {_fmt(q, 0)}  "
+                  f"p99 {_fmt(h.get('p99_ms'))}"
+                  f"±{_fmt(h.get('p99_err_ms'))} ms", file=out)
+            state = h.get("hist")
+            if isinstance(state, dict):
+                try:
+                    part = live.LogHistogram.from_dict(state)
+                    if merged_hist is None:
+                        merged_hist = part
+                    else:
+                        merged_hist.merge(part)
+                    merged_n += 1
+                except (ValueError, TypeError, KeyError) as e:
+                    merge_error = f"{rid}: {e}"
+            s = (snap.get("slos") or {}).get("serve") or {}
+            bad += s.get("bad_total") or 0.0
+            events += s.get("events_total") or 0.0
+            burning = burning or bool(s.get("burning"))
+            objective = objective or s.get("objective")
+        if merge_error:
+            print(f"  merged: histogram geometry mismatch "
+                  f"({merge_error}) — per-replica rows above are the "
+                  f"report", file=out)
+            continue
+        if merged_hist is not None:
+            pct = merged_hist.percentiles((50, 99))
+            avail = (1.0 - bad / events) if events else None
+            target = (objective or {}).get("latency_ms")
+            lat_pct = (objective or {}).get("latency_pct") or 99.0
+            met = None
+            if target is not None:
+                m = merged_hist.percentile(lat_pct)
+                if m["value"] is not None:
+                    met = (True if m["upper"] is not None
+                           and m["upper"] <= target
+                           else False if m["lower"] is not None
+                           and m["lower"] > target
+                           else "within-error-bound")
+            verdict = ("BURNING" if burning
+                       else "degraded" if met is False else "ok")
+            print(f"  merged  {merged_n} replica histogram(s): "
+                  f"p50 {_fmt(pct.get('p50_ms'))}"
+                  f"±{_fmt(pct.get('p50_err_ms'))} ms  "
+                  f"p99 {_fmt(pct.get('p99_ms'))}"
+                  f"±{_fmt(pct.get('p99_err_ms'))} ms", file=out)
+            print(f"  service verdict: {verdict}  availability "
+                  f"{_fmt(avail, 5)} (bad {_fmt(bad, 0)}/"
+                  f"{_fmt(events, 0)})  p{_fmt(lat_pct, 0)} vs target "
+                  f"{_fmt(target)} ms -> {met}", file=out)
+
+
 def report_history(path: Path, out=sys.stdout) -> None:
     lines = live.read_history(path)
     if not lines:
@@ -282,6 +402,8 @@ def main(argv: list[str] | None = None) -> int:
     print(f"slo-report: {len(snaps)} snapshot(s) under {root}")
     for p in snaps:
         report_snapshot(p, _snapshot_payload(p))
+    # Fleet membership records (ISSUE 18): the merged service-level view.
+    report_fleet(root)
     histories = (
         sorted(root.rglob("*_history.jsonl")) if root.is_dir()
         else sorted(root.parent.glob("*_history.jsonl"))

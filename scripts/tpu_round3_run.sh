@@ -239,6 +239,23 @@ run 600 serve-chaos env JAX_PLATFORMS=cpu python scripts/serve_chaos_drill.py
 #       shedding disengaging in the cooldown phase
 run 900 jax-serve-overload python -m paralleljohnson_tpu.cli bench serve_overload --backend jax --preset full --update-baseline BASELINE.md
 
+# 4g''') the replicated-fleet chaos drill (ISSUE 18): three real
+#        `pjtpu serve` replicas heartbeat-registered into a shared
+#        fleet dir, a consistent-hash router forwarding K socket
+#        clients, one replica SIGKILLed mid-traffic — asserts the
+#        re-route lands within one heartbeat lapse, zero hung clients,
+#        bitwise-exact non-shed answers, a monotonic routing epoch, and
+#        an in-SLO merged fleet verdict. CPU replicas by design (they
+#        must never dial the single-tenant tunnel).
+run 600 serve-fleet-drill env JAX_PLATFORMS=cpu python scripts/serve_fleet_drill.py
+
+# 4g'''') the recorded serve-fleet bench row (ISSUE 18): the same
+#         drill at full preset with jax-backend replicas — the detail
+#         column carries reroute_lapse_s (regression-graded under the
+#         `reroute` axis: slower failover flags the gate), the merged
+#         p99 ± bound, and the fleet SLO verdict in-row
+run 900 jax-serve-fleet-bench python -m paralleljohnson_tpu.cli bench serve_fleet --backend jax --preset full --update-baseline BASELINE.md
+
 # 4h) dense-APSP blocked-FW bench row (round-13 tentpole): blocked
 #     min-plus Floyd-Warshall vs min-plus squaring on the same graph,
 #     BITWISE-checked (integer weights); the detail column must carry

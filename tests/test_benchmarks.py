@@ -10,16 +10,18 @@ from paralleljohnson_tpu import benchmarks
 
 
 # The dirty-window and planner-dispatch configs force-measure several
-# kernel schedules (compile-heavy) and serve_overload drives real
-# wall-clock overload/cooldown phases — their smoke rows ride the slow
-# set (suite-budget trims, ISSUE 13/14/15); each has dedicated slow
-# validation (tests/test_dirty_window.py, tests/test_planner.py,
-# test_serve_overload_contract below).
+# kernel schedules (compile-heavy), serve_overload drives real
+# wall-clock overload/cooldown phases, and serve_fleet spawns three
+# replica subprocesses plus a kill drill — their smoke rows ride the
+# slow set (suite-budget trims, ISSUE 13/14/15/18); each has dedicated
+# slow validation (tests/test_dirty_window.py, tests/test_planner.py,
+# test_serve_overload_contract below, tests/test_fleet_serve.py).
 @pytest.mark.parametrize(
     "name",
     [
         pytest.param(n, marks=pytest.mark.slow)
-        if n in ("dirty_window", "planner_dispatch", "serve_overload")
+        if n in ("dirty_window", "planner_dispatch", "serve_overload",
+                 "serve_fleet")
         else n
         for n in sorted(benchmarks.CONFIGS)
     ],
@@ -37,6 +39,12 @@ def test_config_smoke(name):
         assert line["detail"]["p99_ms"] >= line["detail"]["p50_ms"] > 0
     elif name == "serve_overload":
         assert "failed" not in line["detail"], line["detail"]["failed"]
+    elif name == "serve_fleet":
+        # The fleet row is graded in-bench (bitwise answers, reroute
+        # lapse, merged verdict); any violation lands in detail.failed.
+        assert "failed" not in line["detail"], line["detail"]["failed"]
+        assert line["detail"]["reroute_lapse_s"] is not None
+        assert line["detail"]["reroute_lapse_s"] <= line["detail"]["reroute_budget_s"]
     else:
         assert rec.edges_relaxed > 0
         assert line["edges_relaxed_per_sec_per_chip"] > 0

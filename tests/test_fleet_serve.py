@@ -1,0 +1,437 @@
+"""Replicated serve fleet (ISSUE 18): heartbeated membership records,
+consistent-hash routing, the forwarding router, and the merged fleet
+observability view.
+
+The routing invariants under churn are the point: removing one of N
+replicas moves ONLY the removed replica's sources (every survivor keeps
+every source it owned), the published epoch only ever advances, torn
+membership/routing files degrade instead of crashing, and a misrouted
+query still answers exactly — ownership is a cache-locality hint, never
+a correctness boundary. The real-subprocess SIGKILL drill rides the
+slow set (scripts/serve_fleet_drill.py is the full staged twin)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import SolverConfig
+from paralleljohnson_tpu.graphs import erdos_renyi, grid2d
+from paralleljohnson_tpu.observe.live import LogHistogram
+from paralleljohnson_tpu.observe.top import gather_ops, render_ops
+from paralleljohnson_tpu.serve import (
+    FleetRouter,
+    QueryEngine,
+    ReplicaRegistration,
+    RoutingTable,
+    ServeFrontend,
+    TileStore,
+    live_replicas,
+    publish_routing,
+    read_replicas,
+    read_routing,
+)
+from paralleljohnson_tpu.serve.fleet import replicas_dir, routing_path
+from paralleljohnson_tpu.solver import ParallelJohnsonSolver
+
+
+def _cfg(**kw) -> SolverConfig:
+    return SolverConfig(backend="numpy", **kw)
+
+
+def _members(rids, base_port=9000):
+    return {rid: {"host": "127.0.0.1", "port": base_port + i}
+            for i, rid in enumerate(rids)}
+
+
+# -- membership records -------------------------------------------------------
+
+
+def test_registration_record_beat_and_deregister(tmp_path):
+    reg = ReplicaRegistration(tmp_path, "r0", host="127.0.0.1", port=7070,
+                              graph_digest="abcd", interval_s=60.0)
+    reg.beat()
+    recs = read_replicas(tmp_path)
+    assert [r["replica_id"] for r in recs] == ["r0"]
+    assert recs[0]["port"] == 7070
+    assert recs[0]["graph_digest"] == "abcd"
+    assert recs[0]["stale"] is False
+    assert recs[0]["age_s"] is not None
+    ts1 = recs[0]["ts"]
+    reg.beat()
+    assert read_replicas(tmp_path)[0]["ts"] >= ts1
+    reg.stop(deregister=True)
+    assert read_replicas(tmp_path) == []
+
+
+def test_read_replicas_flags_stale_and_torn(tmp_path):
+    ReplicaRegistration(tmp_path, "fresh", host="h", port=1).beat()
+    stale = ReplicaRegistration(tmp_path, "old", host="h", port=2)
+    stale.beat()
+    # Rewind the stale record's ts far past the staleness horizon.
+    p = replicas_dir(tmp_path) / "old.json"
+    rec = json.loads(p.read_text())
+    rec["ts"] -= 3600.0
+    p.write_text(json.dumps(rec))
+    (replicas_dir(tmp_path) / "torn.json").write_text('{"kind": "serve_')
+    by_id = {r["replica_id"]: r for r in read_replicas(tmp_path)}
+    assert by_id["fresh"]["stale"] is False
+    assert by_id["old"]["stale"] is True
+    assert by_id["torn"]["torn"] is True and by_id["torn"]["stale"] is True
+    # live_replicas serves routing: only the fresh record qualifies.
+    assert [r["replica_id"] for r in live_replicas(tmp_path)] == ["fresh"]
+
+
+# -- consistent-hash routing --------------------------------------------------
+
+
+def test_routing_spreads_and_removal_moves_only_the_corpse(tmp_path):
+    rids = ["a", "b", "c", "d"]
+    table = RoutingTable(_members(rids), vnodes=64)
+    sources = [str(s) for s in range(3000)]
+    owners = {s: table.owner(s) for s in sources}
+    counts = {rid: sum(1 for o in owners.values() if o == rid)
+              for rid in rids}
+    # Balanced-ish: every replica owns a real share.
+    assert all(c > len(sources) * 0.1 for c in counts.values()), counts
+    # Remove one replica: the STRONG consistency claim — every source a
+    # survivor owned stays with that survivor; only "c"'s sources move.
+    survivors = RoutingTable(_members(["a", "b", "d"]), vnodes=64)
+    moved = 0
+    for s in sources:
+        if owners[s] == "c":
+            moved += 1
+            assert survivors.owner(s) != "c"
+        else:
+            assert survivors.owner(s) == owners[s], s
+    assert moved == counts["c"]
+    assert moved < len(sources) * 0.5  # ~1/N, never a wholesale reshuffle
+
+
+def test_routing_owner_hash_is_process_stable():
+    # blake2b, never Python hash(): two tables built independently agree.
+    t1 = RoutingTable(_members(["x", "y"]), vnodes=32)
+    t2 = RoutingTable(_members(["x", "y"]), vnodes=32)
+    assert [t1.owner(str(s)) for s in range(100)] == \
+        [t2.owner(str(s)) for s in range(100)]
+    assert RoutingTable({}).owner("5") is None
+
+
+def test_publish_routing_epoch_monotonic_and_round_trips(tmp_path):
+    t1 = publish_routing(tmp_path, _members(["a", "b"]))
+    t2 = publish_routing(tmp_path, _members(["a"]))
+    assert t2.epoch > t1.epoch
+    got = read_routing(tmp_path)
+    assert got.epoch == t2.epoch
+    assert got.address("a") == ("127.0.0.1", 9000)
+    assert got.owner("7") == "a"
+    # min_epoch lets a router fence off a stale table it already beat.
+    t3 = publish_routing(tmp_path, _members(["a", "b"]), min_epoch=50)
+    assert t3.epoch == 50
+
+
+def test_torn_routing_json_reads_as_none(tmp_path):
+    publish_routing(tmp_path, _members(["a"]))
+    routing_path(tmp_path).write_text('{"kind": "serve_routing", "ep')
+    assert read_routing(tmp_path) is None  # degrade, never raise
+
+
+# -- the forwarding router ----------------------------------------------------
+
+
+def _replica_world(tmp_path, name, g, fleet_dir, exact_warm):
+    store = TileStore(tmp_path / name, g, warm_rows=g.num_nodes)
+    engine = QueryEngine(g, store, config=_cfg(), stats_interval_s=0)
+    engine.warm(exact_warm)
+    fe = ServeFrontend(engine, shed_policy="reject", fleet_dir=fleet_dir,
+                       replica_id=name, fleet_heartbeat_s=0.2).start()
+    return fe
+
+
+class _LineClient:
+    def __init__(self, addr, timeout=30.0):
+        self.sock = socket.create_connection(addr, timeout=timeout)
+        self.sock.settimeout(timeout)
+        self.f = self.sock.makefile("rw", encoding="utf-8", newline="\n")
+        self.header = json.loads(self.f.readline())
+
+    def ask(self, req: dict) -> dict:
+        self.f.write(json.dumps(req) + "\n")
+        self.f.flush()
+        return json.loads(self.f.readline())
+
+    def close(self):
+        self.f.close()
+        self.sock.close()
+
+
+def test_router_forwards_and_misroute_is_only_colder(tmp_path):
+    g = grid2d(5, 5, seed=0)
+    n = g.num_nodes
+    exact = np.asarray(ParallelJohnsonSolver(_cfg()).solve(g).matrix)
+    fleet = tmp_path / "fleet"
+    fes = [_replica_world(tmp_path, f"rep-{i}", g, fleet, np.arange(n))
+           for i in range(2)]
+    router = None
+    try:
+        router = FleetRouter(fleet, stale_after_s=5.0,
+                             refresh_interval_s=0.1).start()
+        c = _LineClient(router.address())
+        assert c.header["router"] is True
+        assert c.header["protocol"] == "pjtpu-serve/1"
+        table = router.table
+        for s in range(0, n, 3):
+            r = c.ask({"id": s, "source": s, "dst": (s * 7) % n})
+            assert r.get("error") is None, r
+            assert r["exact"] is True
+            assert float(r["distance"]) == float(exact[s, (s * 7) % n])
+        # health through the router aggregates the fleet.
+        h = c.ask({"op": "health"})
+        assert h["router"] is True and h["replicas_live"] == 2
+        c.close()
+        # Misroute on purpose: ask the replica that does NOT own source
+        # 0 directly. Ownership is a locality hint — the answer must be
+        # byte-identical anyway.
+        owner = table.owner("0")
+        non_owner = next(fe for fe in fes if fe.replica_id != owner)
+        d = _LineClient(non_owner.address)
+        r = d.ask({"id": "mis", "source": 0, "dst": n - 1})
+        assert r["exact"] is True
+        assert float(r["distance"]) == float(exact[0, n - 1])
+        d.close()
+    finally:
+        if router is not None:
+            router.drain()
+        for fe in fes:
+            fe.drain()
+
+
+def test_router_with_empty_fleet_returns_unavailable(tmp_path):
+    router = FleetRouter(tmp_path / "nobody", stale_after_s=1.0,
+                         max_attempts=2, retry_after_ms=7).start()
+    try:
+        c = _LineClient(router.address())
+        r = c.ask({"id": 1, "source": 0, "dst": 1})
+        assert r["error"] == "unavailable"
+        assert r["retry_after_ms"] == 7
+        c.close()
+    finally:
+        router.drain()
+
+
+@pytest.mark.slow  # real subprocesses + SIGKILL (the drill's CPU twin)
+def test_router_survives_sigkill_of_owner(tmp_path):
+    rows = 6
+    g = grid2d(rows, rows, negative_fraction=0.0, seed=0)
+    n = g.num_nodes
+    exact = np.asarray(ParallelJohnsonSolver(_cfg()).solve(g).matrix)
+    fleet = tmp_path / "fleet"
+    store_dir = tmp_path / "store"
+    seed_store = TileStore(store_dir, g, warm_rows=n)
+    seed_engine = QueryEngine(g, seed_store, config=_cfg(),
+                              stats_interval_s=0)
+    seed_engine.warm(np.arange(n))
+    seed_engine.close()
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(Path(__file__).resolve().parents[1]),
+                    env.get("PYTHONPATH")) if p)
+    procs = []
+    router = None
+    try:
+        for i in range(2):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "paralleljohnson_tpu.cli", "serve",
+                 f"grid:rows={rows},cols={rows}",
+                 "--listen", "127.0.0.1:0", "--store-dir", str(store_dir),
+                 "--backend", "numpy", "--fleet-dir", str(fleet),
+                 "--replica-id", f"kill-{i}", "--replica-heartbeat", "0.2"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True)
+            json.loads(p.stdout.readline())  # announce
+            procs.append(p)
+        router = FleetRouter(fleet, stale_after_s=1.5,
+                             refresh_interval_s=0.1).start()
+        epoch_before = router.table.epoch
+        victim_rid = router.table.owner("0")
+        victim = procs[int(victim_rid.rsplit("-", 1)[1])]
+        c = _LineClient(router.address())
+        r = c.ask({"id": 0, "source": 0, "dst": 1})
+        assert float(r["distance"]) == float(exact[0, 1])
+        c.close()
+
+        victim.send_signal(signal.SIGKILL)
+        victim.wait()
+        t_kill = time.monotonic()
+        answered = None
+        while time.monotonic() - t_kill < 10.0:
+            try:
+                c = _LineClient(router.address(), timeout=5)
+                r = c.ask({"id": 1, "source": 0, "dst": 1})
+                c.close()
+                if r.get("error") is None:
+                    answered = r
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.05)
+        lapse = time.monotonic() - t_kill
+        assert answered is not None, "dead replica's sources never re-routed"
+        assert float(answered["distance"]) == float(exact[0, 1])
+        assert lapse < 1.5 + 2.0, f"re-route took {lapse:.2f}s"
+        # The re-published table advanced past the corpse.
+        after = read_routing(fleet)
+        assert after.epoch > epoch_before
+        assert all(after.owner(str(s)) != victim_rid for s in range(n))
+    finally:
+        if router is not None:
+            router.drain()
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+# -- merged fleet observability ----------------------------------------------
+
+
+def _fleet_record(fleet_dir, rid, hist, *, stale=False, slo=None):
+    """A membership record carrying a live snapshot, the shape the
+    frontend's heartbeat payload_fn publishes."""
+    reg = ReplicaRegistration(fleet_dir, rid, host="127.0.0.1", port=1234)
+    reg.beat()
+    p = replicas_dir(fleet_dir) / f"{rid}.json"
+    rec = json.loads(p.read_text())
+    rec["live"] = {
+        "kind": "live_metrics",
+        "counters": {"pjtpu_queries": {"total": hist.count}},
+        "histograms": {"pjtpu_query_latency_ms": hist.summary()},
+        "slos": {"serve": slo or {
+            "burning": False, "bad_total": 0.0,
+            "events_total": float(hist.count),
+            "objective": {"latency_ms": 10_000.0, "latency_pct": 99.0},
+        }},
+    }
+    if stale:
+        rec["ts"] -= 3600.0
+    p.write_text(json.dumps(rec))
+
+
+def test_fleet_merge_matches_pooled_sample_oracle(tmp_path):
+    rng = np.random.default_rng(7)
+    s1 = rng.lognormal(0.0, 1.0, 4000)
+    s2 = rng.lognormal(1.0, 0.7, 4000)
+    h1, h2 = LogHistogram(), LogHistogram()
+    h1.record_many(s1)
+    h2.record_many(s2)
+    _fleet_record(tmp_path, "r1", h1)
+    _fleet_record(tmp_path, "r2", h2)
+    publish_routing(tmp_path, _members(["r1", "r2"]))
+    doc = gather_ops(serve_fleet=tmp_path)
+    sf = doc["serve_fleet"]
+    merged = sf["merged"]
+    assert merged.get("histogram_merge_error") is None
+    assert sf["routing"]["epoch"] == 1
+    assert sorted(sf["replicas"]) == ["r1", "r2"]
+    # The merged estimate must land within its own one-bucket bound of
+    # the pooled-sample oracle — exactly what a pooled histogram of all
+    # 8000 samples would certify.
+    pooled = np.concatenate([s1, s2])
+    for p in (50, 99):
+        oracle = float(np.percentile(pooled, p,
+                                     method="inverted_cdf"))
+        est = merged[f"p{p}_ms"]
+        err = merged[f"p{p}_err_ms"]
+        assert abs(est - oracle) <= err + 1e-9, (p, est, oracle, err)
+    assert merged["verdict"] == "ok"
+    # Render path never chokes on the fleet document.
+    assert "SERVE-FLEET" in render_ops(doc)
+
+
+def test_fleet_merge_geometry_guard_degrades(tmp_path):
+    h1 = LogHistogram()
+    h2 = LogHistogram(lo=0.5, hi=100.0, growth=2.0)  # mismatched bins
+    h1.record_many([1.0, 2.0, 3.0])
+    h2.record_many([1.0, 2.0, 3.0])
+    _fleet_record(tmp_path, "r1", h1)
+    _fleet_record(tmp_path, "r2", h2)
+    doc = gather_ops(serve_fleet=tmp_path)
+    merged = doc["serve_fleet"]["merged"]
+    assert "different geometry" in merged["histogram_merge_error"]
+    assert merged.get("p99_ms") is None
+    render_ops(doc)  # geometry guard renders, never crashes
+
+
+def test_fleet_view_flags_dead_replica_and_excludes_it(tmp_path):
+    h1, h2 = LogHistogram(), LogHistogram()
+    h1.record_many([1.0] * 10)
+    h2.record_many([500.0] * 10)
+    _fleet_record(tmp_path, "alive", h1)
+    _fleet_record(tmp_path, "dead", h2, stale=True)
+    (replicas_dir(tmp_path) / "torn.json").write_text("{nope")
+    doc = gather_ops(serve_fleet=tmp_path)
+    sf = doc["serve_fleet"]
+    assert sf["replicas"]["dead"]["stale"] is True
+    assert sf["replicas"]["torn"]["torn"] is True
+    assert sf["merged"]["replicas_live"] == 1
+    # The dead replica's 500 ms tail must NOT pollute the merged view.
+    assert sf["merged"]["p99_ms"] < 100.0
+    out = render_ops(doc)
+    assert "STALE" in out or "stale" in out
+
+
+def test_top_cli_fleet_absent_dir_never_crashes(tmp_path, capsys):
+    from paralleljohnson_tpu.cli import main
+
+    rc = main(["top", "--fleet-dir", str(tmp_path / "nothing"),
+               "--once", "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    sf = doc["serve_fleet"]
+    assert sf["replicas"] == {}
+    assert sf["merged"]["verdict"] == "no-replicas"
+
+
+# -- live-fleet miss path (satellite 3) --------------------------------------
+
+
+def test_second_process_commit_turns_miss_into_cold_hit(tmp_path):
+    g = erdos_renyi(24, 0.2, seed=5)
+    n = g.num_nodes
+    store = TileStore(tmp_path / "shared", g, warm_rows=4)
+    engine = QueryEngine(g, store, config=_cfg(), stats_interval_s=0)
+    engine.warm([0, 1])
+    scheduled_before = engine.stats.batches_scheduled
+
+    # "Another replica" commits sources 5..9 into the SAME checkpoint
+    # dir — a separate TileStore over a separate engine, the way a
+    # fleet peer would.
+    peer_store = TileStore(tmp_path / "shared", g, warm_rows=4)
+    peer = QueryEngine(g, peer_store, config=_cfg(), stats_interval_s=0)
+    peer.warm([5, 6, 7, 8, 9])
+    peer.close()
+
+    # The next would-be miss re-scans the manifest first: cold hit, no
+    # scheduled solve.
+    resp = engine.query_batch([{"source": 5, "dst": 3}])[0]
+    assert resp["exact"] is True
+    assert engine.stats.batches_scheduled == scheduled_before
+    # A genuinely unsolved source still schedules (the re-scan is a
+    # freshness check, not a suppressor).
+    engine.query_batch([{"source": 15, "dst": 3}])
+    assert engine.stats.batches_scheduled == scheduled_before + 1
+    exact = np.asarray(ParallelJohnsonSolver(_cfg()).solve(g).matrix)
+    assert float(resp["distance"]) == float(exact[5, 3])
+    engine.close()

@@ -474,6 +474,246 @@ def test_drain_is_idempotent_and_closes_engine(tmp_path):
     assert live["kind"] == "live_metrics"
 
 
+# -- per-client fairness (ISSUE 18 satellite) --------------------------------
+
+
+def test_per_client_cap_limits_the_hog_not_the_polite(tmp_path):
+    # One global pool of 4 slots, one slot per client key: the hog's
+    # second in-flight request is rejected with client_limited while a
+    # polite client flows through untouched — admission fairness, not
+    # first-come-first-starve.
+    plan = FaultPlan([Fault(stage="serve_lookup", kind="slow_ms",
+                            attempt=1, slow_ms=500.0)])
+    _, engine, fe = _world(tmp_path, config=_cfg(fault_plan=plan),
+                           max_inflight=4, max_inflight_per_client=1)
+    try:
+        hog_a, hog_b, polite = _Client(fe), _Client(fe), _Client(fe)
+        hog_a.send({"id": "h1", "source": 1, "dst": 2,
+                    "client_id": "hog"})
+        time.sleep(0.15)  # the stall holds hog's one per-key slot
+        rb = hog_b.ask({"id": "h2", "source": 3, "dst": 4,
+                        "client_id": "hog"})
+        assert rb["error"] == "overloaded"
+        assert rb["client_limited"] is True
+        assert rb["reason"] == "max_inflight_per_client"
+        assert rb["retry_after_ms"] > 0
+        # The polite client's slot is its own: global capacity remains.
+        rp = polite.ask({"id": "p", "source": 5, "dst": 6,
+                         "client_id": "polite"})
+        assert rp.get("error") is None and rp["exact"] is True
+        assert hog_a.recv()["exact"] is True  # the hog's first completes
+        assert engine.stats.client_limited == 1
+        assert engine.stats.rejected == 0  # the global bound never bit
+        snap = engine.metrics.snapshot()
+        assert snap["counters"]["pjtpu_client_limited"]["total"] == 1
+        for c in (hog_a, hog_b, polite):
+            c.close()
+    finally:
+        fe.drain()
+
+
+def test_per_client_cap_falls_back_to_peer_address(tmp_path):
+    # No client_id: the key is the peer address, so two connections
+    # from the same host share one per-key slot.
+    plan = FaultPlan([Fault(stage="serve_lookup", kind="slow_ms",
+                            attempt=1, slow_ms=500.0)])
+    _, engine, fe = _world(tmp_path, config=_cfg(fault_plan=plan),
+                           max_inflight=4, max_inflight_per_client=1)
+    try:
+        ca, cb = _Client(fe), _Client(fe)
+        ca.send({"id": "a", "source": 1, "dst": 2})
+        time.sleep(0.15)
+        rb = cb.ask({"id": "b", "source": 3, "dst": 4})
+        assert rb["error"] == "overloaded"
+        assert rb["client_limited"] is True
+        assert ca.recv()["exact"] is True
+        ca.close()
+        cb.close()
+    finally:
+        fe.drain()
+
+
+def test_client_limited_counter_rides_the_prom_table():
+    from paralleljohnson_tpu.serve import SERVE_PROM_METRICS
+
+    names = [m[0] for m in SERVE_PROM_METRICS]
+    assert "pjtpu_client_limited_total" in names
+
+
+def test_per_client_two_client_hammer_no_starvation(tmp_path):
+    # Concurrent hammer: the hog floods from many sockets under one
+    # client_id while the polite client paces single requests. Every
+    # polite request must answer exactly — zero starvation — and every
+    # hog rejection is the flagged client_limited kind.
+    _, engine, fe = _world(tmp_path, max_inflight=2,
+                           max_inflight_per_client=1)
+    try:
+        stop = threading.Event()
+        hog_answers, hog_limited, hog_other = [], [], []
+
+        def hog(k):
+            c = _Client(fe)
+            i = 0
+            while not stop.is_set():
+                r = c.ask({"id": f"hog-{k}-{i}", "source": 1, "dst": 2,
+                           "client_id": "hog"})
+                if r.get("error") is None:
+                    hog_answers.append(r)
+                elif r.get("client_limited"):
+                    hog_limited.append(r)
+                else:
+                    hog_other.append(r)
+                i += 1
+
+        threads = [threading.Thread(target=hog, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        polite = _Client(fe)
+        polite_ok = 0
+        for i in range(25):
+            r = polite.ask({"id": f"p-{i}", "source": 3, "dst": 4,
+                            "client_id": "polite"})
+            assert r.get("error") is None, f"polite starved at {i}: {r}"
+            assert r["exact"] is True
+            polite_ok += 1
+        stop.set()
+        for t in threads:
+            t.join()
+        polite.close()
+        assert polite_ok == 25
+        assert hog_limited, "the hammer never hit the per-client cap"
+        assert engine.stats.client_limited == len(hog_limited)
+        # Global admission may also have bitten, but nothing unflagged.
+        assert all(r["error"] == "overloaded" for r in hog_other)
+    finally:
+        fe.drain()
+
+
+# -- HTTP adaptation (ISSUE 18 satellite) ------------------------------------
+
+
+def _http(fe, method, path, body=None, timeout=30.0):
+    import http.client
+
+    conn = http.client.HTTPConnection(*fe.address, timeout=timeout)
+    payload = json.dumps(body) if body is not None else None
+    conn.request(method, path, body=payload,
+                 headers={"Content-Type": "application/json"}
+                 if payload else {})
+    resp = conn.getresponse()
+    doc = json.loads(resp.read() or b"{}")
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, doc, headers
+
+
+def test_http_query_healthz_and_404(tmp_path):
+    g, engine, fe = _world(tmp_path, http=True)
+    exact = np.asarray(ParallelJohnsonSolver(_cfg()).solve(g).matrix)
+    try:
+        status, doc, _ = _http(fe, "POST", "/query",
+                               {"id": "q1", "source": 3, "dst": 9})
+        assert status == 200
+        assert doc["exact"] is True
+        assert doc["distance"] == float(exact[3, 9])
+        status, doc, _ = _http(fe, "GET", "/healthz")
+        assert status == 200 and doc["ok"] is True
+        status, doc, _ = _http(fe, "GET", "/nope")
+        assert status == 404
+        # A malformed body is a 400, not a dropped connection.
+        import http.client
+        conn = http.client.HTTPConnection(*fe.address, timeout=10)
+        conn.request("POST", "/query", body="not json",
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 400
+        conn.close()
+    finally:
+        fe.drain()
+
+
+def test_http_frontend_still_speaks_line_protocol(tmp_path):
+    """An ``--http`` replica must still serve ``pjtpu-serve/1`` traffic
+    — the fleet router forwards line-protocol regardless of a replica's
+    HTTP flag, so the listener sniffs per connection: HTTP clients send
+    a method token first, line clients wait for the server header."""
+    g, _, fe = _world(tmp_path, http=True)
+    exact = np.asarray(ParallelJohnsonSolver(_cfg()).solve(g).matrix)
+    try:
+        c = _Client(fe)
+        assert c.header["protocol"] == "pjtpu-serve/1"
+        r = c.ask({"id": 1, "source": 3, "dst": 9})
+        assert r["exact"] is True
+        assert r["distance"] == float(exact[3, 9])
+        c.close()
+        # ...and the same listener still answers HTTP afterwards.
+        status, doc, _ = _http(fe, "POST", "/query",
+                               {"id": "q2", "source": 3, "dst": 9})
+        assert status == 200 and doc["distance"] == float(exact[3, 9])
+    finally:
+        fe.drain()
+
+
+def test_http_keepalive_two_queries_one_connection(tmp_path):
+    import http.client
+
+    g, _, fe = _world(tmp_path, http=True)
+    exact = np.asarray(ParallelJohnsonSolver(_cfg()).solve(g).matrix)
+    try:
+        conn = http.client.HTTPConnection(*fe.address, timeout=30)
+        for s, t in [(1, 8), (2, 12)]:
+            conn.request("POST", "/query",
+                         body=json.dumps({"source": s, "dst": t}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            doc = json.loads(resp.read())
+            assert doc["distance"] == float(exact[s, t])
+        conn.close()
+    finally:
+        fe.drain()
+
+
+def test_http_overload_maps_429_with_retry_after(tmp_path):
+    plan = FaultPlan([Fault(stage="serve_lookup", kind="slow_ms",
+                            attempt=1, slow_ms=600.0)])
+    _, engine, fe = _world(tmp_path, config=_cfg(fault_plan=plan),
+                           max_inflight=1, http=True)
+    try:
+        slow_result = {}
+
+        def slow():
+            slow_result["resp"] = _http(
+                fe, "POST", "/query", {"id": "slow", "source": 1,
+                                       "dst": 2})
+
+        t = threading.Thread(target=slow)
+        t.start()
+        time.sleep(0.2)  # the stall occupies the one in-flight slot
+        status, doc, headers = _http(fe, "POST", "/query",
+                                     {"id": "fast", "source": 3,
+                                      "dst": 4})
+        assert status == 429
+        assert doc["error"] == "overloaded"
+        assert int(headers["Retry-After"]) >= 1
+        t.join()
+        assert slow_result["resp"][0] == 200  # the slow one completed
+    finally:
+        fe.drain()
+
+
+def test_http_healthz_503_on_stale_heartbeat(tmp_path):
+    hb = tmp_path / "hb.json"
+    hb.write_text(json.dumps({"ts": 123.0, "stage": "dead"}))  # ancient
+    _, _, fe = _world(tmp_path, http=True, heartbeat_file=hb)
+    try:
+        status, doc, _ = _http(fe, "GET", "/healthz")
+        assert status == 503
+        assert doc["heartbeat"]["fresh"] is False
+    finally:
+        fe.drain()
+
+
 # -- real signals / subprocesses (slow set; chaos drill is the full twin) ----
 
 
