@@ -3279,6 +3279,7 @@ FANOUT_PLANS = [
         forced=lambda cfg: cfg.fw is True,
         failure=_fail_fw,
         force_overrides={"fw": True, "mesh_shape": (1,)},
+        tunables=("fw_tile",),
     ),
     planner.Plan(
         name="vm-blocked+dw", entry="fanout", priority=40,
@@ -3437,6 +3438,7 @@ SSSP_PLANS = [
         price_routes=("bucket", "bucket+sweep"),
         forced=lambda cfg: cfg.bucket is True,
         force_overrides={"bucket": True},
+        tunables=("delta",),
     ),
     planner.Plan(
         name="gs", entry="sssp", priority=40,

@@ -93,6 +93,15 @@ _SIZES = {
                           rscale=7,    mini_rscale=9,    full_rscale=12,
                           dense_n=64,  mini_dense_n=128, full_dense_n=256,
                           sources=4,   mini_sources=4,   full_sources=8),
+    # mini/full sit past the 512 seed tile so the pad-to-V challenger
+    # wins a single-block FW pass vs the seed's 2x2 blocked sweep —
+    # the promotion the acceptance demands; smoke stays below it and
+    # demonstrates the no-promotion-within-band rule instead.
+    "planner_tuning": dict(n=256,      mini_n=576,       full_n=640,
+                          probe_s=30.0, mini_probe_s=45.0,
+                          full_probe_s=90.0,
+                          bucket_s=120.0, mini_bucket_s=180.0,
+                          full_bucket_s=360.0),
     "serve_queries": dict(n=256,       mini_n=1024,      full_n=4096,
                           queries=200, mini_queries=2000, full_queries=20000,
                           clients=16,  mini_clients=16,  full_clients=32),
@@ -714,6 +723,160 @@ def bench_planner_dispatch(backend: str, preset: str) -> BenchRecord:
         total_edges, total_edges / max(total_wall, 1e-9), _n_chips(),
         {"noise_band": PLANNER_NOISE_BAND, **verdict,
          "graphs": per_graph, **_routes(headline_res)},
+    )
+
+
+def bench_planner_tuning(backend: str, preset: str) -> BenchRecord:
+    """Config 17 (ISSUE 19 tentpole): the self-proposing planner's
+    propose → probe-under-budget → promote → dispatch loop, measured on
+    one dense graph (FW territory) with the ``fw_tile`` knob. Two
+    phases, graded in-bench (violations land in ``detail.failed``):
+
+    - **zero budget**: ``tune_bucket`` with ``bucket_budget_s=0`` must
+      touch nothing — the store stays empty and the auto dispatch is
+      BITWISE-identical to today's store-less dispatch (the acceptance
+      criterion that a disabled tuner changes no behavior);
+    - **budgeted**: the tuner probes the hand-tuned seed tile against
+      the pad-to-V tile under a hard per-probe wall cap, promotes the
+      winner only past the planner's 25% noise band, and the next auto
+      dispatch resolves the promoted value — verified bitwise against
+      a run with that tile forced, with ``provenance_table`` reporting
+      the knob as tuner-backed.
+
+    Non-jax backends have no tuner registry; their row records the
+    plain solve with an explicit marker."""
+    import tempfile
+
+    from paralleljohnson_tpu.graphs import erdos_renyi
+
+    n = _sz("planner_tuning", "n", preset)
+    probe_s = _sz("planner_tuning", "probe_s", preset)
+    bucket_s = _sz("planner_tuning", "bucket_s", preset)
+    g = erdos_renyi(n, 0.3, seed=3)
+
+    if backend != "jax":
+        t0 = time.perf_counter()
+        res = _solver(backend).solve(g)
+        wall = time.perf_counter() - t0
+        return BenchRecord(
+            "planner_tuning", backend, preset, wall,
+            res.stats.edges_relaxed, res.stats.edges_relaxed / wall,
+            _n_chips(),
+            {"skipped": "tuner registry is jax-only; plain solve "
+                        "recorded", **_routes(res)},
+        )
+
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.observe.tuning import (
+        DEFAULT_FW_TILE,
+        TUNE_NOISE_BAND,
+        resolve_param,
+    )
+    from paralleljohnson_tpu.tuner import provenance_table, tune_bucket
+
+    pad = ((n + 127) // 128) * 128
+    candidates = {"fw_tile": sorted({DEFAULT_FW_TILE, pad})}
+    failed = []
+    fw_cfg = dict(fw=True, mesh_shape=(1,))
+
+    # Phase A — zero tuning budget must be a perfect no-op.
+    store_a = tempfile.mkdtemp(prefix="pj_tune_zero_")
+    summary_a = tune_bucket(
+        g, store_dir=store_a, config=SolverConfig(backend=backend),
+        knobs=["fw_tile"], candidates=candidates,
+        probe_budget_s=probe_s, bucket_budget_s=0.0,
+    )
+    store_untouched = not (Path(store_a) / "profiles.jsonl").exists()
+    if summary_a.get("probes", -1) != 0 or not store_untouched:
+        failed.append("zero-budget tuner touched the store")
+    plain = _solver(backend, profile_store=None, **fw_cfg).solve(g)
+    with_store = _solver(backend, profile_store=store_a, **fw_cfg).solve(g)
+    zero_bitwise = bool(np.array_equal(
+        np.asarray(plain.dist), np.asarray(with_store.dist),
+        equal_nan=True,
+    ))
+    if not zero_bitwise:
+        failed.append("zero-budget dispatch diverged from store-less")
+
+    # Phase B — budgeted probes, band-gated promotion, auto dispatch.
+    store_b = tempfile.mkdtemp(prefix="pj_tune_probe_")
+    t0 = time.perf_counter()
+    summary_b = tune_bucket(
+        g, store_dir=store_b,
+        config=SolverConfig(backend=backend, profile_store=store_b),
+        knobs=["fw_tile"], candidates=candidates,
+        probe_budget_s=probe_s, bucket_budget_s=bucket_s,
+    )
+    tune_wall = time.perf_counter() - t0
+    knob = summary_b["knobs"].get("fw_tile", {})
+    eff_tile, eff_source = resolve_param(
+        "fw_tile", None, DEFAULT_FW_TILE,
+        config=SolverConfig(backend=backend, profile_store=store_b),
+        platform=_platform(), num_nodes=g.num_nodes,
+        num_edges=g.num_real_edges,
+        validate=lambda t: isinstance(t, int) and t >= 128 and t % 128 == 0,
+    )
+    if knob.get("promoted") and eff_tile != knob.get("winner"):
+        failed.append(
+            f"dispatch resolved tile {eff_tile}, tuner promoted "
+            f"{knob.get('winner')}"
+        )
+    prov = {
+        row["knob"]: row for row in provenance_table(
+            store_dir=store_b, num_nodes=g.num_nodes,
+            num_edges=g.num_real_edges,
+            config=SolverConfig(backend=backend, profile_store=store_b),
+        )
+    }.get("fw_tile", {})
+    if knob.get("promoted") and prov.get("source") != "tuner-promoted":
+        failed.append(
+            f"provenance says {prov.get('source')!r} for a promoted knob"
+        )
+
+    auto = _solver(backend, profile_store=store_b, **fw_cfg)
+    auto.solve(g)  # warm compiles on the resolved tile
+    t0 = time.perf_counter()
+    res = auto.solve(g)
+    dispatch_wall = time.perf_counter() - t0
+    forced = _solver(
+        backend, profile_store=None, fw_tile=int(eff_tile), **fw_cfg
+    ).solve(g)
+    dispatch_bitwise = bool(np.array_equal(
+        np.asarray(res.dist), np.asarray(forced.dist), equal_nan=True,
+    ))
+    if not dispatch_bitwise:
+        failed.append("auto dispatch diverged from forced tuned tile")
+
+    total_wall = tune_wall + dispatch_wall
+    detail = {
+        "noise_band": TUNE_NOISE_BAND,
+        "zero_budget": {
+            "summary": summary_a, "store_untouched": store_untouched,
+            "bitwise_vs_storeless": zero_bitwise,
+        },
+        "tuning": {
+            "probes": summary_b.get("probes"),
+            "censored": summary_b.get("censored"),
+            "probe_budget_s": probe_s,
+            "bucket_budget_s": bucket_s,
+            "tune_wall_s": round(tune_wall, 4),
+            "fw_tile": knob,
+        },
+        "provenance": prov,
+        "dispatch": {
+            "tile": int(eff_tile), "source": eff_source,
+            "bitwise_vs_forced": dispatch_bitwise,
+            "wall_ms": round(dispatch_wall * 1e3, 3),
+        },
+        **_routes(res),
+    }
+    if failed:
+        detail["failed"] = "; ".join(failed)
+    return BenchRecord(
+        "planner_tuning", backend, preset, total_wall,
+        res.stats.edges_relaxed,
+        res.stats.edges_relaxed / max(total_wall, 1e-9), _n_chips(),
+        detail,
     )
 
 
@@ -1992,6 +2155,7 @@ CONFIGS: dict[str, Callable[[str, str], BenchRecord]] = {
     "dense_apsp_fw": bench_dense_apsp_fw,
     "dirty_window": bench_dirty_window,
     "planner_dispatch": bench_planner_dispatch,
+    "planner_tuning": bench_planner_tuning,
     "serve_queries": bench_serve_queries,
     "serve_overload": bench_serve_overload,
     "serve_fleet": bench_serve_fleet,

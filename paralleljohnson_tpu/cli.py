@@ -718,6 +718,13 @@ def main(argv: list[str] | None = None) -> int:
                          help="router/top: eject replicas whose "
                               "membership record is older than this "
                               "(default: 5)")
+    p_serve.add_argument("--tune-dir", default=None, metavar="DIR",
+                         help="idle-capacity tuning (ISSUE 19): while "
+                              "the replica has no open connections it "
+                              "drains probe leases, one at a time, from "
+                              "this tuning-fleet directory (planned by "
+                              "pjtpu tune --fleet-dir); serving traffic "
+                              "always preempts the next claim")
     _add_common(p_serve)
 
     p_top = sub.add_parser(
@@ -786,7 +793,61 @@ def main(argv: list[str] | None = None) -> int:
     p_update.add_argument("--fleet-workers", type=int, default=2,
                           help="worker claim loops for --fleet-dir "
                                "(default 2)")
+    p_update.add_argument("--strategy", default="auto",
+                          choices=["auto", "repair", "resolve"],
+                          help="repair-vs-resolve policy (ISSUE 19): "
+                               "auto prices the dirty-part repair "
+                               "against a full re-solve from learned "
+                               "profile records and picks the cheaper "
+                               "(unpriced: repair, the old behavior); "
+                               "repair/resolve force one side")
     _add_common(p_update)
+
+    p_tune = sub.add_parser(
+        "tune",
+        help="self-proposing planner (README 'Self-proposing planner'): "
+             "probe candidate values of every declared tunable knob "
+             "under hard wall-clock budgets, landing ordinary "
+             "kind='plan' records + kind='tune' audit rows in the "
+             "profile store; the usual 25% noise band decides "
+             "promotion. With --fleet-dir, plan a tuning-lease "
+             "coordinator that idle fleet workers / serve replicas "
+             "drain instead",
+    )
+    p_tune.add_argument("graph", help="path or loader spec of the graph "
+                                      "(= the shape bucket) to calibrate")
+    p_tune.add_argument("--store-dir", default=None, metavar="DIR",
+                        help="profile store to land evidence in "
+                             "(default: $PJ_PROFILE_DIR, else "
+                             "bench_artifacts/profiles)")
+    p_tune.add_argument("--knobs", default=None, metavar="K1,K2",
+                        help="comma-separated knob subset (default: every "
+                             "knob a registered Plan declares)")
+    p_tune.add_argument("--probe-budget", type=float, default=30.0,
+                        metavar="SECONDS",
+                        help="hard wall-clock cap per probe solve; a "
+                             "probe over the cap is censored — recorded "
+                             "but never promotable (default 30)")
+    p_tune.add_argument("--bucket-budget", type=float, default=120.0,
+                        metavar="SECONDS",
+                        help="total probe budget for this bucket; 0 "
+                             "means do nothing at all (default 120)")
+    p_tune.add_argument("--fleet-dir", default=None, metavar="DIR",
+                        help="plan the probes as coordinator tuning "
+                             "leases in DIR (lease = knob x candidate "
+                             "chunk, chunk sizes priced from the cost "
+                             "model) and run --workers in-process claim "
+                             "loops; point solve workers/serve replicas "
+                             "at DIR via --tune-dir to drain it from "
+                             "idle capacity instead")
+    p_tune.add_argument("--workers", type=int, default=1,
+                        help="in-process claim loops for --fleet-dir "
+                             "(default 1; 0 = plan only)")
+    p_tune.add_argument("--harvest", action="store_true",
+                        help="merge committed tuning-lease shards from "
+                             "--fleet-dir into the store and exit "
+                             "(idempotent)")
+    p_tune.add_argument("--json", action="store_true", dest="as_json")
 
     p_fleet = sub.add_parser(
         "fleet",
@@ -984,6 +1045,63 @@ def main(argv: list[str] | None = None) -> int:
                 return 3
             return 0
         except CoordinatorError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+
+    if args.command == "tune":
+        from paralleljohnson_tpu import tuner as _tuner
+        from paralleljohnson_tpu.distributed.coordinator import (
+            CoordinatorError as _CoordErr,
+        )
+
+        store_dir = (
+            args.store_dir
+            or os.environ.get("PJ_PROFILE_DIR")
+            or "bench_artifacts/profiles"
+        )
+        knobs = (
+            [k.strip() for k in args.knobs.split(",") if k.strip()]
+            if args.knobs else None
+        )
+        try:
+            if args.harvest:
+                if not args.fleet_dir:
+                    print("error: --harvest needs --fleet-dir",
+                          file=sys.stderr)
+                    return 1
+                print(json.dumps(
+                    _tuner.harvest_tuning(args.fleet_dir, store_dir)
+                ))
+                return 0
+            g = load_graph(args.graph)
+            if args.fleet_dir:
+                coord = _tuner.plan_tuning_fleet(
+                    args.fleet_dir, graph_spec=args.graph, graph=g,
+                    knobs=knobs, store_dir=store_dir,
+                    probe_budget_s=args.probe_budget,
+                )
+                out = {"fleet_dir": str(coord.dir),
+                       "leases": len(coord.leases()),
+                       "workers": []}
+                for w in range(args.workers):
+                    out["workers"].append(_tuner.run_tuning_worker(
+                        args.fleet_dir, f"tuner{w}", graph=g,
+                    ))
+                if args.workers:
+                    out["harvest"] = _tuner.harvest_tuning(
+                        args.fleet_dir, store_dir
+                    )
+                print(json.dumps(out, default=str))
+                return 0
+            summary = _tuner.tune_bucket(
+                g, store_dir=store_dir, knobs=knobs,
+                probe_budget_s=args.probe_budget,
+                bucket_budget_s=args.bucket_budget,
+            )
+            print(json.dumps(summary, default=str,
+                             indent=None if args.as_json else 2))
+            return 0
+        except (_CoordErr, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
 
@@ -1404,7 +1522,21 @@ def main(argv: list[str] | None = None) -> int:
                         "capped by the budget"
                     ),
                     "pipeline_depth": "hand-tuned fallback 2",
+                    "approx_beta": (
+                        "hand-tuned fallback ops.hopset.auto_beta"
+                        "(V, epsilon)"
+                    ),
                 },
+                "tuner": (
+                    "pjtpu tune probes candidate knob values under hard "
+                    "wall-clock budgets and lands ordinary kind='plan' "
+                    "records plus kind='tune' audit rows; promotion "
+                    "stays behind the same 25% noise band "
+                    "(paralleljohnson_tpu.tuner, ISSUE 19). Zero budget "
+                    "= bitwise-identical dispatch. Idle fleet workers "
+                    "and serve replicas drain tuning leases via "
+                    "--tune-dir"
+                ),
                 "tuning": (
                     "per (platform, shape bucket) from the profile "
                     "store's kind='plan' records: the value with the "
@@ -1612,6 +1744,23 @@ def main(argv: list[str] | None = None) -> int:
                             "calibration_n": entry["n"],
                         }
                 info["graph"]["priced_routes"] = priced
+            # Knob provenance (ISSUE 19 satellite): where each tunable's
+            # effective value for THIS shape bucket comes from — seed /
+            # cpu-calibrated / tuner-promoted — with the profile-store
+            # line number of the backing record when one exists.
+            try:
+                from paralleljohnson_tpu.tuner import provenance_table
+
+                info["graph"]["tuned_knobs"] = provenance_table(
+                    store_dir=_store_dir,
+                    num_nodes=g.num_nodes,
+                    num_edges=g.num_real_edges,
+                    config=SolverConfig(profile_store=args.profile_store),
+                )
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                info["graph"]["tuned_knobs"] = {
+                    "error": f"{type(e).__name__}: {e}"
+                }
         if args.updates is not None:
             # Dirty-set diagnosis of a concrete update file — the same
             # diagnose() pjtpu update runs, no repair work (the state
@@ -1892,6 +2041,7 @@ def main(argv: list[str] | None = None) -> int:
                     fleet_dir=args.fleet_dir,
                     replica_id=args.replica_id,
                     fleet_heartbeat_s=args.replica_heartbeat,
+                    tune_dir=args.tune_dir,
                 ).start()
                 # The announce line scripts/chaos drills parse for the
                 # bound (possibly ephemeral) port.
@@ -2015,6 +2165,7 @@ def main(argv: list[str] | None = None) -> int:
                 result = repair_checkpoint(
                     args.checkpoint_dir, g, updates, config=cfg,
                     num_parts=args.partition_parts,
+                    strategy=args.strategy,
                 )
             payload = result.as_dict()
             if args.as_json:

@@ -63,8 +63,16 @@ def run_worker(
     poll_s: float = 0.25,
     idle_timeout_s: float = 600.0,
     self_kill_after_claims: int | None = None,
+    tune_dir: str | Path | None = None,
 ) -> dict:
     """Claim-solve-commit until the fleet is done (or ``max_leases``).
+
+    ``tune_dir``: optional tuning-fleet directory (ISSUE 19,
+    :func:`paralleljohnson_tpu.tuner.plan_tuning_fleet`). When the solve
+    coordinator has no claimable lease, the worker claims ONE tuning
+    lease from ``tune_dir`` instead of sleeping — idle fleet capacity
+    becomes calibration probes. Solve leases always win: tuning is only
+    attempted when ``claim`` comes back empty.
 
     ``self_kill_after_claims=k``: after the k-th successful claim the
     worker SIGKILLs itself mid-lease — the deterministic host-loss
@@ -113,6 +121,7 @@ def run_worker(
         "edges_relaxed": 0,
         "stale_commits": 0,
         "claims": 0,
+        "tuning_leases": 0,
         "wall_s": 0.0,
         "rc": 0,
     }
@@ -149,6 +158,23 @@ def run_worker(
             if lease is None:
                 if coord.done():
                     break
+                if tune_dir is not None:
+                    # Idle-capacity farm (ISSUE 19): no solve lease to
+                    # claim, so run one calibration probe lease instead
+                    # of sleeping. Probes run under their own wall-clock
+                    # caps, so a solve lease freed meanwhile is picked up
+                    # within one probe budget.
+                    from paralleljohnson_tpu.tuner import try_tuning_lease
+
+                    tuned = try_tuning_lease(tune_dir, worker_id)
+                    if tuned is not None:
+                        summary["tuning_leases"] += 1
+                        if tel:
+                            tel.event("tuning_lease", worker=worker_id,
+                                      lease=tuned["lease"],
+                                      probes=len(tuned["probes"]))
+                        idle_since = None
+                        continue
                 # Outstanding leases belong to other workers; they will
                 # either commit or be re-queued by a reap — poll, with a
                 # hard idle cap so an orphaned worker cannot spin forever.
@@ -254,6 +280,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--self-kill-after-claims", type=int, default=None,
                     help="TEST HOOK: SIGKILL self after the Nth claim, "
                          "lease held (deterministic host-loss injection)")
+    ap.add_argument("--tune-dir", default=None,
+                    help="idle-capacity tuning (ISSUE 19): when the solve "
+                         "coordinator has no claimable lease, drain one "
+                         "probe lease from this tuning-fleet dir instead "
+                         "of sleeping")
     args = ap.parse_args(argv)
 
     from paralleljohnson_tpu.utils.platform import honor_cpu_platform_request
@@ -271,6 +302,7 @@ def main(argv: list[str] | None = None) -> int:
             poll_s=args.poll_s,
             idle_timeout_s=args.idle_timeout_s,
             self_kill_after_claims=args.self_kill_after_claims,
+            tune_dir=args.tune_dir,
         )
     except (CoordinatorError, ValueError, FileNotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)

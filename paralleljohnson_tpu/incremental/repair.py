@@ -185,6 +185,7 @@ class RepairResult:
     io_s: float
     wall_s: float
     diag: DirtySet | None = None
+    plan: dict | None = None
 
     def as_dict(self) -> dict:
         out = {
@@ -202,7 +203,157 @@ class RepairResult:
             out[k] = round(float(getattr(self, k)), 6)
         if self.diag is not None:
             out["dirty_set"] = self.diag.as_dict()
+        if self.plan is not None:
+            out["plan"] = self.plan
         return out
+
+
+# -- repair-vs-resolve plan registry (ISSUE 19 satellite) --------------------
+#
+# Whether an update batch is cheaper to REPAIR (dirty-part closures +
+# affected-row re-expansion) or to RE-SOLVE outright used to be the
+# caller's problem — ``pjtpu update`` always repaired. It is now the
+# same priced ``select()`` walk as every dispatch decision, with each
+# side priced at its HONEST work unit via ``Plan.price_batch``: repair
+# at the estimated affected-row count (from the digest-level diagnosis
+# — no closure work is paid before the decision), resolve at B=V. The
+# ``kind:"repair"`` records every repair lands (route
+# ``incremental-repair``) are the calibration that makes the repair
+# side priceable. Unpriced, priority order keeps the old behavior:
+# repair first, always.
+
+
+REPAIR_PLANS = [
+    # Imported lazily below to keep module import order stable; filled
+    # at first use via _repair_plans().
+]
+
+
+def _repair_plans():
+    if REPAIR_PLANS:
+        return REPAIR_PLANS
+    from paralleljohnson_tpu import planner as _planner
+
+    REPAIR_PLANS.extend([
+        _planner.Plan(
+            name="repair", entry="repair", priority=10,
+            qualify=lambda ctx: (
+                True, "dirty-part repair is the incremental default"
+            ),
+            price_routes=("incremental-repair",),
+            forced=lambda cfg: getattr(
+                cfg, "repair_strategy", "auto") == "repair",
+            force_overrides={"repair_strategy": "repair"},
+            price_batch=lambda ctx: max(1, int(ctx.affected_rows)),
+            tunables=("partition_parts",),
+        ),
+        _planner.Plan(
+            name="resolve", entry="repair", priority=20,
+            qualify=lambda ctx: (True, "full re-solve always qualifies"),
+            price_routes=(
+                "vm-blocked+dw", "vm-blocked", "gs", "dia", "vm",
+                "sweep-sm",
+            ),
+            forced=lambda cfg: getattr(
+                cfg, "repair_strategy", "auto") == "resolve",
+            force_overrides={"repair_strategy": "resolve"},
+            price_batch=lambda ctx: int(ctx.num_nodes),
+        ),
+    ])
+    return REPAIR_PLANS
+
+
+def estimate_affected_rows(state, diag, num_nodes: int) -> int:
+    """Digest-level UPPER BOUND on the rows a repair would re-expand,
+    before any closure runs: rows in dirty parts re-expand fully; a
+    dirty core (cross-part change) conservatively touches everything
+    (the bitwise affected-set refinement needs the closures we are
+    deciding whether to pay for). No state → no decomposition to
+    repair along → everything."""
+    if state is None or diag is None:
+        return int(num_nodes)
+    if diag.core_dirty:
+        return int(num_nodes)
+    parts, _lids, _bl, _bc = state.indices()
+    part_pos = {int(p): i for i, p in enumerate(state.part_ids)}
+    rows = sum(
+        int(parts[part_pos[int(p)]].size)
+        for p in diag.dirty_parts if int(p) in part_pos
+    )
+    return min(int(num_nodes), rows)
+
+
+def decide_repair_strategy(
+    checkpoint_dir,
+    graph: CSRGraph,
+    report,
+    *,
+    config=None,
+    state: IncrementalState | None = None,
+    strategy: str = "auto",
+):
+    """Walk :data:`REPAIR_PLANS` for one update batch. ``report`` is
+    the ``apply_edge_updates`` report (old/new digests + changed
+    edges). Returns the ``PlanDecision``; unpriced it always chooses
+    ``repair`` (the pre-ISSUE-19 behavior, asserted by the parity
+    test). ``strategy`` pins a side ("repair"/"resolve") through the
+    ordinary forced-plan mechanism."""
+    import types as _types
+
+    from paralleljohnson_tpu import planner as _planner
+    from paralleljohnson_tpu.config import SolverConfig
+    from paralleljohnson_tpu.observe import current_platform
+
+    cfg = config if config is not None else SolverConfig()
+    if strategy not in ("auto", "repair", "resolve"):
+        raise ValueError(
+            f"repair strategy must be auto/repair/resolve, got {strategy!r}"
+        )
+    if state is None:
+        old_ckpt = BatchCheckpointer(
+            checkpoint_dir, graph_key=report.old_digest
+        )
+        try:
+            state = IncrementalState.load(
+                old_ckpt.dir, expect_digest=report.old_digest
+            )
+        except Exception:  # noqa: BLE001 — unreadable state = no state
+            state = None
+    diag = (
+        diagnose(state, report.changed_edges) if state is not None else None
+    )
+    v = graph.num_nodes
+    affected = estimate_affected_rows(state, diag, v)
+    ctx = _types.SimpleNamespace(
+        state=state, diag=diag, affected_rows=affected, num_nodes=v,
+        config=cfg, params={},
+    )
+    model = None
+    if getattr(cfg, "planner", True) is not False:
+        from paralleljohnson_tpu.observe.costs import resolve_profile_dir
+        from paralleljohnson_tpu.observe.tuning import cached_records
+
+        store_dir = resolve_profile_dir(
+            getattr(cfg, "profile_store", None)
+        )
+        records = cached_records(store_dir) if store_dir else []
+        if records:
+            from paralleljohnson_tpu.observe.store import CostModel
+
+            try:
+                model = CostModel.fit(records)
+            except Exception:  # noqa: BLE001 — unreadable = unpriced
+                model = None
+    decision = _planner.select(
+        _repair_plans(), ctx, model=model, platform=current_platform(),
+        num_edges=graph.num_real_edges, batch=max(1, affected),
+        config=_types.SimpleNamespace(repair_strategy=strategy),
+    )
+    decision.params.update(
+        affected_rows_estimate=int(affected),
+        dirty_parts=len(diag.dirty_parts) if diag is not None else None,
+    )
+    return decision
 
 
 class RepairPlan:
@@ -684,14 +835,91 @@ def repair_checkpoint(
     state: IncrementalState | None = None,
     num_parts: int | None = None,
     seed: int = 0,
+    strategy: str = "auto",
 ) -> RepairResult:
-    """Prepare + execute one repair (the ``pjtpu update`` entry)."""
+    """Prepare + execute one repair (the ``pjtpu update`` entry).
+
+    ``strategy`` (ISSUE 19 satellite): ``"auto"`` prices
+    repair-vs-resolve through :data:`REPAIR_PLANS` from the learned
+    ``kind:"repair"`` records BEFORE any closure work is paid — a
+    cheaper full re-solve skips the repair machinery entirely;
+    ``"repair"``/``"resolve"`` pin a side. Unpriced auto is the old
+    behavior: always repair."""
+    from paralleljohnson_tpu.config import SolverConfig
+
+    cfg = config if config is not None else SolverConfig()
+    decision = None
+    if strategy != "repair":
+        # Pre-compute the update report once for the decision; the
+        # repair path re-derives it inside prepare_repair (host-side
+        # CSR rebuild — linear, and correctness-critical to keep in
+        # one place there).
+        _, report = graph.apply_edge_updates(updates)
+        if report.num_changed:
+            decision = decide_repair_strategy(
+                checkpoint_dir, graph, report, config=cfg, state=state,
+                strategy=strategy,
+            )
+    if decision is not None and decision.chosen.plan.name == "resolve":
+        return _resolve_checkpoint(
+            checkpoint_dir, graph, updates, config=cfg, decision=decision,
+        )
     plan = prepare_repair(
-        checkpoint_dir, graph, updates, config=config, state=state,
+        checkpoint_dir, graph, updates, config=cfg, state=state,
         num_parts=num_parts, seed=seed,
     )
     with plan.tel.span("repair", changed=plan.report.num_changed):
-        return execute_repair(plan)
+        result = execute_repair(plan)
+    if decision is not None:
+        result.plan = decision.as_dict(built="repair")
+    return result
+
+
+def _resolve_checkpoint(
+    checkpoint_dir,
+    graph: CSRGraph,
+    updates,
+    *,
+    config,
+    decision,
+) -> RepairResult:
+    """The priced re-solve side of the repair-vs-resolve walk: solve
+    the updated graph through the ordinary solver straight into the
+    NEW digest's checkpoint subtree (same layout a repair commits to),
+    then finish like a repair — status ``done``, stale rows cleared.
+    The solve itself lands the usual ``kind:"solve"`` records, so the
+    decision keeps calibrating from real walls on both sides."""
+    t_start = time.perf_counter()
+    from paralleljohnson_tpu.solver.johnson import ParallelJohnsonSolver
+
+    new_graph, report = graph.apply_edge_updates(updates)
+    v = new_graph.num_nodes
+    old_ckpt = BatchCheckpointer(checkpoint_dir, graph_key=report.old_digest)
+    repair_status.write_repair_status(
+        old_ckpt.dir, status="repairing", new_digest=report.new_digest,
+        affected="all", total_sources=v,
+    )
+    cfg = dataclasses.replace(config, checkpoint_dir=str(checkpoint_dir))
+    t0 = time.perf_counter()
+    ParallelJohnsonSolver(cfg).solve(new_graph)
+    solve_s = time.perf_counter() - t0
+    new_ckpt = BatchCheckpointer(checkpoint_dir, graph_key=report.new_digest)
+    repair_status.write_repair_status(
+        old_ckpt.dir, status="done", new_digest=report.new_digest,
+        affected="all", remaining=[], total_sources=v,
+    )
+    result = RepairResult(
+        old_digest=report.old_digest, new_digest=report.new_digest,
+        trivial=False, parts_total=0, dirty_parts_closed=0,
+        core_recomputed=False, boundary_changed=False,
+        full_row_parts=[], col_parts=[], affected_rows=v,
+        rows_recomputed=v, rows_patched=0, rows_copied=0,
+        batches_rewritten=len(new_ckpt.manifest()), expand_macs=0,
+        closures_s=0.0, expand_s=solve_s, io_s=0.0,
+        wall_s=time.perf_counter() - t_start,
+        plan=decision.as_dict(built="resolve"),
+    )
+    return result
 
 
 def _append_profile_record(plan: RepairPlan, result: RepairResult) -> None:

@@ -90,7 +90,9 @@ from paralleljohnson_tpu.observe.tuning import (  # noqa: F401
     DEFAULT_FW_TILE,
     DEFAULT_PIPELINE_DEPTH,
     TUNABLE_PARAMS,
+    TUNE_NOISE_BAND,
     cached_records,
+    param_provenance,
     resolve_param,
     tuned_value,
 )

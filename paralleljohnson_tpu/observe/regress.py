@@ -55,6 +55,14 @@ DEFAULT_ITER_ABS_FLOOR = 2
 DEFAULT_REROUTE_BAND = 0.50
 DEFAULT_REROUTE_ABS_FLOOR_S = 0.5
 
+# Tuned-knob band (ISSUE 19): promoted knob values were measured probes,
+# and promotion itself required beating the seed beyond the planner's
+# 25% noise band — so a fresh probe of the SAME (knob, value, bucket)
+# regressing past that same band means the promotion no longer holds.
+# Mirrors observe.tuning.TUNE_NOISE_BAND (kept literal: this module is
+# loaded standalone by scripts, without the package).
+DEFAULT_TUNE_BAND = 0.25
+
 # Hopset size band (ISSUE 17): a hopset's edge count is a DETERMINISTIC
 # function of (graph, ε, k, β, seed, picker) — same shape bucket, same
 # knobs, fatter hopset means the construction changed, not the weather.
@@ -198,6 +206,45 @@ def _planner_rows(obj: dict, source: str | None) -> list[dict]:
     }]
 
 
+def _tune_rows(obj: dict, source: str | None) -> list[dict]:
+    """Rows from ``kind: "tune"`` probe records (ISSUE 19): one budgeted
+    probe measurement keyed by (knob, pow2 shape bucket) with the probed
+    value as the preset axis — so each candidate value accumulates its
+    own history. Censored probes (budget exceeded / probe error) and
+    demotion markers (``event``) are not measurements and are skipped.
+    A promoted value whose fresh probes regress past the tuning band
+    flags as ``kind: "tune"`` and ``bench_regress.py`` auto-demotes it
+    back to the seed (an ``event: "demote"`` record the resolver
+    honors)."""
+    if obj.get("censored") or obj.get("event"):
+        return []
+    measured = obj.get("measured") or {}
+    wall = measured.get("wall_s")
+    if not isinstance(wall, (int, float)) or wall <= 0:
+        return []
+    bench = (
+        f"tune:{obj.get('knob')}"
+        f":V{_pow2_up(obj.get('nodes'))}"
+        f":E{_pow2_up(obj.get('edges'))}"
+    )
+    return [{
+        "bench": bench,
+        "backend": "jax",
+        "platform": obj.get("platform", "unknown"),
+        "preset": str(obj.get("value")),
+        "wall_s": float(wall),
+        "detail": {
+            "knob": obj.get("knob"),
+            "value": obj.get("value"),
+            "plan": obj.get("plan"),
+            "rung": obj.get("rung"),
+            "nodes": obj.get("nodes"),
+            "edges": obj.get("edges"),
+        },
+        "source": source,
+    }]
+
+
 def _hopset_rows(obj: dict, source: str | None) -> list[dict]:
     """Rows from ``kind: "hopset"`` profile records (ISSUE 17): one
     construction measurement keyed by the graph's pow2 shape bucket and
@@ -246,6 +293,8 @@ def normalize_record(obj: dict, *, source: str | None = None) -> list[dict]:
         return []
     if obj.get("kind") == "plan":
         return _planner_rows(obj, source)
+    if obj.get("kind") == "tune":
+        return _tune_rows(obj, source)
     if obj.get("kind") == "hopset":
         return _hopset_rows(obj, source)
     if "bench" in obj and "wall_s" in obj:
@@ -377,8 +426,13 @@ def detect_regressions(
     iters_by_key: dict[tuple, list[int]] = {}
     size_by_key: dict[tuple, list[int]] = {}
     reroute_by_key: dict[tuple, list[float]] = {}
+    tune_by_key: dict[tuple, list[float]] = {}
     for row in history:
         w = row.get("wall_s")
+        if (row.get("detail") or {}).get("knob"):
+            if isinstance(w, (int, float)) and w > 0:
+                tune_by_key.setdefault(history_key(row), []).append(float(w))
+            continue
         if isinstance(w, (int, float)) and w > 0:
             by_key.setdefault(history_key(row), []).append(float(w))
         it = _iterations_of(row)
@@ -394,6 +448,34 @@ def detect_regressions(
     for row in fresh:
         w = row.get("wall_s")
         if not isinstance(w, (int, float)) or w <= 0:
+            continue
+        detail = row.get("detail") or {}
+        if detail.get("knob"):
+            # Tuned-knob probe rows (ISSUE 19) grade ONLY under the
+            # tuning band against their own (knob, value, bucket)
+            # history: a promoted value whose fresh probes regress past
+            # the same band that justified its promotion flags — the
+            # consumer (bench_regress.py) auto-demotes it to the seed.
+            thist = tune_by_key.get(history_key(row))
+            if thist and len(thist) >= min_history:
+                tbase = statistics.median(thist)
+                if (
+                    w > tbase * (1.0 + DEFAULT_TUNE_BAND)
+                    and (w - tbase) > abs_floor_s
+                ):
+                    flagged.append({
+                        **row,
+                        "kind": "tune",
+                        "knob": detail["knob"],
+                        "value": detail.get("value"),
+                        "baseline_s": tbase,
+                        "slowdown": w / tbase,
+                        "band": DEFAULT_TUNE_BAND,
+                        "history_n": len(thist),
+                        "roofline_bound": _roofline_of(
+                            row, profile_records
+                        ),
+                    })
             continue
         hist = by_key.get(history_key(row))
         if not hist or len(hist) < min_history:

@@ -51,11 +51,22 @@ from pathlib import Path
 DEFAULT_FW_TILE = 512
 DEFAULT_PIPELINE_DEPTH = 2
 
-# The tunable-parameter vocabulary plan records carry.
+# The tunable-parameter vocabulary plan records carry. ``approx_beta``
+# joined in ISSUE 19: the hopset relay cap is a per-shape schedule like
+# any other knob (PAPERS.md: approximate-shortest-path parameter
+# schedules are regime-dependent).
 TUNABLE_PARAMS = (
     "fw_tile", "partition_parts", "delta", "source_batch",
-    "pipeline_depth",
+    "pipeline_depth", "approx_beta",
 )
+
+# A profile-tuned value must beat a MEASURED fallback (seed) wall by
+# more than this fraction to displace it — the same calibrated-
+# challenger rule the planner applies to routes
+# (``planner.PLANNER_NOISE_BAND``); kept numerically in lock-step by
+# test_planner. An unmeasured fallback has nothing to defend with, so
+# the min-of-best-walls rule stands (the pre-ISSUE-19 behavior).
+TUNE_NOISE_BAND = 0.25
 
 # A value needs at least this many distinct observed alternatives in
 # the key before the tuner overrides the hand-tuned constant: one
@@ -101,6 +112,105 @@ def _bucket(num_nodes: int, num_edges: int) -> tuple[int, int]:
     return shape_bucket(num_nodes, num_edges, 1)[:2]
 
 
+def _best_walls(
+    name: str,
+    records: list,
+    *,
+    platform: str,
+    want: tuple,
+    validate=None,
+) -> dict:
+    """Per-value best recorded walls for one knob in one (platform,
+    shape-bucket) key: ``{value: {"wall", "record", "kind",
+    "tune_record"}}`` where ``record`` is the backing line index in
+    ``profiles.jsonl`` and ``tune_record`` is the index of a
+    non-censored ``kind:"tune"`` probe for the value (None when only
+    plan records back it — i.e. a human-driven run, not the tuner).
+
+    Two honesty rules beyond the plan-record path:
+
+    - a **censored probe never counts** — a probe killed at its
+      wall-clock cap proves the value is SLOWER than the cap, not how
+      fast it is; promoting from a censored wall would reward the
+      kill, so censored tune records are skipped entirely;
+    - a **demotion erases history**: a ``kind:"tune", event:"demote"``
+      record (written by ``bench_regress`` when a promoted value
+      regresses past the noise band) invalidates every record of that
+      value with ``ts`` at or before the demotion — newer probes can
+      re-promote, stale wins cannot."""
+    demoted: dict = {}
+    for r in records:
+        if r.get("kind") != "tune" or r.get("event") != "demote":
+            continue
+        if r.get("knob") != name or r.get("platform") != platform:
+            continue
+        if _bucket(r.get("nodes") or 0, r.get("edges") or 0) != want:
+            continue
+        v = r.get("value")
+        ts = r.get("ts") or 0
+        if v is not None and ts >= demoted.get(v, 0):
+            demoted[v] = ts
+    best: dict = {}
+    for idx, r in enumerate(records):
+        kind = r.get("kind")
+        if kind == "plan":
+            value = (r.get("params") or {}).get(name)
+        elif kind == "tune":
+            if r.get("event") == "demote" or r.get("censored"):
+                continue
+            if r.get("knob") != name:
+                continue
+            value = r.get("value")
+        else:
+            continue
+        if value is None:
+            continue
+        if r.get("platform") != platform:
+            continue
+        if _bucket(r.get("nodes") or 0, r.get("edges") or 0) != want:
+            continue
+        if validate is not None and not validate(value):
+            continue
+        measured = r.get("measured") or {}
+        wall = measured.get("compute_s") or measured.get("wall_s")
+        if not isinstance(wall, (int, float)) or wall <= 0:
+            continue
+        if value in demoted and (r.get("ts") or 0) <= demoted[value]:
+            continue
+        # Min-of-samples per value: timing noise only inflates (the
+        # CostModel rationale), so the best recorded wall is the
+        # steady-state cost of running with that value.
+        entry = best.get(value)
+        if entry is None:
+            entry = best[value] = {
+                "wall": wall, "record": idx, "kind": kind,
+                "tune_record": None,
+            }
+        elif wall < entry["wall"]:
+            entry.update(wall=wall, record=idx, kind=kind)
+        if kind == "tune" and entry["tune_record"] is None:
+            entry["tune_record"] = idx
+    return best
+
+
+def _winner(best: dict, fallback, band: float):
+    """The promotion rule shared by :func:`tuned_value` and
+    :func:`param_provenance` (see module docstring)."""
+    if len(best) < MIN_DISTINCT_VALUES:
+        return None
+    winner = min(best, key=lambda v: best[v]["wall"])
+    if (
+        fallback is not None
+        and winner != fallback
+        and fallback in best
+        and not best[winner]["wall"] < best[fallback]["wall"] * (1.0 - band)
+    ):
+        # The seed defended itself: the challenger's measured edge is
+        # inside the noise band, so the hand-tuned value stands.
+        return None
+    return winner
+
+
 def tuned_value(
     name: str,
     *,
@@ -110,11 +220,16 @@ def tuned_value(
     num_nodes: int,
     num_edges: int,
     validate=None,
+    fallback=None,
+    band: float = TUNE_NOISE_BAND,
 ):
     """The profile-tuned value of ``name`` for this (platform, shape
     bucket), or None when the store holds nothing decisive (see module
     docstring). ``validate`` filters candidate values (e.g. fw tiles
-    must be 128-multiples)."""
+    must be 128-multiples). When ``fallback`` (the hand-tuned seed) has
+    a measured wall in the same key, a different winner must beat it by
+    more than ``band`` — the planner's calibrated-challenger rule
+    applied to parameter values."""
     if name not in TUNABLE_PARAMS:
         raise ValueError(
             f"unknown tunable parameter {name!r}; expected one of "
@@ -124,33 +239,63 @@ def tuned_value(
         records = cached_records(store_dir)
     if not records:
         return None
-    want = _bucket(num_nodes, num_edges)
-    best_wall: dict = {}
-    for r in records:
-        if r.get("kind") != "plan":
-            continue
-        if r.get("platform") != platform:
-            continue
-        if _bucket(r.get("nodes") or 0, r.get("edges") or 0) != want:
-            continue
-        value = (r.get("params") or {}).get(name)
-        if value is None:
-            continue
-        if validate is not None and not validate(value):
-            continue
-        measured = r.get("measured") or {}
-        wall = measured.get("compute_s") or measured.get("wall_s")
-        if not isinstance(wall, (int, float)) or wall <= 0:
-            continue
-        # Min-of-samples per value: timing noise only inflates (the
-        # CostModel rationale), so the best recorded wall is the
-        # steady-state cost of running with that value.
-        key = value
-        if key not in best_wall or wall < best_wall[key]:
-            best_wall[key] = wall
-    if len(best_wall) < MIN_DISTINCT_VALUES:
-        return None
-    return min(best_wall, key=best_wall.get)
+    best = _best_walls(
+        name, records, platform=platform,
+        want=_bucket(num_nodes, num_edges), validate=validate,
+    )
+    return _winner(best, fallback, band)
+
+
+def param_provenance(
+    name: str,
+    *,
+    records=None,
+    store_dir: str | Path | None = None,
+    platform: str,
+    num_nodes: int,
+    num_edges: int,
+    validate=None,
+    fallback=None,
+    band: float = TUNE_NOISE_BAND,
+) -> dict:
+    """Where one knob's effective value comes from, for ``pjtpu info``
+    (ISSUE 19 satellite): ``{"value", "source", "record", "wall_s",
+    "values_seen"}`` with source one of
+
+    - ``"seed"`` — the hand-tuned constant stands (nothing decisive
+      measured, or the challenger lost to the measured seed);
+    - ``"cpu-calibrated"`` — a human-driven run (explicit config value)
+      measured faster and the store promoted it;
+    - ``"tuner-promoted"`` — the winning value is backed by a
+      ``kind:"tune"`` probe record, i.e. the self-proposing tuner
+      discovered it.
+
+    ``record`` is the backing line index into ``profiles.jsonl`` (the
+    record whose wall won), None for seed."""
+    if records is None:
+        records = cached_records(store_dir)
+    best = _best_walls(
+        name, records or [], platform=platform,
+        want=_bucket(num_nodes, num_edges), validate=validate,
+    )
+    winner = _winner(best, fallback, band)
+    if winner is None:
+        return {
+            "value": fallback, "source": "seed", "record": None,
+            "wall_s": (
+                best[fallback]["wall"] if fallback in best else None
+            ),
+            "values_seen": len(best),
+        }
+    entry = best[winner]
+    tuned = entry["tune_record"] is not None
+    return {
+        "value": winner,
+        "source": "tuner-promoted" if tuned else "cpu-calibrated",
+        "record": entry["record"],
+        "wall_s": entry["wall"],
+        "values_seen": len(best),
+    }
 
 
 def resolve_param(
@@ -182,6 +327,7 @@ def resolve_param(
         tuned = tuned_value(
             name, store_dir=store_dir, platform=platform,
             num_nodes=num_nodes, num_edges=num_edges, validate=validate,
+            fallback=fallback,
         )
         if tuned is not None:
             return tuned, "profile-tuned"

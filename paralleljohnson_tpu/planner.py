@@ -91,7 +91,14 @@ class Plan:
     the first priced tag wins (a family whose route tag varies, e.g.
     ``fw``/``fw-tile``, lists both). ``force_overrides`` is the config
     patch that pins dispatch to this plan — what the bench harness uses
-    to measure every qualified plan on one graph."""
+    to measure every qualified plan on one graph. ``tunables`` names
+    the knobs (``observe.tuning.TUNABLE_PARAMS`` vocabulary) whose
+    value shapes this plan's wall — what the self-proposing tuner
+    (``tuner.py``, ISSUE 19) enumerates candidates for. ``price_batch``
+    overrides the dispatch-level ``batch`` for THIS plan's pricing
+    (e.g. an incremental repair is priced at its affected-row count
+    while the full re-solve prices at B=V — one ``select()`` call, two
+    honest work units)."""
 
     name: str
     entry: str
@@ -103,6 +110,8 @@ class Plan:
     forced: Callable[[Any], bool] = lambda config: False
     failure: Callable[[Any, Any], None] | None = None
     force_overrides: dict = dataclasses.field(default_factory=dict)
+    tunables: tuple[str, ...] = ()
+    price_batch: Callable[[Any], int] | None = None
 
 
 @dataclasses.dataclass
@@ -203,9 +212,13 @@ def select(
         )
     if model is not None and num_edges:
         for cand in qualified:
+            plan_batch = (
+                int(cand.plan.price_batch(ctx))
+                if cand.plan.price_batch is not None else batch
+            )
             for route in cand.plan.price_routes:
                 pred = model.predict(
-                    route, num_edges=num_edges, batch=batch,
+                    route, num_edges=num_edges, batch=plan_batch,
                     platform=platform,
                 )
                 if pred is not None:
@@ -314,6 +327,67 @@ LOOKUP_PLANS = [
         force_overrides={"device_lookup": "off"},
     ),
 ]
+
+
+def tune_record(
+    *,
+    knob: str,
+    value,
+    platform: str,
+    num_nodes: int,
+    num_edges: int,
+    batch: int = 1,
+    plan: str | None = None,
+    wall_s: float | None = None,
+    compute_s: float | None = None,
+    censored: bool = False,
+    budget_s: float | None = None,
+    rung: int | None = None,
+    label: str = "tuner",
+    event: str | None = None,
+    reason: str | None = None,
+) -> dict:
+    """The ``kind: "tune"`` profile-store record (ISSUE 19): one per
+    tuner probe (``event=None``) or per demotion (``event="demote"``,
+    written by ``bench_regress`` when a promoted value regresses past
+    the noise band). Probe records are what marks a value
+    "tuner-promoted" in provenance; a CENSORED probe (killed at its
+    wall-clock cap) carries no measured wall and can never promote —
+    ``observe.tuning`` skips it by construction. The CostModel's fit
+    ignores the kind entirely, so probes never distort route pricing;
+    the ordinary ``kind:"plan"``/``"solve"`` records the probe solve
+    itself lands are the calibration."""
+    out = {
+        "ts": time.time(),
+        "kind": "tune",
+        "label": label,
+        "platform": platform,
+        "nodes": int(num_nodes),
+        "edges": int(num_edges),
+        "batch": int(batch),
+        "knob": knob,
+        "value": value,
+    }
+    if event is not None:
+        out["event"] = event
+    if plan is not None:
+        out["plan"] = plan
+    if censored:
+        out["censored"] = True
+    if budget_s is not None:
+        out["budget_s"] = float(budget_s)
+    if rung is not None:
+        out["rung"] = int(rung)
+    if reason is not None:
+        out["reason"] = reason
+    measured = {}
+    if wall_s is not None:
+        measured["wall_s"] = float(wall_s)
+    if compute_s is not None:
+        measured["compute_s"] = float(compute_s)
+    if measured:
+        out["measured"] = measured
+    return out
 
 
 def plan_record(

@@ -62,7 +62,9 @@ import os
 import socket
 import threading
 import time
+import types
 
+from paralleljohnson_tpu import planner as _planner
 from paralleljohnson_tpu.serve.engine import (
     SERVE_LIVE_FILENAME,
     QueryError,
@@ -89,6 +91,60 @@ DEFAULT_RETRY_AFTER_MS = 100
 # server never trades latency for width.
 DEFAULT_BATCH_WINDOW = 32
 DEFAULT_BATCH_WAIT_MS = 0.0
+
+# Shedding tiers as a planner registry (ISSUE 19 satellite): what an
+# exact-miss degrades to under overload is the same kind of decision as
+# which kernel route serves a solve, so it goes through the same
+# ``planner.select`` walk — declared priority unpriced, CostModel-priced
+# promotion past the 25% band under ``shed_policy="priced"``, forced
+# pins for the explicit policies, and a decision record with the full
+# candidate table (including honest disqualification reasons) in
+# ``health()``. The "stale" tier is declared but self-disqualifying:
+# staleness is an answer PROPERTY of the repair contract (ISSUE 11),
+# not a servable degrade target, and the candidate table says so
+# instead of silently omitting it.
+SHED_PLANS = [
+    _planner.Plan(
+        name="hopset", entry="shed", priority=10,
+        qualify=lambda ctx: (
+            (True, "certified (1+eps) hopset tier attached")
+            if getattr(ctx.engine, "hopset", None) is not None
+            else (False, "no hopset attached to the engine")
+        ),
+        price_routes=("hopset+bf",),
+        forced=lambda cfg: getattr(cfg, "shed_policy", None) == "hopset",
+    ),
+    _planner.Plan(
+        name="landmark", entry="shed", priority=20,
+        qualify=lambda ctx: (
+            (True, "landmark index attached (certified bounds)")
+            if ctx.engine.landmarks is not None
+            else (False, "no landmark index attached to the engine")
+        ),
+        price_routes=("lookup-host",),
+        forced=lambda cfg: getattr(cfg, "shed_policy", None) == "landmark",
+    ),
+    _planner.Plan(
+        name="stale", entry="shed", priority=25,
+        qualify=lambda ctx: (
+            False,
+            "stale pre-update rows are a property the repair staleness "
+            "contract stamps on answers, not a tier a shed exact-miss "
+            "can degrade to — nothing independent to serve",
+        ),
+    ),
+    _planner.Plan(
+        name="reject", entry="shed", priority=90,
+        qualify=lambda ctx: (
+            True, "unconditional: the overloaded rejection always exists"
+        ),
+        forced=lambda cfg: getattr(cfg, "shed_policy", None) == "reject",
+    ),
+]
+
+# Chosen shed plan -> the query mode an exact-miss is rewritten to
+# ("reject" short-circuits to the overloaded answer instead).
+_SHED_MODES = {"hopset": "hopset", "landmark": "approx", "reject": "reject"}
 
 # The low-traffic guard on the shed decision (the SRE-workbook caveat:
 # burn-rate math over a handful of events is dominated by any single
@@ -231,7 +287,8 @@ class ServeFrontend:
                  max_inflight_per_client: int | None = None,
                  http: bool = False,
                  fleet_dir=None, replica_id: str | None = None,
-                 fleet_heartbeat_s: float = 1.0) -> None:
+                 fleet_heartbeat_s: float = 1.0,
+                 tune_dir=None, tune_idle_s: float = 2.0) -> None:
         if shed_policy not in SHED_POLICIES:
             raise ValueError(
                 f"shed_policy must be one of {SHED_POLICIES}, "
@@ -295,6 +352,13 @@ class ServeFrontend:
             str(replica_id) if replica_id else f"replica-{os.getpid()}"
         )
         self.fleet_heartbeat_s = float(fleet_heartbeat_s)
+        # Idle-capacity tuning (ISSUE 19): with a tuning-fleet dir
+        # attached, a replica that has had no open connections for
+        # tune_idle_s claims ONE probe lease at a time from it —
+        # serving traffic always preempts the next claim.
+        self.tune_dir = tune_dir
+        self.tune_idle_s = float(tune_idle_s)
+        self._tune_thread: threading.Thread | None = None
         self._registration = None
         self._tel = engine._tel
         self._tracker = engine.slo_tracker()
@@ -360,12 +424,57 @@ class ServeFrontend:
             target=self._accept_loop, name="pj-serve-accept", daemon=True
         )
         self._accept_thread.start()
+        if self.tune_dir is not None:
+            self._tune_thread = threading.Thread(
+                target=self._tune_loop, name="pj-serve-tuner", daemon=True
+            )
+            self._tune_thread.start()
         self._tel.event("serve_listen", host=self.address[0],
                         port=self.address[1], protocol=PROTOCOL,
                         max_connections=self.max_connections,
                         max_inflight=self.max_inflight,
                         shed_policy=self.shed_policy)
         return self
+
+    def _tune_loop(self) -> None:
+        """Idle-capacity farm (ISSUE 19): while the replica is serving
+        nothing, drain one tuning lease at a time from ``tune_dir``.
+        One-lease-at-a-time keeps preemption latency at one probe
+        budget; probes run in this daemon thread under their own hard
+        wall-clock caps, and results only become real when the
+        coordinator commit lands (the digest-guarded manifest idiom) —
+        a replica killed mid-probe leaks nothing into the store."""
+        from paralleljohnson_tpu.tuner import try_tuning_lease
+
+        idle_since: float | None = None
+        while not self._draining.is_set():
+            with self._conn_lock:
+                busy = bool(self._conns)
+            if busy:
+                idle_since = None
+                self._draining.wait(self.tune_idle_s)
+                continue
+            now = time.monotonic()
+            if idle_since is None:
+                idle_since = now
+            if now - idle_since < self.tune_idle_s:
+                self._draining.wait(
+                    max(0.05, self.tune_idle_s - (now - idle_since))
+                )
+                continue
+            try:
+                res = try_tuning_lease(
+                    self.tune_dir, f"serve-{self.replica_id}"
+                )
+            except Exception:  # noqa: BLE001 — tuning must never kill serving
+                res = None
+            if res is None:
+                self._draining.wait(self.tune_idle_s)
+            else:
+                self._tel.event(
+                    "tuning_lease", replica=self.replica_id,
+                    lease=res["lease"], probes=len(res["probes"]),
+                )
 
     def _fleet_payload(self) -> dict:
         """Merged into every membership heartbeat: serve counters + a
@@ -624,30 +733,23 @@ class ServeFrontend:
         return self.shed_active
 
     def _shed_mode(self) -> str:
-        """The query mode a shed exact-miss degrades to: ``"approx"``
-        (landmark tier) or ``"hopset"``. For ``shed_policy="priced"``
-        the certified tiers are ordered by predicted per-query serving
-        cost from the profile store's calibration (``lookup-host`` vs
-        ``hopset+bf`` route records); with nothing priced the hopset
-        wins when attached — its composed interval is at least as tight
-        as the landmark one by construction. Resolved once per process
-        (the fit reads the store) and cached with its why-line."""
-        if self.shed_policy == "landmark":
-            return "approx"
-        if self.shed_policy == "hopset":
-            return "hopset"
+        """What a shed exact-miss degrades to — the chosen
+        :data:`SHED_PLANS` entry's mode (``"hopset"`` / ``"approx"`` /
+        ``"reject"``). Every policy goes through the same
+        ``planner.select`` walk: explicit policies are forced pins,
+        ``"priced"`` fits the profile store's CostModel and promotes
+        the cheaper certified tier only when BOTH are priced beyond the
+        planner noise band (same gate as kernel dispatch), unpriced
+        falls back to declared tier order (hopset first — its composed
+        interval is at least as tight as the landmark one by
+        construction). Resolved once per process and cached with the
+        full decision record (``health()`` reports it)."""
         if self._shed_mode_cached is not None:
             return self._shed_mode_cached[0]
         engine = self.engine
-        tiers = []  # (mode, priced route tag) in default preference order
-        if getattr(engine, "hopset", None) is not None:
-            tiers.append(("hopset", "hopset+bf"))
-        if engine.landmarks is not None:
-            tiers.append(("approx", "lookup-host"))
-        mode, why = tiers[0][0], "unpriced: declared tier order"
-        if len(tiers) > 1:
+        model = None
+        if self.shed_policy == "priced":
             try:
-                from paralleljohnson_tpu.observe import current_platform
                 from paralleljohnson_tpu.observe.costs import (
                     resolve_profile_dir,
                 )
@@ -661,21 +763,25 @@ class ServeFrontend:
                 )
                 if store_dir:
                     model = CostModel.fit(ProfileStore(store_dir))
-                    platform = current_platform()
-                    priced = []
-                    for m, route in tiers:
-                        pred = model.predict(
-                            route, num_edges=engine.graph.num_edges,
-                            batch=1, platform=platform,
-                        )
-                        if pred is not None:
-                            priced.append((float(pred["predicted_s"]), m))
-                    if priced:
-                        cost, mode = min(priced)
-                        why = f"priced: {mode} predicts {cost:.4g}s/query"
             except Exception:  # noqa: BLE001 — pricing must never block a shed
-                pass
-        self._shed_mode_cached = (mode, why)
+                model = None
+        try:
+            from paralleljohnson_tpu.observe import current_platform
+
+            platform = current_platform()
+        except Exception:  # noqa: BLE001
+            platform = "unknown"
+        decision = _planner.select(
+            SHED_PLANS,
+            types.SimpleNamespace(engine=engine, params={}),
+            model=model,
+            platform=platform,
+            num_edges=int(getattr(engine.graph, "num_edges", 0) or 0),
+            batch=1,
+            config=types.SimpleNamespace(shed_policy=self.shed_policy),
+        )
+        mode = _SHED_MODES[decision.chosen.plan.name]
+        self._shed_mode_cached = (mode, decision.reason, decision.as_dict())
         return mode
 
     def health(self) -> dict:
@@ -700,7 +806,8 @@ class ServeFrontend:
             "shed_tier": (
                 None if self._shed_mode_cached is None
                 else {"mode": self._shed_mode_cached[0],
-                      "reason": self._shed_mode_cached[1]}
+                      "reason": self._shed_mode_cached[1],
+                      "plan": self._shed_mode_cached[2]}
             ),
             "open_connections": stats.open_connections,
             "max_connections": self.max_connections,
@@ -850,7 +957,8 @@ class ServeFrontend:
             except (TypeError, ValueError):
                 pass  # malformed: the engine's parser owns the error
             if not is_hit:
-                if self.shed_policy == "reject":
+                shed_to = self._shed_mode()
+                if shed_to == "reject":
                     self._count_rejection()
                     return {"id": req_id, "error": "overloaded",
                             "reason": "shedding", "shed": True,
@@ -858,8 +966,9 @@ class ServeFrontend:
                 # Certified degrade: the landmark/hopset answer is
                 # flagged exact=false AND shed=true, and carries
                 # max_error — never an unflagged approximation. The
-                # tier is the policy's (priced under "priced").
-                req = {**req, "mode": self._shed_mode()}
+                # tier is the SHED_PLANS decision's (priced under
+                # "priced", forced pin otherwise).
+                req = {**req, "mode": shed_to}
                 shed = True
         try:
             if self.batcher is not None:

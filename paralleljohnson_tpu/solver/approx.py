@@ -337,6 +337,7 @@ APPROX_PLANS = [
         price_routes=("hopset+bf",),
         forced=lambda cfg: getattr(cfg, "hopset", "auto") is True,
         force_overrides={"hopset": True},
+        tunables=("approx_beta",),
     ),
     _planner.Plan(
         name="exact", entry="apsp", priority=20,

@@ -20,10 +20,12 @@ import collections
 import concurrent.futures
 import dataclasses
 import time
+import types
 from typing import Any
 
 import numpy as np
 
+from paralleljohnson_tpu import planner as _planner
 from paralleljohnson_tpu.backends import Backend, get_backend
 from paralleljohnson_tpu.config import SolverConfig
 from paralleljohnson_tpu.graphs import CSRGraph, stack_graphs
@@ -169,6 +171,78 @@ _ROW_REDUCERS = {
 }
 
 
+# -- solver-level plan registry (ISSUE 19) -----------------------------------
+#
+# The condensed/standard choice used to be a hand-rolled ``if
+# self._use_partitioned(...)`` branch — the last dispatch decision the
+# planner registry could not see, price, or tune. It is now the same
+# ``select()`` walk every kernel family goes through: ``condensed+fw``
+# (priority 10, qualification = the old predicate verbatim) vs
+# ``standard`` (priority 20, unconditional fallback). Unpriced, the
+# walk reproduces the old branch bit-for-bit (priority order == branch
+# order); priced, a calibrated store can promote either side past the
+# 25% noise band, and the self-proposing tuner (``tuner.py``) can probe
+# the family's declared knobs like any other plan's.
+
+
+def _qual_condensed(ctx):
+    config = ctx.config
+    if getattr(ctx.solver, "_partitioned_disabled", False):
+        return False, (
+            "condensed route disabled for this solver instance "
+            "(earlier auto-route failure)"
+        )
+    flag = getattr(config, "partitioned", False)
+    if flag is False:
+        return False, "partitioned=False pins the standard route"
+    if flag is True:
+        return True, "partitioned=True forces the condensed route"
+    if config.backend != "jax":
+        return False, "condensed route is jax-only"
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return False, (
+            "auto condensed is TPU-gated (the dense core pays on MXU)"
+        )
+    v = ctx.graph.num_nodes
+    if not 1024 <= v <= config.fw_threshold:
+        return False, f"V={v} outside the blocked-FW size range"
+    if 2 * len(ctx.sources) < v:
+        return False, "source set below full-APSP scale (2B < V)"
+    if ctx.graph.num_real_edges >= config.dense_min_density * v * v:
+        return False, "dense graph: the plain fw route owns it"
+    return True, (
+        "TPU + sparse + full-APSP scale in the blocked-FW size range"
+    )
+
+
+SOLVER_PLANS = [
+    _planner.Plan(
+        name="condensed+fw", entry="solver", priority=10,
+        qualify=_qual_condensed,
+        price_routes=("condensed+fw",),
+        forced=lambda cfg: getattr(cfg, "partitioned", False) is True,
+        force_overrides={"partitioned": True},
+        tunables=("fw_tile", "partition_parts"),
+    ),
+    _planner.Plan(
+        name="standard", entry="solver", priority=20,
+        qualify=lambda ctx: (True, "unconditional standard Johnson path"),
+        # The standard path's actual fan-out route is decided one layer
+        # down (FANOUT_PLANS); for solver-level pricing the first
+        # calibrated tag in ladder order stands in.
+        price_routes=(
+            "vm-blocked+dw", "vm-blocked", "gs", "dia", "vm",
+            "sweep-sm", "fw",
+        ),
+        forced=lambda cfg: getattr(cfg, "partitioned", True) is False,
+        force_overrides={"partitioned": False},
+        tunables=("source_batch", "pipeline_depth"),
+    ),
+]
+
+
 class ParallelJohnsonSolver:
     """Orchestrates Johnson's algorithm over a pluggable backend."""
 
@@ -221,9 +295,11 @@ class ParallelJohnsonSolver:
         tel.progress(op="solve", sources_total=len(sources))
         with tel.span("solve", op="solve", n_sources=len(sources),
                       predecessors=predecessors):
-            if self._use_partitioned(graph, sources):
+            decision = self._solver_decision(graph, sources)
+            if decision.chosen.plan.name == "condensed+fw":
                 res = self._try_condensed(
-                    graph, sources, stats, predecessors, tel
+                    graph, sources, stats, predecessors, tel,
+                    decision=decision,
                 )
                 if res is not None:
                     return res
@@ -487,36 +563,69 @@ class ParallelJohnsonSolver:
 
     # -- internals ----------------------------------------------------------
 
+    def _solver_model(self):
+        """Fitted CostModel for the solver-level ``select()`` walk, or
+        None (unpriced — pure declared priority, i.e. the old branch).
+        Cached per records-list identity like the backend's
+        ``_planner_model`` so repeated solves fit once per store state."""
+        config = self.config
+        if getattr(config, "planner", True) is False:
+            return None
+        from paralleljohnson_tpu.observe.costs import resolve_profile_dir
+        from paralleljohnson_tpu.observe.tuning import cached_records
+
+        store_dir = resolve_profile_dir(
+            getattr(config, "profile_store", None)
+        )
+        if not store_dir:
+            return None
+        records = cached_records(store_dir)
+        if not records:
+            return None
+        cached = getattr(self, "_solver_model_cache", None)
+        if cached is not None and cached[0] is records:
+            return cached[1]
+        from paralleljohnson_tpu.observe.store import CostModel
+
+        try:
+            model = CostModel.fit(records)
+        except Exception:  # noqa: BLE001 — unreadable store = unpriced
+            return None
+        self._solver_model_cache = (records, model)
+        return model
+
+    def _solver_decision(self, graph: CSRGraph, sources: np.ndarray):
+        """The solver-level plan decision: ``SOLVER_PLANS`` walked
+        through the ordinary priced ``select()`` (ISSUE 19 — the last
+        hand-rolled dispatch branch, now registry data)."""
+        from paralleljohnson_tpu.observe import current_platform
+
+        ctx = types.SimpleNamespace(
+            solver=self, graph=graph, sources=sources,
+            config=self.config, params={},
+        )
+        return _planner.select(
+            SOLVER_PLANS, ctx, model=self._solver_model(),
+            platform=current_platform(),
+            num_edges=graph.num_real_edges, batch=len(sources),
+            config=self.config,
+        )
+
     def _use_partitioned(self, graph: CSRGraph, sources: np.ndarray) -> bool:
         """Condense-solve-expand route qualification
-        (``solver.partitioned``, route tag ``condensed+fw``). True
-        forces (the route's math is backend-independent jnp + numpy);
-        "auto" mirrors the TPU-gated auto routes: full-APSP-scale source
-        sets (2B >= V) on sparse graphs (below the dense density gate —
-        dense graphs take the plain fw route) in the blocked-FW size
-        range, on TPU only — that is where the dense core replaces a
-        gather-bound sweep with MXU work."""
-        flag = getattr(self.config, "partitioned", False)
-        if flag is False or getattr(self, "_partitioned_disabled", False):
-            return False
-        if flag is True:
-            return True
-        if self.config.backend != "jax":
-            return False
-        import jax
-
-        v = graph.num_nodes
-        return (
-            jax.default_backend() == "tpu"
-            and 1024 <= v <= self.config.fw_threshold
-            and 2 * len(sources) >= v
-            and graph.num_real_edges
-            < self.config.dense_min_density * v * v
-        )
+        (``solver.partitioned``, route tag ``condensed+fw``) — a view
+        over the :data:`SOLVER_PLANS` ``select()`` walk: True forces,
+        "auto" mirrors the TPU-gated auto routes (full-APSP-scale
+        source sets on sparse graphs in the blocked-FW size range, TPU
+        only — where the dense core replaces a gather-bound sweep with
+        MXU work), and a calibrated store can price either side past
+        the planner noise band."""
+        decision = self._solver_decision(graph, sources)
+        return decision.chosen.plan.name == "condensed+fw"
 
     def _try_condensed(
         self, graph: CSRGraph, sources: np.ndarray, stats: SolverStats,
-        predecessors: bool, tel,
+        predecessors: bool, tel, decision=None,
     ) -> SolveResult | None:
         """One condensed solve attempt. Returns None to hand the solve
         back to the standard route (auto-route failure, or the pred tree
@@ -595,20 +704,27 @@ class ParallelJohnsonSolver:
                 edges_relaxed=info["macs"],
                 route=info["route"],
                 cost=cost,
-                # Solver-level plan note (ISSUE 14): the condensed
-                # family's decision + its resolved auto-tuned
+                # Solver-level plan note (ISSUE 14/19): the condensed
+                # family's SELECT decision + its resolved auto-tuned
                 # parameters (fw_tile, partition_parts) land in the
                 # kind:"plan" record like every registry plan's.
-                plan={
-                    "chosen": "condensed+fw",
-                    "reason": (
-                        "solver-level qualification (forced)"
-                        if self.config.partitioned is True else
-                        "solver-level qualification: TPU + sparse + "
-                        "full-APSP scale in the blocked-FW size range"
-                    ),
-                    "params": dict(info.get("params") or {}),
-                },
+                plan=(
+                    {
+                        **decision.as_dict(),
+                        "params": dict(info.get("params") or {}),
+                    }
+                    if decision is not None else
+                    {
+                        "chosen": "condensed+fw",
+                        "reason": (
+                            "solver-level qualification (forced)"
+                            if self.config.partitioned is True else
+                            "solver-level qualification: TPU + sparse + "
+                            "full-APSP scale in the blocked-FW size range"
+                        ),
+                        "params": dict(info.get("params") or {}),
+                    }
+                ),
             ),
             phase="fanout",
         )

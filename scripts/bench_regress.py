@@ -41,12 +41,44 @@ def _load_module(rel: str, name: str):
     a log-analysis box in well under a second."""
     spec = importlib.util.spec_from_file_location(name, _REPO / rel)
     mod = importlib.util.module_from_spec(spec)
+    # Register before exec: dataclasses resolves cls.__module__ through
+    # sys.modules while building fields (planner.py's Plan/PlanDecision).
+    sys.modules[name] = mod
     spec.loader.exec_module(mod)
     return mod
 
 
 regress = _load_module("paralleljohnson_tpu/observe/regress.py", "pj_regress")
 store_mod = _load_module("paralleljohnson_tpu/observe/store.py", "pj_store")
+planner_mod = _load_module("paralleljohnson_tpu/planner.py", "pj_planner")
+
+
+def _demote_tuned(flag: dict, store_dir: str) -> str | None:
+    """Auto-demotion (ISSUE 19): a ``kind: "tune"`` flag means a
+    promoted knob value's fresh probes regressed past the same band
+    that justified its promotion — append an ``event: "demote"``
+    record so the resolver (observe.tuning) stops trusting every
+    measurement of that value at or before this instant and falls back
+    to the seed. Returns the demotion why-line, or None when the flag
+    lacks the fields a demotion record needs."""
+    detail = flag.get("detail") or {}
+    knob, value = flag.get("knob"), flag.get("value")
+    nodes, edges = detail.get("nodes"), detail.get("edges")
+    if not knob or value is None or not nodes:
+        return None
+    why = (
+        f"probe regressed {flag['slowdown']:.2f}x past the "
+        f"{flag['band']:.0%} tuning band vs its {flag['history_n']}-run "
+        f"median {flag['baseline_s']:.4f}s — demoted to seed"
+    )
+    store_mod.ProfileStore(store_dir).append(planner_mod.tune_record(
+        knob=knob, value=value,
+        platform=flag.get("platform", "unknown"),
+        num_nodes=int(nodes), num_edges=int(edges or 0),
+        plan=detail.get("plan"), event="demote", reason=why,
+        label="bench-regress",
+    ))
+    return why
 
 
 def _default_history() -> str:
@@ -176,6 +208,13 @@ def main(argv: list[str] | None = None) -> int:
     graded = sum(
         1 for r in fresh if isinstance(r.get("wall_s"), (int, float))
     )
+    # Auto-demotion (ISSUE 19) applies in BOTH output modes: the demote
+    # record lands once, here, and the flag carries the why-line.
+    for f in flagged:
+        if f.get("kind") == "tune":
+            f["demoted"] = _demote_tuned(
+                f, args.profile_store or args.history
+            )
     if args.as_json:
         print(json.dumps({
             "graded": graded, "history_rows": len(history),
@@ -212,6 +251,22 @@ def main(argv: list[str] | None = None) -> int:
                     f"{f['reroute_lapse_s']:.2f}s kill-to-reroute vs "
                     f"median {f['baseline_lapse_s']:.2f}s over "
                     f"{f['history_n']} runs ({f['slowdown']:.2f}x)"
+                )
+                continue
+            if f.get("kind") == "tune":
+                # Tuned-knob regression (ISSUE 19): a promoted value's
+                # fresh probes no longer justify the promotion — print
+                # the why-line; the demotion record already landed (the
+                # resolver honors the marker immediately).
+                why = f.get("demoted")
+                print(
+                    f"  REGRESSION (tune) {key}: knob "
+                    f"{f['knob']}={f['value']!r} probed "
+                    f"{f['wall_s']:.4f}s vs median "
+                    f"{f['baseline_s']:.4f}s over {f['history_n']} "
+                    f"runs ({f['slowdown']:.2f}x)"
+                    + (f" — {why}" if why
+                       else " — demotion skipped (incomplete record)")
                 )
                 continue
             if f.get("kind") == "size":

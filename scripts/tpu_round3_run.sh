@@ -300,6 +300,14 @@ run 1200 jax-dirty-window python -m paralleljohnson_tpu.cli bench dirty_window -
 #     noise band), distances bitwise-checked per route
 run 1200 jax-planner-dispatch python -m paralleljohnson_tpu.cli bench planner_dispatch --backend jax --preset full --update-baseline BASELINE.md
 
+# 4m2) self-proposing tuner bench (ISSUE 19 tentpole): zero-budget
+#      tune is bitwise-identical to no tuner at all, then budgeted
+#      probes propose+measure the FW tile candidates under a hard
+#      per-probe cap, promote the winner past the 25% band, and the
+#      next auto dispatch resolves it (bitwise vs forced; provenance
+#      reports tuner-promoted) — the first ON-CHIP probe calibration
+run 1200 jax-planner-tuning python -m paralleljohnson_tpu.cli bench planner_tuning --backend jax --preset full --update-baseline BASELINE.md
+
 # 4n) certified approximate tier (ISSUE 17 tentpole): exact vs
 #     hopset+bf at eps in {0.1, 0.5} on the corridor lattice — detail
 #     carries construction/query walls, the hopset edge count, and the

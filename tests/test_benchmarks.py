@@ -20,8 +20,8 @@ from paralleljohnson_tpu import benchmarks
     "name",
     [
         pytest.param(n, marks=pytest.mark.slow)
-        if n in ("dirty_window", "planner_dispatch", "serve_overload",
-                 "serve_fleet")
+        if n in ("dirty_window", "planner_dispatch", "planner_tuning",
+                 "serve_overload", "serve_fleet")
         else n
         for n in sorted(benchmarks.CONFIGS)
     ],

@@ -294,8 +294,8 @@ def test_regress_ingests_plan_records_idempotently(tmp_path):
 
 
 def test_empty_store_resolves_every_hand_tuned_fallback(tmp_path):
-    """The acceptance contract: all five parameters fall back to the
-    hand-tuned constants when the profile store is empty."""
+    """The acceptance contract: every tunable parameter falls back to
+    the hand-tuned constant when the profile store is empty."""
     from paralleljohnson_tpu.observe.tuning import (
         DEFAULT_FW_TILE,
         DEFAULT_PIPELINE_DEPTH,
@@ -310,6 +310,7 @@ def test_empty_store_resolves_every_hand_tuned_fallback(tmp_path):
         "delta": 0.5,
         "source_batch": 64,
         "pipeline_depth": DEFAULT_PIPELINE_DEPTH,
+        "approx_beta": 6,
     }
     assert set(fallbacks) == set(TUNABLE_PARAMS)
     for name, fb in fallbacks.items():

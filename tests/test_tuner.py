@@ -1,0 +1,539 @@
+"""Self-proposing planner (ISSUE 19): the budgeted probe tuner, its
+idle-capacity lease farm, and the select()-walk parity of the dispatch
+branches the round converted (solver condensed/standard, repair vs
+resolve, serve shed tiers).
+
+The three module invariants (tuner.py docstring) are pinned here with an
+injected ``solve_fn`` whose walls are deliberate sleeps — no kernel
+compiles, so the whole file rides the fast tier:
+
+* the probe budget is a hard wall (a censored value is structurally
+  unpromotable — its measurements never reach the store);
+* candidate proposals are deterministic per (bucket, seed, measured-set);
+* promotion stays behind the single 25% calibrated-challenger band
+  (within-band walls leave the seed standing);
+* zero bucket budget never opens the store.
+"""
+
+import json
+import time
+import types
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import planner as _planner
+from paralleljohnson_tpu.config import SolverConfig
+from paralleljohnson_tpu.distributed.coordinator import (
+    Coordinator,
+    StaleLeaseError,
+)
+from paralleljohnson_tpu.graphs import load_graph
+from paralleljohnson_tpu.observe.store import ProfileStore
+from paralleljohnson_tpu.observe.tuning import tuned_value
+from paralleljohnson_tpu.tuner import (
+    KNOB_SPECS,
+    declared_tunables,
+    harvest_tuning,
+    plan_tuning_fleet,
+    propose_candidates,
+    run_probe,
+    run_tuning_worker,
+    try_tuning_lease,
+    tune_bucket,
+    tunable_knobs,
+)
+
+SPEC = "er:n=64,p=0.1,seed=1"
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return load_graph(SPEC)
+
+
+def _sleeper(walls: dict):
+    """A fake probe whose wall is a deliberate sleep keyed by the
+    candidate value the probe config carries (fw_tile here)."""
+
+    def fn(graph, sources, cfg):
+        time.sleep(walls[cfg.fw_tile])
+
+    return fn
+
+
+def _records(store_dir):
+    return ProfileStore(store_dir).records()
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_every_declared_knob_has_a_spec():
+    """The work list is DERIVED from the plan registries; every knob a
+    Plan declares must be probeable (module-level assert's test twin)."""
+    declared = {knob for _plan, knob in declared_tunables()}
+    assert declared <= set(KNOB_SPECS)
+    assert tunable_knobs()  # at least one plan declares something
+
+
+# -- deterministic proposals -------------------------------------------------
+
+
+def test_propose_candidates_deterministic(graph):
+    kw = dict(num_nodes=graph.num_nodes, num_edges=graph.num_real_edges,
+              platform="cpu")
+    a = propose_candidates("fw_tile", **kw)
+    b = propose_candidates("fw_tile", **kw)
+    assert a == b
+    seed = KNOB_SPECS["fw_tile"].seed(
+        SolverConfig(), graph.num_nodes, graph.num_real_edges
+    )
+    assert a[0] == seed  # the config seed always leads
+    # Measured values sink behind untried ones, deterministically.
+    recs = [_planner.tune_record(
+        knob="fw_tile", value=a[-1], platform="cpu",
+        num_nodes=graph.num_nodes, num_edges=graph.num_real_edges,
+        wall_s=0.1,
+    )]
+    c = propose_candidates("fw_tile", records=recs, **kw)
+    d = propose_candidates("fw_tile", records=recs, **kw)
+    assert c == d
+    assert set(c) == set(a)
+    if len(a) > 1 and a[-1] != c[0]:
+        assert c[-1] == a[-1]  # the measured value moved to the back
+
+
+def test_propose_candidates_rejects_invalid_seed_shapes(graph):
+    # Every proposal passes the knob's resolve-time validator — the
+    # tuner never probes a value dispatch would refuse to trust.
+    for knob, spec in KNOB_SPECS.items():
+        cands = propose_candidates(
+            knob, num_nodes=graph.num_nodes,
+            num_edges=graph.num_real_edges, platform="cpu",
+        )
+        assert cands, knob
+        if spec.validate is not None:
+            assert all(spec.validate(c) for c in cands), knob
+
+
+# -- budgeted probes ---------------------------------------------------------
+
+
+def test_probe_budget_is_a_hard_wall(graph, tmp_path):
+    """A probe that outlives its cap is censored within ~the cap, lands
+    ONLY the censored audit record, and its value can never promote."""
+    store = ProfileStore(tmp_path / "store")
+    t0 = time.perf_counter()
+    res = run_probe(
+        graph, knob="fw_tile", value=256, store=store, budget_s=0.3,
+        solve_fn=_sleeper({256: 30.0}),
+    )
+    elapsed = time.perf_counter() - t0
+    assert res.censored and res.wall_s is None
+    assert "budget" in res.reason
+    assert elapsed < 5.0  # nowhere near the 30s sleep
+    recs = _records(tmp_path / "store")
+    assert len(recs) == 1 and recs[0]["censored"] is True
+    # Censored-only evidence is structurally unpromotable.
+    assert tuned_value(
+        "fw_tile", store_dir=str(tmp_path / "store"), platform=recs[0][
+            "platform"], num_nodes=graph.num_nodes,
+        num_edges=graph.num_real_edges, fallback=128,
+    ) is None
+
+
+def test_probe_error_is_censored_not_raised(graph, tmp_path):
+    store = ProfileStore(tmp_path / "store")
+
+    def boom(graph, sources, cfg):
+        raise RuntimeError("probe exploded")
+
+    res = run_probe(graph, knob="fw_tile", value=256, store=store,
+                    budget_s=5.0, solve_fn=boom)
+    assert res.censored and "probe exploded" in res.reason
+    (rec,) = _records(tmp_path / "store")
+    assert rec["censored"] is True
+
+
+def test_probe_rejects_invalid_candidate(graph, tmp_path):
+    with pytest.raises(ValueError, match="invalid candidate"):
+        run_probe(graph, knob="fw_tile", value=100,  # not a 128-multiple
+                  store=ProfileStore(tmp_path / "s"), budget_s=1.0)
+
+
+# -- tune_bucket: band gate, censoring, zero budget --------------------------
+
+
+def test_zero_budget_never_opens_the_store(graph, tmp_path):
+    store_dir = tmp_path / "store"
+    summary = tune_bucket(
+        graph, store_dir=store_dir, knobs=["fw_tile"],
+        candidates={"fw_tile": [128, 256]}, bucket_budget_s=0.0,
+        solve_fn=_sleeper({128: 0.01, 256: 0.01}),
+    )
+    assert summary["probes"] == 0
+    assert summary["skipped"] == "zero tuning budget"
+    assert not store_dir.exists()
+
+
+def test_no_promotion_within_noise_band(graph, tmp_path):
+    """The challenger measures faster — but inside the 25% band, so the
+    hand-tuned seed stands (winner None, nothing pinned)."""
+    summary = tune_bucket(
+        graph, store_dir=tmp_path / "store",
+        config=SolverConfig(fw_tile=512),
+        knobs=["fw_tile"], candidates={"fw_tile": [512, 640]},
+        probe_budget_s=30.0, bucket_budget_s=60.0,
+        solve_fn=_sleeper({512: 0.30, 640: 0.27}),
+    )
+    knob = summary["knobs"]["fw_tile"]
+    assert knob["seed"] == 512
+    assert knob["winner"] is None
+    assert knob["promoted"] is False
+
+
+def test_promotion_past_the_band(graph, tmp_path):
+    summary = tune_bucket(
+        graph, store_dir=tmp_path / "store",
+        config=SolverConfig(fw_tile=512),
+        knobs=["fw_tile"], candidates={"fw_tile": [512, 640]},
+        probe_budget_s=30.0, bucket_budget_s=60.0,
+        solve_fn=_sleeper({512: 0.30, 640: 0.02}),
+    )
+    knob = summary["knobs"]["fw_tile"]
+    assert knob["winner"] == 640 and knob["promoted"] is True
+    # The promoted value resolves for dispatch in the same bucket.
+    recs = _records(tmp_path / "store")
+    assert tuned_value(
+        "fw_tile", store_dir=str(tmp_path / "store"),
+        platform=recs[0]["platform"], num_nodes=graph.num_nodes,
+        num_edges=graph.num_real_edges, fallback=512,
+    ) == 640
+
+
+def test_censored_challenger_never_promotes(graph, tmp_path):
+    """The challenger would be 'fastest' if its kill counted — the cap
+    censors it, so only the seed is measured and nothing promotes."""
+    summary = tune_bucket(
+        graph, store_dir=tmp_path / "store",
+        config=SolverConfig(fw_tile=512),
+        knobs=["fw_tile"], candidates={"fw_tile": [512, 640]},
+        probe_budget_s=0.3, bucket_budget_s=60.0, max_rungs=0,
+        solve_fn=_sleeper({512: 0.02, 640: 30.0}),
+    )
+    knob = summary["knobs"]["fw_tile"]
+    assert summary["censored"] >= 1
+    assert knob["winner"] is None and knob["promoted"] is False
+    values_measured = {
+        r["value"] for r in _records(tmp_path / "store")
+        if r.get("kind") == "tune" and not r.get("censored")
+    }
+    assert 640 not in values_measured
+
+
+def test_unknown_knob_raises(graph, tmp_path):
+    with pytest.raises(ValueError, match="unknown knob"):
+        tune_bucket(graph, store_dir=tmp_path / "s", knobs=["warp_drive"])
+
+
+# -- idle-capacity lease farm ------------------------------------------------
+
+
+def _fleet(tmp_path, graph, **kw):
+    kw.setdefault("knobs", ["fw_tile"])
+    kw.setdefault("candidates", {"fw_tile": [256, 384]})
+    kw.setdefault("probe_budget_s", 5.0)
+    return plan_tuning_fleet(
+        tmp_path / "fleet", graph_spec=SPEC, graph=graph, **kw
+    )
+
+
+def test_tuning_lease_crash_requeues_and_second_worker_commits(
+        graph, tmp_path):
+    """The round-15 crash contract, for tuning leases: a claimed lease
+    whose worker dies (no heartbeat, deadline lapses) requeues; the
+    survivor's commit wins; the dead worker's late commit is stale; and
+    harvest merges ONLY the committed shard."""
+    coord = _fleet(tmp_path, graph, lease_deadline_s=5.0)
+    assert len(coord.leases()) == 1  # cold store: both candidates fit
+
+    # wA claims, probes into its shard, then "crashes" before commit.
+    stale_coord = Coordinator(tmp_path / "fleet")
+    lease = stale_coord.claim("wA", now=100.0)
+    assert lease is not None and lease.owner == "wA"
+    shard_a = ProfileStore(
+        stale_coord.shard_dir("wA") / f"tune-lease{lease.lease_id}"
+    )
+    run_probe(graph, knob="fw_tile", value=256, store=shard_a,
+              budget_s=5.0, label="tuner:wA",
+              solve_fn=_sleeper({256: 0.01}))
+
+    # No heartbeat, past the deadline: the lease requeues.
+    events = stale_coord.reap(now=200.0)
+    assert [e["ev"] for e in events] == ["requeued"]
+
+    # The idle hook on a healthy worker claims the requeued lease,
+    # probes both candidates, and commits.
+    result = try_tuning_lease(
+        tmp_path / "fleet", "wB", graph=graph,
+        solve_fn=_sleeper({256: 0.01, 384: 0.01}),
+    )
+    assert result is not None and result["lease"] == lease.lease_id
+    assert len(result["probes"]) == 2
+    committed = Coordinator(tmp_path / "fleet").leases()[0]
+    assert committed.state == "committed"
+    assert committed.committed_by == "wB"
+
+    # The dead incarnation's late commit is rejected, not merged.
+    with pytest.raises(StaleLeaseError):
+        stale_coord.commit(lease.lease_id, "wA", now=300.0)
+
+    # Harvest reads the COMMITTED worker's shard only: every merged
+    # record carries wB's probe label, never the crashed wA's.
+    out = harvest_tuning(tmp_path / "fleet", tmp_path / "store")
+    assert out["leases_harvested"] == 1 and out["records"] > 0
+    labels = {r.get("label") for r in _records(tmp_path / "store")}
+    assert labels == {"tuner:wB"}
+
+
+def test_harvest_is_idempotent(graph, tmp_path):
+    _fleet(tmp_path, graph)
+    run_tuning_worker(
+        tmp_path / "fleet", "w0", graph=graph,
+        solve_fn=_sleeper({256: 0.01, 384: 0.01}),
+    )
+    first = harvest_tuning(tmp_path / "fleet", tmp_path / "store")
+    assert first["leases_harvested"] == 1 and first["fleet_done"]
+    n = len(_records(tmp_path / "store"))
+    second = harvest_tuning(tmp_path / "fleet", tmp_path / "store")
+    assert second["leases_harvested"] == 0
+    assert second["total_harvested"] == first["total_harvested"]
+    assert len(_records(tmp_path / "store")) == n
+
+
+def test_try_tuning_lease_ignores_non_tuning_dirs(graph, tmp_path):
+    # Not a coordinator at all.
+    assert try_tuning_lease(tmp_path / "nope", "w0", graph=graph) is None
+    # A real coordinator, but a SOLVE fleet: the idle hook must not
+    # steal solve leases as if they were tuning jobs.
+    Coordinator.create(
+        tmp_path / "solve", graph_spec=SPEC, graph_digest="d" * 16,
+        num_sources=8, lease_sources=4,
+    )
+    assert try_tuning_lease(tmp_path / "solve", "w0", graph=graph) is None
+
+
+def test_tuning_fleet_refuses_wrong_graph(graph, tmp_path):
+    """The digest guard: measurements from a different graph than the
+    fleet planned for must never land."""
+    _fleet(tmp_path, graph)
+    other = load_graph("er:n=48,p=0.1,seed=2")
+    assert try_tuning_lease(tmp_path / "fleet", "w0", graph=other) is None
+    assert Coordinator(tmp_path / "fleet").leases()[0].state == "pending"
+
+
+def test_probe_failure_inside_lease_still_commits(graph, tmp_path):
+    """A probe that blows up is censored IN-PROBE (evidence discarded,
+    audit record kept) — the lease itself still commits: a bad
+    candidate must not wedge the farm."""
+    _fleet(tmp_path, graph)
+
+    def boom(graph, sources, cfg):
+        raise RuntimeError("bad candidate")
+
+    result = try_tuning_lease(tmp_path / "fleet", "w0", graph=graph,
+                              solve_fn=boom)
+    assert result is not None
+    assert all(p["censored"] for p in result["probes"])
+    assert Coordinator(tmp_path / "fleet").leases()[0].state == "committed"
+
+
+def test_lease_error_releases_for_retry(graph, tmp_path, monkeypatch):
+    """An error in the lease LOOP itself (outside the probe sandbox)
+    releases the lease so another worker can retry it."""
+    from paralleljohnson_tpu import tuner as tuner_mod
+
+    _fleet(tmp_path, graph)
+
+    def broken_probe(*a, **kw):
+        raise OSError("shard store unwritable")
+
+    monkeypatch.setattr(tuner_mod, "run_probe", broken_probe)
+    with pytest.raises(OSError, match="unwritable"):
+        try_tuning_lease(tmp_path / "fleet", "w0", graph=graph)
+    assert Coordinator(tmp_path / "fleet").leases()[0].state == "pending"
+
+
+# -- select() parity for the converted dispatch branches ---------------------
+
+
+def test_condensed_select_parity_unpriced(graph):
+    """The solver-level condensed/standard branch through SOLVER_PLANS:
+    unpriced on CPU the auto walk picks standard (condensed is
+    TPU-gated), and the partitioned flag still pins either side."""
+    from paralleljohnson_tpu.solver.johnson import ParallelJohnsonSolver
+
+    sources = np.arange(graph.num_nodes, dtype=np.int64)
+    auto = ParallelJohnsonSolver(SolverConfig(profile_store=None))
+    decision = auto._solver_decision(graph, sources)
+    assert decision.chosen.plan.name == "standard"
+    assert auto._use_partitioned(graph, sources) is False
+
+    forced = ParallelJohnsonSolver(
+        SolverConfig(partitioned=True, profile_store=None)
+    )
+    assert forced._use_partitioned(graph, sources) is True
+    pinned = ParallelJohnsonSolver(
+        SolverConfig(partitioned=False, profile_store=None)
+    )
+    assert pinned._use_partitioned(graph, sources) is False
+
+
+def test_repair_select_parity_unpriced(graph, tmp_path):
+    """The repair-vs-resolve branch through REPAIR_PLANS: unpriced auto
+    always chooses repair (the pre-ISSUE-19 behavior); the strategy
+    flag pins either side through the ordinary forced-plan pin."""
+    from paralleljohnson_tpu.incremental.repair import (
+        decide_repair_strategy,
+    )
+
+    report = types.SimpleNamespace(
+        old_digest="0" * 16, new_digest="1" * 16,
+        changed_edges=np.zeros((0, 3)),
+    )
+    cfg = SolverConfig(profile_store=None)
+    auto = decide_repair_strategy(
+        tmp_path / "ckpt", graph, report, config=cfg,
+    )
+    assert auto.chosen.plan.name == "repair"
+    assert auto.params["affected_rows_estimate"] == graph.num_nodes
+
+    resolve = decide_repair_strategy(
+        tmp_path / "ckpt", graph, report, config=cfg, strategy="resolve",
+    )
+    assert resolve.chosen.plan.name == "resolve"
+    with pytest.raises(ValueError, match="auto/repair/resolve"):
+        decide_repair_strategy(
+            tmp_path / "ckpt", graph, report, config=cfg, strategy="yolo",
+        )
+
+
+def _shed_select(engine, policy):
+    from paralleljohnson_tpu.serve.frontend import SHED_PLANS, _SHED_MODES
+
+    decision = _planner.select(
+        SHED_PLANS,
+        types.SimpleNamespace(engine=engine, params={}),
+        platform="cpu", num_edges=1000, batch=1,
+        config=types.SimpleNamespace(shed_policy=policy),
+    )
+    return _SHED_MODES[decision.chosen.plan.name], decision
+
+
+def test_shed_plans_tier_order_and_pins():
+    """SHED_PLANS (satellite 1): declared tier order when unpriced
+    (hopset > landmark > reject), explicit policies as forced pins, and
+    the stale plan NEVER chosen — its disqualification is structural."""
+    full = types.SimpleNamespace(hopset=object(), landmarks=object())
+    no_hopset = types.SimpleNamespace(hopset=None, landmarks=object())
+    bare = types.SimpleNamespace(hopset=None, landmarks=None)
+
+    assert _shed_select(full, "priced")[0] == "hopset"
+    assert _shed_select(no_hopset, "priced")[0] == "approx"
+    assert _shed_select(bare, "priced")[0] == "reject"
+    # Explicit policies are forced pins through the same walk.
+    assert _shed_select(full, "reject")[0] == "reject"
+    assert _shed_select(full, "landmark")[0] == "approx"
+    assert _shed_select(no_hopset, "hopset")[0] != "hopset"  # can't force absent tier
+    # The stale tier is declared (visible in every decision record with
+    # its honest reason) but never servable.
+    for engine in (full, no_hopset, bare):
+        for policy in ("priced", "hopset", "landmark", "reject"):
+            mode, decision = _shed_select(engine, policy)
+            assert decision.chosen.plan.name != "stale"
+            stale = [c for c in decision.as_dict()["candidates"]
+                     if c["plan"] == "stale"]
+            assert stale and not stale[0]["qualified"]
+
+
+def test_tune_records_are_regression_rows(graph, tmp_path):
+    """kind:"tune" records normalize into bench rows keyed per (knob,
+    pow2 bucket, value) — the satellite-5 ingestion path bench_regress
+    grades under the tuning band."""
+    from paralleljohnson_tpu.observe import regress
+
+    store = ProfileStore(tmp_path / "store")
+    run_probe(graph, knob="fw_tile", value=256, store=store,
+              budget_s=5.0, solve_fn=_sleeper({256: 0.01}))
+    rows = []
+    for rec in _records(tmp_path / "store"):
+        rows.extend(regress.normalize_record(rec, source="test"))
+    tune_rows = [r for r in rows if (r.get("detail") or {}).get("knob")]
+    assert len(tune_rows) == 1
+    row = tune_rows[0]
+    assert row["bench"].startswith("tune:fw_tile:")
+    assert row["preset"] == "256"
+    assert row["wall_s"] > 0
+    # Censored probes are NOT measurements: they never become rows.
+    run_probe(graph, knob="fw_tile", value=384, store=store,
+              budget_s=0.2, solve_fn=_sleeper({384: 30.0}))
+    rows2 = []
+    for rec in _records(tmp_path / "store"):
+        rows2.extend(regress.normalize_record(rec, source="test"))
+    assert len([r for r in rows2
+                if (r.get("detail") or {}).get("knob")]) == 1
+
+
+def test_tune_regression_demotes_to_seed(graph, tmp_path):
+    """The full satellite-5 loop in-process: history of good probes, a
+    regressed fresh probe past the 25% tune band, detect_regressions
+    flags it as kind 'tune', and the demote record flips the resolver
+    back to the seed."""
+    from paralleljohnson_tpu.observe import regress
+
+    store_dir = tmp_path / "store"
+    store = ProfileStore(store_dir)
+    recs = []
+    for wall in (0.20, 0.21, 0.20):
+        recs.append(_planner.tune_record(
+            knob="fw_tile", value=640, platform="cpu",
+            num_nodes=graph.num_nodes, num_edges=graph.num_real_edges,
+            plan="fw", wall_s=wall,
+        ))
+    recs.append(_planner.tune_record(
+        knob="fw_tile", value=512, platform="cpu",
+        num_nodes=graph.num_nodes, num_edges=graph.num_real_edges,
+        plan="fw", wall_s=0.90,
+    ))
+    for r in recs:
+        store.append(r)
+    kw = dict(platform="cpu", num_nodes=graph.num_nodes,
+              num_edges=graph.num_real_edges, fallback=512)
+    assert tuned_value("fw_tile", store_dir=str(store_dir), **kw) == 640
+
+    history = [row for rec in recs
+               for row in regress.normalize_record(rec, source="hist")]
+    fresh_rec = _planner.tune_record(
+        knob="fw_tile", value=640, platform="cpu",
+        num_nodes=graph.num_nodes, num_edges=graph.num_real_edges,
+        plan="fw", wall_s=0.80,  # 4x the 0.20 median
+    )
+    (flag,) = regress.detect_regressions(
+        regress.normalize_record(fresh_rec, source="fresh"), history,
+        min_history=3,
+    )
+    assert flag["kind"] == "tune"
+    assert flag["knob"] == "fw_tile" and flag["value"] == 640
+    assert flag["band"] == regress.DEFAULT_TUNE_BAND == 0.25
+    assert flag["slowdown"] > 1.25
+
+    # The demotion record (what bench_regress appends) erases the
+    # promoted value's history: dispatch falls back to the seed.
+    store.append(_planner.tune_record(
+        knob="fw_tile", value=640, platform="cpu",
+        num_nodes=graph.num_nodes, num_edges=graph.num_real_edges,
+        plan="fw", event="demote", reason="regressed past tune band",
+        label="bench-regress",
+    ))
+    assert tuned_value("fw_tile", store_dir=str(store_dir), **kw) is None
