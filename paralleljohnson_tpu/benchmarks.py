@@ -1508,7 +1508,15 @@ def bench_serve_fleet(backend: str, preset: str) -> BenchRecord:
       solve's matrix — a misrouted query is only colder, never wrong;
     - the per-replica latency histograms merge into one service-level
       SLO verdict (:func:`observe.top.gather_ops` fleet view) which must
-      be in-SLO for the row to pass.
+      be in-SLO for the row to pass;
+    - request tracing end to end (ISSUE 20): router + every replica run
+      with flight recorders, the kill-survivor probe's answer must
+      assemble (``observe.trace.assemble``) into ONE single-rooted
+      timeline spanning router and replica, at least one trace must show
+      the retry hop (a ``forward`` span with ``attempt >= 2``) across
+      the kill, and a post-drill query for the one deliberately
+      unsolved source must carry the scheduled ``serve_solve`` in its
+      assembled trace.
 
     Violations land in ``detail["failed"]`` (the row is the assertion)."""
     import os as _os
@@ -1554,13 +1562,17 @@ def bench_serve_fleet(backend: str, preset: str) -> BenchRecord:
     with tempfile.TemporaryDirectory() as td:
         fleet_dir = Path(td) / "fleet"
         store_dir = Path(td) / "store"
-        # Pre-solve the full checkpoint once; every replica serves it
-        # cold/warm so non-shed answers are bitwise-reproducible.
+        trace_root = Path(td) / "trace"
+        # Pre-solve the checkpoint once; every replica serves it
+        # cold/warm so non-shed answers are bitwise-reproducible. Source
+        # n-1 is deliberately left UNSOLVED (clients never query it):
+        # the post-drill solve probe queries it so its assembled trace
+        # must contain the scheduled serve_solve hop (ISSUE 20).
         seed_store = TileStore(str(store_dir), g, hot_rows=max(8, n // 8),
                                warm_rows=n)
         seed_engine = QueryEngine(g, seed_store, config=cfg,
                                   stats_interval_s=0)
-        seed_engine.warm(np.arange(n))
+        seed_engine.warm(np.arange(n - 1))
         seed_engine.close()
 
         env = dict(_os.environ)
@@ -1584,7 +1596,8 @@ def bench_serve_fleet(backend: str, preset: str) -> BenchRecord:
                  "--replica-id", f"replica-{i}",
                  "--replica-heartbeat", str(heartbeat_s),
                  "--slo-p99-ms", "2000",
-                 "--stats-interval", "0.5"],
+                 "--stats-interval", "0.5",
+                 "--trace-dir", str(trace_root / f"replica-{i}")],
                 env=env, stdout=_subprocess.PIPE,
                 stderr=_subprocess.DEVNULL, text=True)
             line = p.stdout.readline()
@@ -1597,6 +1610,7 @@ def bench_serve_fleet(backend: str, preset: str) -> BenchRecord:
             return p, ann
 
         router = None
+        router_tel = None
         t0 = time.perf_counter()
         try:
             anns = []
@@ -1604,10 +1618,15 @@ def bench_serve_fleet(backend: str, preset: str) -> BenchRecord:
                 p, ann = spawn_replica(i)
                 procs.append(p)
                 anns.append(ann)
+            from paralleljohnson_tpu.utils.telemetry import Telemetry
+
+            router_tel = Telemetry.create(
+                trace_dir=trace_root / "router", label="router")
             router = FleetRouter(
                 str(fleet_dir), stale_after_s=stale_after_s,
                 refresh_interval_s=heartbeat_s / 2,
                 retry_after_ms=25,
+                telemetry=router_tel,
             ).start()
             host, port = router.address()
             table = router.table
@@ -1654,7 +1673,9 @@ def bench_serve_fleet(backend: str, preset: str) -> BenchRecord:
                         delay = sent / rate - elapsed
                         if delay > 0:
                             time.sleep(delay)
-                        src = int(crng.integers(n))
+                        # n-1 is the reserved never-solved source — the
+                        # solve probe's, not client traffic's.
+                        src = int(crng.integers(n - 1))
                         dst = int(crng.integers(n))
                         f.write(json.dumps(
                             {"id": sent, "source": src, "dst": dst,
@@ -1773,6 +1794,36 @@ def bench_serve_fleet(backend: str, preset: str) -> BenchRecord:
             if answered == 0:
                 failures.append("no exact answers at all — dead fleet")
 
+            # -- the scheduled-solve probe (ISSUE 20) -------------------
+            # Source n-1 was never pre-solved and no client queried it:
+            # this one query forces the owning replica to schedule a
+            # solve, whose serve_solve span must land in the assembled
+            # trace below.
+            solve_probe_trace = None
+            try:
+                sock = _socket.create_connection((host, port), timeout=15)
+                sock.settimeout(15)
+                f = sock.makefile("rw", encoding="utf-8", newline="\n")
+                json.loads(f.readline())
+                f.write(json.dumps({"id": "solve-probe",
+                                    "source": n - 1, "dst": 0}) + "\n")
+                f.flush()
+                resp = json.loads(f.readline())
+                f.close()
+                sock.close()
+                solve_probe_trace = resp.get("trace_id")
+                if resp.get("error") is not None:
+                    failures.append(f"solve probe errored: {resp}")
+                elif not resp.get("shed"):
+                    want = float(exact[n - 1, 0])
+                    if float(resp["distance"]) != want:
+                        failures.append(
+                            f"solve-probe answer not bitwise: "
+                            f"{resp['distance']} != {want}")
+            except (OSError, ValueError) as e:
+                failures.append(
+                    f"solve probe failed: {type(e).__name__}: {e}")
+
             # -- merged fleet verdict (the top/slo_report view) ---------
             time.sleep(2 * heartbeat_s)  # let final heartbeats land
             doc = gather_ops(serve_fleet=fleet_dir,
@@ -1794,6 +1845,8 @@ def bench_serve_fleet(backend: str, preset: str) -> BenchRecord:
         finally:
             if router is not None:
                 router.drain()
+            if router_tel is not None:
+                router_tel.close()
             for p in procs:
                 if p.poll() is None:
                     p.send_signal(_signal.SIGTERM)
@@ -1802,6 +1855,76 @@ def bench_serve_fleet(backend: str, preset: str) -> BenchRecord:
                     p.wait(timeout=20)
                 except _subprocess.TimeoutExpired:
                     p.kill()
+
+        # -- assembled request traces (ISSUE 20) ------------------------
+        # Every process on the request path flushed its own flight
+        # JSONL (the SIGKILLed victim's may end in a torn line — the
+        # loader tolerates exactly that); the join must reconstruct
+        # end-to-end causality: the kill-survivor probe as ONE
+        # single-rooted timeline spanning router + replica, a visible
+        # retry hop, and the solve probe's scheduled serve_solve.
+        from paralleljohnson_tpu.observe.trace import assemble
+
+        try:
+            asm = assemble([trace_root])
+        except (OSError, ValueError) as e:
+            failures.append(f"trace assembly failed: {e}")
+            asm = {"processes": [], "traces": {}}
+        traces = asm["traces"]
+        probe_tid = (lapse_box.get("resp") or {}).get("trace_id")
+        ptr = traces.get(probe_tid) if probe_tid else None
+        if ptr is None:
+            failures.append(
+                "kill-survivor probe answer carried no assemblable "
+                f"trace (trace_id={probe_tid!r})")
+        else:
+            if not ptr["single_rooted"]:
+                failures.append(
+                    f"probe trace {probe_tid} not single-rooted: "
+                    f"roots={ptr['roots']} "
+                    f"unresolved={ptr['unresolved']}")
+            if ("router" not in ptr["processes"]
+                    or len(ptr["processes"]) < 2):
+                failures.append(
+                    "probe trace does not span router + replica: "
+                    f"{ptr['processes']}")
+        retry_tids = [
+            tid for tid, t in traces.items()
+            if any(s["name"] == "forward"
+                   and (s["attrs"].get("attempt") or 1) >= 2
+                   for s in t["spans"])
+        ]
+        if not retry_tids:
+            failures.append(
+                "no assembled trace shows the retry hop (a forward "
+                "span with attempt >= 2) across the kill")
+        elif not any(traces[tid]["single_rooted"] for tid in retry_tids):
+            failures.append(
+                "no retried request reconstructed into a single "
+                "parented trace")
+        stp = traces.get(solve_probe_trace) if solve_probe_trace else None
+        if stp is None:
+            failures.append(
+                "solve probe carried no assemblable trace "
+                f"(trace_id={solve_probe_trace!r})")
+        elif not any(s["name"] == "serve_solve" for s in stp["spans"]):
+            failures.append(
+                "solve-probe trace missing the scheduled serve_solve "
+                f"span: {[s['name'] for s in stp['spans']]}")
+
+        # The drill's tempdir dies with this function; PJ_FLEET_TRACE_OUT
+        # preserves the raw flight dirs so the round-3 pass can re-run
+        # the offline assembler (`trace-assemble` stage) on real fleet
+        # recordings and stage the per-hop regression rows.
+        keep = _os.environ.get("PJ_FLEET_TRACE_OUT")
+        if keep:
+            import shutil as _shutil
+
+            _shutil.rmtree(keep, ignore_errors=True)
+            try:
+                _shutil.copytree(trace_root, keep)
+            except OSError:
+                pass
 
         detail = {
             "nodes": n, "edges": g.num_real_edges,
@@ -1825,6 +1948,12 @@ def bench_serve_fleet(backend: str, preset: str) -> BenchRecord:
             "slo": merged.get("slo"),
             "verdict": merged.get("verdict"),
             "router": dict(router.stats),
+            "traces_assembled": len(traces),
+            "traces_single_rooted": sum(
+                1 for t in traces.values() if t["single_rooted"]),
+            "retry_traces": len(retry_tids),
+            "probe_trace": probe_tid,
+            "solve_probe_trace": solve_probe_trace,
         }
         if failures:
             detail["failed"] = failures[:10]

@@ -241,6 +241,17 @@ def _add_observability(p: argparse.ArgumentParser) -> None:
                         "pjtpu_roofline_bound{kind=...}, ...) for "
                         "scrape-based monitoring (default: "
                         "$PJ_METRICS_FILE if set)")
+    p.add_argument("--trace-sample", type=float,
+                   default=(float(os.environ["PJ_TRACE_SAMPLE"])
+                            if os.environ.get("PJ_TRACE_SAMPLE") else None),
+                   metavar="RATE",
+                   help="head-based request-trace sampling rate in [0, 1] "
+                        "(ISSUE 20, serve/router modes): the FIRST ingress "
+                        "mints a trace_id and decides once, deterministically "
+                        "(sha256 of the id), whether the whole request chain "
+                        "is recorded; downstream hops honor the wire verdict "
+                        "(default: $PJ_TRACE_SAMPLE; else 1.0 when "
+                        "--trace-dir is set, 0 otherwise)")
     p.add_argument("--profile-store",
                    default=os.environ.get("PJ_PROFILE_DIR"),
                    metavar="DIR",
@@ -1150,9 +1161,30 @@ def main(argv: list[str] | None = None) -> int:
                     "--heartbeat-interval": _dc_heartbeat_default,
                     "--metrics-file": "Prometheus textfile export "
                                       "(pjtpu_* counters/gauges)",
+                    "--trace-sample": "head-based request-trace sampling "
+                                      "rate for serve/router ingress "
+                                      "(default 1.0 when --trace-dir is "
+                                      "set, 0 otherwise; the verdict "
+                                      "travels the wire so downstream "
+                                      "hops never re-decide)",
                 },
                 "env_defaults": ["PJ_TRACE_DIR", "PJ_HEARTBEAT_FILE",
-                                 "PJ_HEARTBEAT_INTERVAL", "PJ_METRICS_FILE"],
+                                 "PJ_HEARTBEAT_INTERVAL", "PJ_METRICS_FILE",
+                                 "PJ_TRACE_SAMPLE"],
+                "request_tracing": {
+                    "ingress": "router or replica — whichever sees the "
+                               "request first mints trace_id and samples "
+                               "once; the wire context ({'trace': {'id', "
+                               "'parent', 'sampled'}}) threads every hop",
+                    "spans": ["route_request", "forward", "serve_request",
+                              "convoy_batch", "convoy_member", "query",
+                              "serve_solve", "device_megabatch",
+                              "shed_decision"],
+                    "assembler": "python scripts/trace_assemble.py "
+                                 "DIR... [--perfetto-dir OUT] [--check]",
+                    "request_tree": "python scripts/trace_summary.py "
+                                    "--request TRACE_ID DIR...",
+                },
                 "offline_reader": "python scripts/trace_summary.py "
                                   "<flight.jsonl> [--chrome trace.json]",
                 "hung_vs_progressing": (
@@ -1912,6 +1944,8 @@ def main(argv: list[str] | None = None) -> int:
                                    if args.replica_stale is not None
                                    else 5.0),
                     retry_after_ms=args.retry_after_ms,
+                    telemetry=_telemetry(args, label="router"),
+                    trace_sample=args.trace_sample,
                 ).start()
                 table = router.table
                 print(json.dumps({
@@ -2042,6 +2076,7 @@ def main(argv: list[str] | None = None) -> int:
                     replica_id=args.replica_id,
                     fleet_heartbeat_s=args.replica_heartbeat,
                     tune_dir=args.tune_dir,
+                    trace_sample=args.trace_sample,
                 ).start()
                 # The announce line scripts/chaos drills parse for the
                 # bound (possibly ephemeral) port.

@@ -87,6 +87,10 @@ def run_worker(
     from paralleljohnson_tpu.config import SolverConfig
     from paralleljohnson_tpu.graphs import load_graph
     from paralleljohnson_tpu.observe.live import MetricsRegistry
+    from paralleljohnson_tpu.observe.trace import (
+        current_trace_id,
+        trace_attrs as _trace_attrs,
+    )
     from paralleljohnson_tpu.solver import ParallelJohnsonSolver
     from paralleljohnson_tpu.utils.checkpoint import graph_digest
     from paralleljohnson_tpu.utils.telemetry import Telemetry
@@ -172,7 +176,8 @@ def run_worker(
                         if tel:
                             tel.event("tuning_lease", worker=worker_id,
                                       lease=tuned["lease"],
-                                      probes=len(tuned["probes"]))
+                                      probes=len(tuned["probes"]),
+                                      **_trace_attrs())
                         idle_since = None
                         continue
                 # Outstanding leases belong to other workers; they will
@@ -203,9 +208,13 @@ def run_worker(
 
                 os.kill(os.getpid(), signal.SIGKILL)
             if tel:
+                # ISSUE 20: leases claimed on behalf of a traced update
+                # carry the originating trace id so the assembler can
+                # join worker flights into the request's timeline.
                 tel.event("lease_claimed", worker=worker_id,
                           lease=lease.lease_id,
-                          start=lease.start, stop=lease.stop)
+                          start=lease.start, stop=lease.stop,
+                          **_trace_attrs())
                 tel.progress(worker=worker_id, lease=lease.lease_id,
                              lease_range=[lease.start, lease.stop])
             try:
@@ -217,7 +226,8 @@ def run_worker(
                     coord.release(lease.lease_id, worker_id, reason="error")
                     if tel:
                         tel.event("lease_requeued", worker=worker_id,
-                                  lease=lease.lease_id, reason="error")
+                                  lease=lease.lease_id, reason="error",
+                                  **_trace_attrs())
                 except StaleLeaseError:
                     pass
                 raise
@@ -231,19 +241,20 @@ def run_worker(
                 metrics.counter("pjtpu_lease_stale_commits").add(1)
                 if tel:
                     tel.event("lease_stale_commit", worker=worker_id,
-                              lease=lease.lease_id)
+                              lease=lease.lease_id, **_trace_attrs())
                 continue
             # Claim-to-commit wall: what a lease actually costs this
             # worker (solve + checkpoint + coordinator round trips) —
             # the number lease sizing will be priced against.
-            lease_hist.record((time.perf_counter() - t_claim) * 1e3)
+            lease_hist.record((time.perf_counter() - t_claim) * 1e3,
+                              exemplar=current_trace_id())
             metrics.counter("pjtpu_leases_committed").add(1)
             summary["leases_committed"].append(lease.lease_id)
             summary["sources_solved"] += lease.stop - lease.start
             summary["edges_relaxed"] += int(res.stats.edges_relaxed)
             if tel:
                 tel.event("lease_committed", worker=worker_id,
-                          lease=lease.lease_id)
+                          lease=lease.lease_id, **_trace_attrs())
                 tel.progress(leases_committed=len(summary["leases_committed"]))
     except BaseException as e:
         summary["rc"] = 1

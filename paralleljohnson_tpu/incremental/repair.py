@@ -78,6 +78,7 @@ from paralleljohnson_tpu.utils.checkpoint import (
     graph_digest,
 )
 from paralleljohnson_tpu.observe.live import resolve_metrics as _resolve_metrics
+from paralleljohnson_tpu.observe.trace import trace_attrs as _trace_attrs
 from paralleljohnson_tpu.utils.telemetry import resolve as _resolve_telemetry
 
 ROUTE_TAG = "incremental-repair"
@@ -560,7 +561,8 @@ def prepare_repair(
         return plan
 
     v = graph.num_nodes
-    with tel.span("repair_prepare", changed=report.num_changed):
+    with tel.span("repair_prepare", changed=report.num_changed,
+                  **_trace_attrs()):
         # Conservative staleness from the first moment repair work runs;
         # refined to the exact affected set once closures land.
         repair_status.write_repair_status(
@@ -868,7 +870,10 @@ def repair_checkpoint(
         checkpoint_dir, graph, updates, config=cfg, state=state,
         num_parts=num_parts, seed=seed,
     )
-    with plan.tel.span("repair", changed=plan.report.num_changed):
+    # A repair driven on behalf of a traced update request joins that
+    # request's timeline (ISSUE 20); {} on every untraced/offline path.
+    with plan.tel.span("repair", changed=plan.report.num_changed,
+                       **_trace_attrs()):
         result = execute_repair(plan)
     if decision is not None:
         result.plan = decision.as_dict(built="repair")

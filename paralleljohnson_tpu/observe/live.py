@@ -62,6 +62,12 @@ DEFAULT_LO = 1e-3
 DEFAULT_HI = 1e7
 DEFAULT_GROWTH = 2.0 ** 0.25
 
+# Per-bucket exemplar bound (ISSUE 20): each occupied bucket keeps the
+# LAST K (trace_id, value) pairs recorded into it, so "p99 = 38 ms"
+# comes with concrete request traces to assemble — bounded memory no
+# matter how many samples flow through (K * occupied buckets entries).
+DEFAULT_EXEMPLAR_K = 4
+
 
 class LogHistogram:
     """Log-bucketed streaming histogram with bounded-error percentiles.
@@ -79,7 +85,8 @@ class LogHistogram:
     """
 
     __slots__ = ("lo", "hi", "growth", "_log_growth", "n_buckets",
-                 "_counts", "count", "sum", "min", "max", "_lock")
+                 "_counts", "count", "sum", "min", "max", "_lock",
+                 "exemplar_k", "_exemplars")
 
     def __init__(self, *, lo: float = DEFAULT_LO, hi: float = DEFAULT_HI,
                  growth: float = DEFAULT_GROWTH) -> None:
@@ -102,6 +109,9 @@ class LogHistogram:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        # bucket index -> last-K [(exemplar_id, value), ...] (ISSUE 20).
+        self.exemplar_k = DEFAULT_EXEMPLAR_K
+        self._exemplars: dict[int, list] = {}
         self._lock = threading.Lock()
 
     # -- geometry ----------------------------------------------------------
@@ -131,7 +141,11 @@ class LogHistogram:
 
     # -- recording ---------------------------------------------------------
 
-    def record(self, v: float) -> None:
+    def record(self, v: float, exemplar: str | None = None) -> None:
+        """Record one sample; ``exemplar`` (a trace_id) rides into the
+        sample's bucket, displacing the oldest of that bucket's last-K
+        — how a latency histogram keeps concrete traces per bucket
+        without unbounded growth."""
         v = float(v)
         if math.isnan(v):
             return  # a NaN latency is a caller bug, never a bin
@@ -145,6 +159,11 @@ class LogHistogram:
                 self.min = v
             if v > self.max:
                 self.max = v
+            if exemplar is not None:
+                ex = self._exemplars.setdefault(i, [])
+                ex.append((str(exemplar), v))
+                if len(ex) > self.exemplar_k:
+                    del ex[0]
 
     def record_many(self, values) -> None:
         for v in values:
@@ -163,6 +182,7 @@ class LogHistogram:
             counts = dict(other._counts)
             o_count, o_sum = other.count, other.sum
             o_min, o_max = other.min, other.max
+            o_ex = {i: list(ex) for i, ex in other._exemplars.items()}
         with self._lock:
             for i, c in counts.items():
                 self._counts[i] = self._counts.get(i, 0) + c
@@ -170,6 +190,11 @@ class LogHistogram:
             self.sum += o_sum
             self.min = min(self.min, o_min)
             self.max = max(self.max, o_max)
+            for i, oex in o_ex.items():
+                ex = self._exemplars.setdefault(i, [])
+                ex.extend(oex)
+                if len(ex) > self.exemplar_k:
+                    del ex[: len(ex) - self.exemplar_k]
         return self
 
     # -- percentiles -------------------------------------------------------
@@ -251,6 +276,38 @@ class LogHistogram:
         out.append((math.inf, total))
         return out
 
+    def bucket_exemplars(self) -> dict:
+        """Latest exemplar per occupied bucket, keyed by the bucket's
+        Prometheus ``le`` edge (the same edges
+        :meth:`cumulative_buckets` emits; the overflow bucket maps to
+        the ``+Inf`` edge): ``{le: (exemplar_id, value)}``. Feeds the
+        OpenMetrics exemplar suffix in
+        ``telemetry.write_prom_metrics(..., exemplars=True)``."""
+        with self._lock:
+            ex = {i: list(v) for i, v in self._exemplars.items()}
+        out = {}
+        for i, pairs in ex.items():
+            if not pairs:
+                continue
+            _, upper = self.bucket_bounds(i)
+            out[upper] = pairs[-1]
+        return out
+
+    def tail_exemplars(self, limit: int = DEFAULT_EXEMPLAR_K) -> list:
+        """``[(exemplar_id, value), ...]`` from the slowest occupied
+        buckets downward (newest first within a bucket) — the "show me
+        the traces behind the p99" accessor ``pjtpu top`` and
+        ``slo_report.py`` render."""
+        with self._lock:
+            ex = sorted(self._exemplars.items(), reverse=True)
+        out: list = []
+        for _i, pairs in ex:
+            for pair in reversed(pairs):
+                out.append(tuple(pair))
+                if len(out) >= limit:
+                    return out
+        return out
+
     def as_dict(self) -> dict:
         with self._lock:
             return {
@@ -263,6 +320,10 @@ class LogHistogram:
                 "sum": self.sum,
                 "min": None if self.min is math.inf else self.min,
                 "max": None if self.max == -math.inf else self.max,
+                **({"exemplars": {str(i): [[e, v] for e, v in ex]
+                                  for i, ex in
+                                  sorted(self._exemplars.items())}}
+                   if self._exemplars else {}),
             }
 
     @classmethod
@@ -275,6 +336,10 @@ class LogHistogram:
         h.sum = float(d.get("sum", 0.0))
         h.min = math.inf if d.get("min") is None else float(d["min"])
         h.max = -math.inf if d.get("max") is None else float(d["max"])
+        h._exemplars = {
+            int(i): [(str(e), float(v)) for e, v in ex][-h.exemplar_k:]
+            for i, ex in (d.get("exemplars") or {}).items()
+        }
         return h
 
     def summary(self, pcts=(50, 99)) -> dict:
@@ -291,6 +356,21 @@ class LogHistogram:
             "hist": self.as_dict(),
         }
         return out
+
+
+def tail_exemplars_from_dict(hist_dict: dict | None,
+                             limit: int = DEFAULT_EXEMPLAR_K) -> list:
+    """:meth:`LogHistogram.tail_exemplars` over the serialized
+    ``as_dict`` form — what ``pjtpu top`` / ``slo_report.py`` render
+    straight from a snapshot JSON without rebuilding the histogram."""
+    ex = (hist_dict or {}).get("exemplars") or {}
+    out: list = []
+    for i in sorted((int(k) for k in ex), reverse=True):
+        for pair in reversed(ex[str(i)]):
+            out.append((str(pair[0]), float(pair[1])))
+            if len(out) >= limit:
+                return out
+    return out
 
 
 class RateCounter:
@@ -735,11 +815,17 @@ class _NullHistogram:
     count = 0
     sum = 0.0
 
-    def record(self, v):
+    def record(self, v, exemplar=None):
         return None
 
     def record_many(self, values):
         return None
+
+    def bucket_exemplars(self):
+        return {}
+
+    def tail_exemplars(self, limit=4):
+        return []
 
     def percentile(self, p):
         return {"value": 0.0, "lower": 0.0, "upper": 0.0, "max_error": 0.0}

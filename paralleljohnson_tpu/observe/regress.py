@@ -63,6 +63,16 @@ DEFAULT_REROUTE_ABS_FLOOR_S = 0.5
 # loaded standalone by scripts, without the package).
 DEFAULT_TUNE_BAND = 0.25
 
+# Trace-hop band (ISSUE 20): assembled per-hop request-trace p50s
+# (``scripts/trace_assemble.py --regress-out`` rows) grade the serving
+# path hop by hop — a silently doubled convoy queue-wait flags with the
+# hop NAMED even when the end-to-end bench wall absorbs it. Queue waits
+# are quantised by the batch-window clock and walls are ms-scale, so
+# the band is wide (50%) with small absolute floors.
+DEFAULT_TRACE_BAND = 0.50
+DEFAULT_TRACE_ABS_FLOOR_S = 0.01
+DEFAULT_TRACE_QUEUE_ABS_FLOOR_MS = 2.0
+
 # Hopset size band (ISSUE 17): a hopset's edge count is a DETERMINISTIC
 # function of (graph, ε, k, β, seed, picker) — same shape bucket, same
 # knobs, fatter hopset means the construction changed, not the weather.
@@ -279,6 +289,36 @@ def _hopset_rows(obj: dict, source: str | None) -> list[dict]:
     }]
 
 
+def _trace_hop_rows(obj: dict, source: str | None) -> list[dict]:
+    """Rows from ``kind: "trace"`` assembler output (ISSUE 20): one
+    per-hop p50 from ``scripts/trace_assemble.py --regress-out``. The
+    row keys as ``trace:<bench>:<hop>`` so every hop (forward /
+    serve_request / convoy_member / query / device_megabatch / ...)
+    accumulates its own baseline; the graded axes are the hop's p50
+    wall and — where the hop records it — the p50 convoy queue wait."""
+    hop = obj.get("hop")
+    wall = obj.get("wall_s")
+    if not hop or not isinstance(wall, (int, float)) or wall < 0:
+        return []
+    detail: dict = {
+        "hop": str(hop),
+        "count": obj.get("count"),
+        "open": obj.get("open"),
+    }
+    qw = obj.get("queue_wait_p50_ms")
+    if isinstance(qw, (int, float)):
+        detail["queue_wait_p50_ms"] = float(qw)
+    return [{
+        "bench": f"trace:{obj.get('bench')}:{hop}",
+        "backend": obj.get("backend", "unknown"),
+        "platform": obj.get("platform", "unknown"),
+        "preset": obj.get("preset"),
+        "wall_s": float(wall),
+        "detail": detail,
+        "source": source,
+    }]
+
+
 def normalize_record(obj: dict, *, source: str | None = None) -> list[dict]:
     """Normalize ONE parsed measurement object into history rows.
 
@@ -287,7 +327,8 @@ def normalize_record(obj: dict, *, source: str | None = None) -> list[dict]:
     a driver metric payload (metric/value/detail); the committed
     ``BENCH_r0*.json`` wrapper (its ``parsed`` field is the payload);
     a profile store's ``kind: "plan"`` planner-decision record or
-    ``kind: "hopset"`` construction record.
+    ``kind: "hopset"`` construction record; the trace assembler's
+    ``kind: "trace"`` per-hop p50 rows (ISSUE 20).
     Unrecognized objects yield [] — ingestion skips, never crashes."""
     if not isinstance(obj, dict):
         return []
@@ -297,6 +338,8 @@ def normalize_record(obj: dict, *, source: str | None = None) -> list[dict]:
         return _tune_rows(obj, source)
     if obj.get("kind") == "hopset":
         return _hopset_rows(obj, source)
+    if obj.get("kind") == "trace":
+        return _trace_hop_rows(obj, source)
     if "bench" in obj and "wall_s" in obj:
         row = dict(obj)
         row.setdefault("source", source)
@@ -421,14 +464,29 @@ def detect_regressions(
     rows carrying ``detail.reroute_lapse_s`` are graded on the
     kill-to-reroute lapse (``kind: "reroute"``) under a wide band with
     a heartbeat-clock absolute floor — a slower failover flags the gate
-    even when the bench wall is quiet."""
+    even when the bench wall is quiet. Trace-hop rows (``detail.hop``,
+    ISSUE 20) grade per hop on p50 wall and p50 convoy queue wait
+    (``kind: "trace"``, why-line names the hop)."""
     by_key: dict[tuple, list[float]] = {}
     iters_by_key: dict[tuple, list[int]] = {}
     size_by_key: dict[tuple, list[int]] = {}
     reroute_by_key: dict[tuple, list[float]] = {}
     tune_by_key: dict[tuple, list[float]] = {}
+    trace_wall_by_key: dict[tuple, list[float]] = {}
+    trace_queue_by_key: dict[tuple, list[float]] = {}
     for row in history:
         w = row.get("wall_s")
+        if (row.get("detail") or {}).get("hop"):
+            if isinstance(w, (int, float)) and w > 0:
+                trace_wall_by_key.setdefault(
+                    history_key(row), []
+                ).append(float(w))
+            qw = (row.get("detail") or {}).get("queue_wait_p50_ms")
+            if isinstance(qw, (int, float)) and qw > 0:
+                trace_queue_by_key.setdefault(
+                    history_key(row), []
+                ).append(float(qw))
+            continue
         if (row.get("detail") or {}).get("knob"):
             if isinstance(w, (int, float)) and w > 0:
                 tune_by_key.setdefault(history_key(row), []).append(float(w))
@@ -447,9 +505,69 @@ def detect_regressions(
     flagged = []
     for row in fresh:
         w = row.get("wall_s")
+        detail = row.get("detail") or {}
+        if detail.get("hop"):
+            # Trace-hop rows (ISSUE 20) grade ONLY under the trace band
+            # against their own (trace:<bench>:<hop>) history — on the
+            # hop's p50 wall AND, where recorded, the convoy's p50
+            # queue wait. The flag names the hop so a silently doubled
+            # convoy wait arrives pre-attributed to the hop, not just
+            # to a slower end-to-end bench.
+            hop = detail["hop"]
+            key = history_key(row)
+            whist = trace_wall_by_key.get(key)
+            if (
+                isinstance(w, (int, float)) and w > 0
+                and whist and len(whist) >= min_history
+            ):
+                wbase = statistics.median(whist)
+                if (
+                    w > wbase * (1.0 + DEFAULT_TRACE_BAND)
+                    and (w - wbase) > DEFAULT_TRACE_ABS_FLOOR_S
+                ):
+                    flagged.append({
+                        **row,
+                        "kind": "trace",
+                        "hop": hop,
+                        "axis": "wall",
+                        "baseline_s": wbase,
+                        "slowdown": w / wbase,
+                        "band": DEFAULT_TRACE_BAND,
+                        "history_n": len(whist),
+                        "why": (
+                            f"hop '{hop}' p50 wall {w * 1e3:.2f}ms vs "
+                            f"median {wbase * 1e3:.2f}ms"
+                        ),
+                    })
+            qw = detail.get("queue_wait_p50_ms")
+            qhist = trace_queue_by_key.get(key)
+            if (
+                isinstance(qw, (int, float)) and qw > 0
+                and qhist and len(qhist) >= min_history
+            ):
+                qbase = statistics.median(qhist)
+                if (
+                    qw > qbase * (1.0 + DEFAULT_TRACE_BAND)
+                    and (qw - qbase) > DEFAULT_TRACE_QUEUE_ABS_FLOOR_MS
+                ):
+                    flagged.append({
+                        **row,
+                        "kind": "trace",
+                        "hop": hop,
+                        "axis": "queue_wait",
+                        "queue_wait_p50_ms": float(qw),
+                        "baseline_queue_wait_ms": qbase,
+                        "slowdown": qw / qbase,
+                        "band": DEFAULT_TRACE_BAND,
+                        "history_n": len(qhist),
+                        "why": (
+                            f"hop '{hop}' p50 convoy queue-wait "
+                            f"{qw:.2f}ms vs median {qbase:.2f}ms"
+                        ),
+                    })
+            continue
         if not isinstance(w, (int, float)) or w <= 0:
             continue
-        detail = row.get("detail") or {}
         if detail.get("knob"):
             # Tuned-knob probe rows (ISSUE 19) grade ONLY under the
             # tuning band against their own (knob, value, bucket)

@@ -74,6 +74,7 @@ def _summarize_live(live: dict | None) -> dict:
             rates[name]["total"] = c.get("total")
     if rates:
         out["rates"] = rates
+    from paralleljohnson_tpu.observe.live import tail_exemplars_from_dict
     hists = {}
     for name, h in (live.get("histograms") or {}).items():
         hists[name] = {
@@ -82,6 +83,12 @@ def _summarize_live(live: dict | None) -> dict:
                       "p99_ms", "p99_err_ms", "max")
             if k in h
         }
+        # Tail exemplars (ISSUE 20): the trace ids behind the slowest
+        # buckets, so the p99 number links straight to a request tree.
+        tail = tail_exemplars_from_dict(h.get("hist"))
+        if tail:
+            hists[name]["tail_exemplars"] = [
+                {"trace_id": e, "ms": round(v, 3)} for e, v in tail]
     if hists:
         out["histograms"] = hists
     slos = {}
@@ -325,6 +332,10 @@ def _gather_serve_fleet(fleet_dir: Path, now: float,
             k: round(v, 4)
             for k, v in merged_hist.percentiles((50, 99)).items()
         })
+        tail = merged_hist.tail_exemplars()
+        if tail:
+            merged["tail_exemplars"] = [
+                {"trace_id": e, "ms": round(v, 3)} for e, v in tail]
     slo: dict = {"burning": burning, "bad_total": slo_bad,
                  "events_total": slo_events}
     if slo_events > 0:
@@ -467,6 +478,15 @@ def _render_serve(lines: list[str], entries: list[dict]) -> None:
                 f"batch-width p50 {_fmt(s.get('batch_width_p50'))} "
                 f"p99 {_fmt(s.get('batch_width_p99'))}"
             )
+        # Tail exemplar line (ISSUE 20): trace ids behind the slowest
+        # latency buckets — feed them to `scripts/trace_summary.py
+        # --request ID` for the full span tree.
+        tail = (((live.get("histograms") or {})
+                 .get("pjtpu_query_latency_ms") or {}).get("tail_exemplars"))
+        if tail:
+            lines.append(
+                "  tail traces " + "  ".join(
+                    f"{t['trace_id']}@{_fmt(t['ms'])}ms" for t in tail))
         for name, slo in (live.get("slos") or {}).items():
             lat = slo.get("latency") or {}
             verdict = "BURNING" if slo.get("burning") else "ok"
@@ -517,6 +537,11 @@ def _render_serve_fleet(lines: list[str], doc: dict) -> None:
             f"rejected {_fmt(counters.get('rejected'))}   "
             f"client-limited {_fmt(counters.get('client_limited'))}"
         )
+        tail = merged.get("tail_exemplars") or []
+        if tail:
+            lines.append(
+                "  tail traces " + "  ".join(
+                    f"{t['trace_id']}@{_fmt(t['ms'])}ms" for t in tail))
     if slo:
         lines.append(
             f"  SLO fleet: {'BURNING' if slo.get('burning') else 'ok'} "

@@ -37,6 +37,13 @@ invalidates it (stable row -> tile-slot mapping in between). Stale rows
 are excluded at build: the kernel can never gather a row the host path
 would flag. All operand batches are padded to power-of-two lengths so
 the jit cache stays bounded under arbitrary client mixes.
+
+Request tracing (ISSUE 20): the engine wraps the whole launch group in
+one ``device_megabatch`` span tagged with every sampled ``trace`` that
+rides the launch — the kernels here stay trace-agnostic (pure jitted
+functions; threading ids through them would poison the jit cache), so
+per-request attribution of device time is the span's job, not the
+kernel's.
 """
 
 from __future__ import annotations

@@ -154,8 +154,12 @@ class ServeStats:
     hist: LogHistogram = dataclasses.field(default_factory=LogHistogram)
     batch_hist: LogHistogram = dataclasses.field(default_factory=LogHistogram)
 
-    def record_latency(self, ms: float) -> None:
-        self.hist.record(float(ms))
+    def record_latency(self, ms: float,
+                       exemplar: str | None = None) -> None:
+        # ``exemplar`` is the request's trace_id (ISSUE 20): it rides
+        # into the latency bucket so "p99 = 38 ms" links to concrete
+        # assembled traces (prom exemplars, `pjtpu top`, slo_report).
+        self.hist.record(float(ms), exemplar=exemplar)
 
     def percentiles(self) -> dict:
         """``{"p50_ms", "p50_err_ms", "p99_ms", "p99_err_ms"}`` — the
@@ -447,8 +451,19 @@ class QueryEngine:
             raise QueryError("mode 'approx' needs a landmark index")
         if mode == "hopset" and self.hopset is None:
             raise QueryError("mode 'hopset' needs an attached hopset")
+        # Trace passthrough (ISSUE 20): the wire context rides the
+        # request JSON; only a SAMPLED id tags spans/exemplars (an
+        # upstream ingress's head decision is final).
+        t = req.get("trace")
+        trace_id = None
+        if isinstance(t, dict):
+            if t.get("sampled", True) is not False:
+                tid = t.get("id")
+                trace_id = tid if isinstance(tid, str) else None
+        elif isinstance(t, str):
+            trace_id = t
         return {"id": req.get("id"), "source": source, "dsts": dsts,
-                "many": many, "mode": mode}
+                "many": many, "mode": mode, "trace": trace_id}
 
     # -- the serving loop ----------------------------------------------------
 
@@ -574,7 +589,20 @@ class QueryEngine:
                 missing_exact = still_missing
             if missing_exact:
                 batch = np.asarray(missing_exact, np.int64)
-                with tel.span("serve_solve", n_sources=len(batch)):
+                # The scheduled solve tagged with the traces it serves
+                # (ISSUE 20): a store miss's solve cost shows up IN the
+                # request's assembled timeline, not as anonymous work.
+                miss_set = set(missing_exact)
+                solve_traces = sorted({
+                    p["trace"] for p in parsed
+                    if p is not None and p.get("trace")
+                    and p["source"] in miss_set
+                })
+                extra = ({"trace": solve_traces[0],
+                          "traces": solve_traces[:8]}
+                         if solve_traces else {})
+                with tel.span("serve_solve", n_sources=len(batch),
+                              **extra):
                     self._fire_fault("serve_solve",
                                      batch=self.stats.batches_scheduled)
                     res = self.solver.solve(self.graph, sources=batch)
@@ -594,12 +622,15 @@ class QueryEngine:
             for i, p in enumerate(parsed):
                 if p is None:
                     continue
+                q_attrs = ({"trace": p["trace"]} if p.get("trace")
+                           else {})
                 with tel.span("query", source=p["source"],
-                              many=p["many"]):
+                              many=p["many"], **q_attrs):
                     responses[i] = self._answer(p, rows, pre.get(i))
                 self.stats.queries_total += 1
                 latency_ms = (time.perf_counter() - t_batch) * 1e3
-                self.stats.record_latency(latency_ms)
+                self.stats.record_latency(latency_ms,
+                                          exemplar=p.get("trace"))
                 self.metrics.counter("pjtpu_queries").add(1)
                 self.metrics.observe_slo(self.slo.name, latency_ms, ok=True)
             self.metrics.gauge("pjtpu_query_hit_rate",
@@ -723,32 +754,54 @@ class QueryEngine:
                     lmp_t.extend(int(d) for d in dsts)
         nonneg = (self.landmarks.nonnegative
                   if self.landmarks is not None else True)
-        if pair_q:
-            flat = dpath.exact_pairs(pair_slots, pair_dsts)
-            off = 0
-            for qi, seg in zip(pair_q, pair_seg):
-                pre[qi] = ("exact",
-                           np.asarray(flat[off:off + seg], np.float64))
-                off += seg
-        if row_q:
-            out = dpath.exact_rows(row_slots)
-            for j, qi in enumerate(row_q):
-                pre[qi] = ("exact", np.asarray(out[j], np.float64))
-        if lmp_q:
-            lo, up = dpath.landmark_pairs(lmp_s, lmp_t)
-            lo, up = widen_bounds(lo, up, nonnegative=nonneg)
-            est, err = finish_estimates(lo, up)
-            off = 0
-            for qi, seg in zip(lmp_q, lmp_seg):
-                pre[qi] = ("landmark", est[off:off + seg],
-                           err[off:off + seg])
-                off += seg
-        if lmr_q:
-            lo, up = dpath.landmark_rows(lmr_s)
-            for j, qi in enumerate(lmr_q):
-                wl, wu = widen_bounds(lo[j], up[j], nonnegative=nonneg)
-                est, err = finish_estimates(wl, wu)
-                pre[qi] = ("landmark", est, err)
+        if not (pair_q or row_q or lmp_q or lmr_q):
+            return pre
+        # The megabatch kernel launch as one span (ISSUE 20): tagged
+        # with every trace riding this launch, so an assembled timeline
+        # shows WHICH device launch served the request (and how wide it
+        # was — convoy width reaching the accelerator).
+        tel = self._tel
+        mb_attrs = {}
+        if tel.enabled:
+            mb_traces = sorted({
+                parsed[qi]["trace"]
+                for qi in (pair_q + row_q + lmp_q + lmr_q)
+                if parsed[qi] is not None and parsed[qi].get("trace")
+            })
+            if mb_traces:
+                mb_attrs = {"trace": mb_traces[0],
+                            "traces": mb_traces[:8]}
+        with tel.span("device_megabatch", pairs=len(pair_slots),
+                      rows=len(row_q), lm_pairs=len(lmp_s),
+                      lm_rows=len(lmr_q), **mb_attrs):
+            if pair_q:
+                flat = dpath.exact_pairs(pair_slots, pair_dsts)
+                off = 0
+                for qi, seg in zip(pair_q, pair_seg):
+                    pre[qi] = ("exact",
+                               np.asarray(flat[off:off + seg],
+                                          np.float64))
+                    off += seg
+            if row_q:
+                out = dpath.exact_rows(row_slots)
+                for j, qi in enumerate(row_q):
+                    pre[qi] = ("exact", np.asarray(out[j], np.float64))
+            if lmp_q:
+                lo, up = dpath.landmark_pairs(lmp_s, lmp_t)
+                lo, up = widen_bounds(lo, up, nonnegative=nonneg)
+                est, err = finish_estimates(lo, up)
+                off = 0
+                for qi, seg in zip(lmp_q, lmp_seg):
+                    pre[qi] = ("landmark", est[off:off + seg],
+                               err[off:off + seg])
+                    off += seg
+            if lmr_q:
+                lo, up = dpath.landmark_rows(lmr_s)
+                for j, qi in enumerate(lmr_q):
+                    wl, wu = widen_bounds(lo[j], up[j],
+                                          nonnegative=nonneg)
+                    est, err = finish_estimates(wl, wu)
+                    pre[qi] = ("landmark", est, err)
         return pre
 
     def _hopset_estimate(self, s, dsts):

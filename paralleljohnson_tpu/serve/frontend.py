@@ -65,6 +65,7 @@ import time
 import types
 
 from paralleljohnson_tpu import planner as _planner
+from paralleljohnson_tpu.observe import trace as _trace
 from paralleljohnson_tpu.serve.engine import (
     SERVE_LIVE_FILENAME,
     QueryError,
@@ -173,15 +174,20 @@ def parse_listen(spec: str) -> tuple[str, int]:
 
 
 class _BatchSlot:
-    """One request's place in a :class:`MicroBatcher` convoy."""
+    """One request's place in a :class:`MicroBatcher` convoy. Captures
+    the submitter's trace context and enqueue time at construction —
+    the leader executes on ANOTHER thread, so follower→leader span
+    linkage (ISSUE 20) must travel with the slot, not a contextvar."""
 
-    __slots__ = ("req", "resp", "exc", "done")
+    __slots__ = ("req", "resp", "exc", "done", "ctx", "t_submit")
 
-    def __init__(self, req: dict) -> None:
+    def __init__(self, req: dict, ctx=None) -> None:
         self.req = req
         self.resp: dict | None = None
         self.exc: BaseException | None = None
         self.done = False
+        self.ctx = ctx
+        self.t_submit = time.perf_counter()
 
 
 class MicroBatcher:
@@ -226,7 +232,7 @@ class MicroBatcher:
         """Answer one request through the combining pipeline. Blocks
         until the request's batch completes; raises whatever the engine
         raised for that batch."""
-        slot = _BatchSlot(req)
+        slot = _BatchSlot(req, _trace.current_trace())
         with self._lock:
             self._pending.append(slot)
         while not slot.done:
@@ -239,7 +245,7 @@ class MicroBatcher:
                     batch = self._pending[:self.max_width]
                     del self._pending[:len(batch)]
                 if batch:
-                    self._execute(batch)
+                    self._execute(batch, leader_slot=slot)
                 # FIFO take: our slot is served within ceil(pos/width)
                 # turns, every one of which does real work — no
                 # spinning, no starvation.
@@ -247,7 +253,42 @@ class MicroBatcher:
             raise slot.exc
         return slot.resp  # type: ignore[return-value]
 
-    def _execute(self, batch: list[_BatchSlot]) -> None:
+    def _execute(self, batch: list[_BatchSlot],
+                 leader_slot: "_BatchSlot | None" = None) -> None:
+        tel = getattr(self.engine, "_tel", None)
+        traced = ([s for s in batch if s.ctx is not None and s.ctx.sampled]
+                  if tel else [])
+        if not traced:
+            self._run_batch(batch)
+            return
+        # The convoy made visible (ISSUE 20): one ``convoy_batch`` span
+        # on the leader's thread (so the engine's serve_batch nests
+        # under it), plus one ``convoy_member`` span per traced slot,
+        # explicitly ``parent=``-linked to the batch span — a follower
+        # whose request rode someone else's batch still joins its own
+        # trace via the ``trace`` attr, and its queue wait (submit ->
+        # execution start) stops being invisible.
+        t_exec = time.perf_counter()
+        with tel.span("convoy_batch", width=len(batch),
+                      traced=len(traced)) as bs:
+            members = [
+                (s, tel.begin_span(
+                    "convoy_member", parent=bs.id, trace=s.ctx.trace_id,
+                    queue_wait_ms=round((t_exec - s.t_submit) * 1e3, 3),
+                    leader=(s is leader_slot),
+                ))
+                for s in traced
+            ]
+            try:
+                self._run_batch(batch)
+            finally:
+                for s, sid in members:
+                    if s.exc is not None:
+                        tel.finish_span(sid, "error", repr(s.exc))
+                    else:
+                        tel.finish_span(sid)
+
+    def _run_batch(self, batch: list[_BatchSlot]) -> None:
         try:
             responses = self.engine.query_batch([s.req for s in batch])
             for s, resp in zip(batch, responses):
@@ -288,7 +329,8 @@ class ServeFrontend:
                  http: bool = False,
                  fleet_dir=None, replica_id: str | None = None,
                  fleet_heartbeat_s: float = 1.0,
-                 tune_dir=None, tune_idle_s: float = 2.0) -> None:
+                 tune_dir=None, tune_idle_s: float = 2.0,
+                 trace_sample: float | None = None) -> None:
         if shed_policy not in SHED_POLICIES:
             raise ValueError(
                 f"shed_policy must be one of {SHED_POLICIES}, "
@@ -361,6 +403,15 @@ class ServeFrontend:
         self._tune_thread: threading.Thread | None = None
         self._registration = None
         self._tel = engine._tel
+        # Request tracing (ISSUE 20): with telemetry wired, this
+        # frontend is a trace ingress — it honors an upstream (router)
+        # wire context or mints its own, head-sampled at trace_sample
+        # (default: everything when a trace dir is configured, nothing
+        # otherwise — rate 0 keeps the request/answer bytes identical).
+        self.trace_sample = (
+            float(trace_sample) if trace_sample is not None
+            else (1.0 if self._tel else 0.0)
+        )
         self._tracker = engine.slo_tracker()
         self._inflight = threading.Semaphore(self.max_inflight)
         self._client_lock = threading.Lock()
@@ -874,9 +925,50 @@ class ServeFrontend:
         """Admission + answer for one parsed request object; always
         returns a response document, never raises. Shared by the JSONL
         socket path and the HTTP adaptation — one admission policy,
-        two framings."""
+        two framings.
+
+        Trace ingress (ISSUE 20): an upstream wire context
+        (``req["trace"]``) is honored — its head-sampling decision is
+        final — else one is minted at ``trace_sample``. A sampled
+        request runs inside a ``serve_request`` span (``wire_parent``
+        carries the router's forward-span ref for the assembler) with
+        the context installed for downstream hops (convoy, engine,
+        scheduled solves), and its response is stamped with
+        ``trace_id``. With the rate at 0 and no wire context, this
+        method IS the pre-trace code path: nothing minted, responses
+        byte-identical."""
         if req.get("op") == "health":
             return {"id": req.get("id"), **self.health()}
+        ctx = None
+        if self.trace_sample > 0.0 or _trace.WIRE_KEY in req:
+            ctx = _trace.ingress(
+                req, rate=self.trace_sample if self._tel else 0.0
+            )
+        if ctx is None or not ctx.sampled:
+            return self._admit(req, peer)
+        tel = self._tel
+        if not tel:
+            # Untraced replica behind a traced router: echo the id so
+            # the answer still joins its (router-side) timeline.
+            resp = self._admit(req, peer)
+            if isinstance(resp, dict):
+                resp.setdefault(_trace.RESPONSE_KEY, ctx.trace_id)
+            return resp
+        if req.get(_trace.WIRE_KEY) is None:
+            # Minted here: let the engine's per-query spans see the id.
+            req[_trace.WIRE_KEY] = {"id": ctx.trace_id}
+        attrs = {"trace": ctx.trace_id, "source": req.get("source")}
+        if ctx.parent:
+            attrs["wire_parent"] = ctx.parent
+        with tel.span("serve_request", **attrs), _trace.use_trace(ctx):
+            resp = self._admit(req, peer)
+            if isinstance(resp, dict):
+                if resp.get("error") is not None:
+                    tel.event("request_error", error=str(resp["error"]))
+                resp.setdefault(_trace.RESPONSE_KEY, ctx.trace_id)
+            return resp
+
+    def _admit(self, req: dict, peer: str | None = None) -> dict:
         req_id = req.get("id")
         if self._draining.is_set():
             self._count_rejection()
@@ -958,6 +1050,16 @@ class ServeFrontend:
                 pass  # malformed: the engine's parser owns the error
             if not is_hit:
                 shed_to = self._shed_mode()
+                tel = self._tel
+                tid = _trace.current_trace_id() if tel else None
+                if tid:
+                    # The shed decision as a first-class span (ISSUE 20
+                    # satellite): the chaos drill asserts a shed answer
+                    # reconstructs with this decision point visible.
+                    tel.finish_span(tel.begin_span(
+                        "shed_decision", trace=tid,
+                        policy=self.shed_policy, mode=shed_to,
+                    ))
                 if shed_to == "reject":
                     self._count_rejection()
                     return {"id": req_id, "error": "overloaded",

@@ -13,6 +13,17 @@ explicit ``{"error": "unavailable", "retry_after_ms": ...}``. Replicas
 whose heartbeat goes stale-by-age are ejected by the background refresh
 even with no traffic aimed at them. Because any replica can serve any
 source, failover can only make an answer colder, never wrong.
+
+Request tracing (ISSUE 20): when constructed with ``telemetry`` the
+router is the fleet's first ingress — it mints a ``trace_id`` per
+request (head-sampled at ``trace_sample``), wraps routing in a
+``route_request`` span and each upstream attempt in a ``forward`` span,
+and injects the wire context (``{"trace": {"id", "parent"}}``) into the
+forwarded line with the *forward span's* global ref as the replica's
+parent — so a failover retry shows up in the assembled timeline as two
+``forward`` hops (the first status=error) under one ``route_request``.
+Replies remain verbatim: the REPLICA stamps ``trace_id`` into the
+answer document, the router never rewrites it.
 """
 
 from __future__ import annotations
@@ -23,7 +34,9 @@ import socket
 import threading
 import time
 
+from paralleljohnson_tpu.observe import trace as _trace
 from paralleljohnson_tpu.serve import fleet as _fleet
+from paralleljohnson_tpu.utils import telemetry as _telemetry
 
 PROTOCOL = "pjtpu-serve/1"  # same wire protocol as serve.frontend
 
@@ -52,8 +65,18 @@ class FleetRouter:
         connect_timeout_s: float = DEFAULT_CONNECT_TIMEOUT_S,
         io_timeout_s: float = DEFAULT_IO_TIMEOUT_S,
         refresh_interval_s: float = DEFAULT_REFRESH_INTERVAL_S,
+        telemetry=None,
+        trace_sample: float | None = None,
     ) -> None:
         self.fleet_dir = fleet_dir
+        self._tel = _telemetry.resolve(telemetry)
+        # Default sample rate: trace everything when telemetry is wired
+        # (a trace dir was configured), nothing otherwise — the ISSUE 20
+        # contract. The untraced path never parses/mints anything.
+        self.trace_sample = (
+            float(trace_sample) if trace_sample is not None
+            else (1.0 if self._tel else 0.0)
+        )
         self.host = host
         self.port = int(port)
         self.stale_after_s = float(stale_after_s)
@@ -294,24 +317,69 @@ class FleetRouter:
             return {"error": f"bad request line: {exc}"}
         if req.get("op") == "health":
             return self.health()
+        tel = self._tel
+        ctx = None
+        if tel and self.trace_sample > 0.0:
+            ctx = _trace.ingress(req, rate=self.trace_sample)
+        if ctx is None:
+            # Tracing off at this router: forward the line untouched (a
+            # client-supplied wire context, if any, rides through to the
+            # replica — bitwise-identical requests, the PR-5 guarantee).
+            return self._forward(upstreams, req, line, None, None)
+        if not ctx.sampled:
+            # Head sampling declined this trace: downstream must not
+            # re-mint, so the verdict still travels the wire — but no
+            # spans open anywhere.
+            if req.get(_trace.WIRE_KEY) is None:
+                line = json.dumps({**req, _trace.WIRE_KEY: ctx.to_wire()})
+            return self._forward(upstreams, req, line, None, None)
+        span_attrs = {"trace": ctx.trace_id, "source": str(req.get("source"))}
+        if ctx.parent:
+            span_attrs["wire_parent"] = ctx.parent
+        with tel.span("route_request", **span_attrs):
+            return self._forward(upstreams, req, line, ctx, tel)
+
+    def _forward(self, upstreams, req: dict, line: str, ctx, tel):
+        """The bounded attempt loop. With a sampled ``ctx``, every
+        attempt gets its own ``forward`` span whose global ref becomes
+        the replica-side parent — the retry hop after a replica death
+        is a first-class span (status=error), not a lost counter."""
         source_key = str(req.get("source"))
-        for _attempt in range(self.max_attempts):
+        for attempt in range(1, self.max_attempts + 1):
             self._refresh()
             with self._lock:
                 table = self._table
             rid = table.owner(source_key) if table is not None else None
             if rid is None:
                 break
+            if ctx is not None:
+                span_id = tel.begin_span(
+                    "forward", replica=rid, attempt=attempt,
+                    trace=ctx.trace_id,
+                )
+                wire = ctx.child(tel.global_ref(span_id)).to_wire()
+                line_out = json.dumps({**req, _trace.WIRE_KEY: wire})
+            else:
+                span_id = None
+                line_out = line
             try:
-                reply = self._roundtrip(upstreams, table, rid, line)
+                reply = self._roundtrip(upstreams, table, rid, line_out)
             except _ReplicaDown:
+                if span_id is not None:
+                    tel.finish_span(span_id, "error", "replica_down")
+                    tel.event("route_retry", trace=ctx.trace_id,
+                              replica=rid, attempt=attempt)
                 self._eject(rid)
                 with self._lock:
                     self.stats["retries"] += 1
                 continue
+            if span_id is not None:
+                tel.finish_span(span_id)
             with self._lock:
                 self.stats["forwarded"] += 1
             return reply
+        if ctx is not None:
+            tel.event("route_unavailable", trace=ctx.trace_id)
         with self._lock:
             self.stats["unavailable"] += 1
         return {"error": "unavailable", "retry_after_ms": self.retry_after_ms}

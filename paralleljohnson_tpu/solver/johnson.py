@@ -29,6 +29,7 @@ from paralleljohnson_tpu import planner as _planner
 from paralleljohnson_tpu.backends import Backend, get_backend
 from paralleljohnson_tpu.config import SolverConfig
 from paralleljohnson_tpu.graphs import CSRGraph, stack_graphs
+from paralleljohnson_tpu.observe.trace import trace_attrs as _trace_attrs
 from paralleljohnson_tpu.utils import resilience
 from paralleljohnson_tpu.utils.metrics import SolverStats, phase_timer
 from paralleljohnson_tpu.utils.reductions import finite_checksum, xp as _xp
@@ -293,8 +294,11 @@ class ParallelJohnsonSolver:
 
         tel = self._tel
         tel.progress(op="solve", sources_total=len(sources))
+        # A solve scheduled on behalf of a traced serve request carries
+        # the originating trace_id (ISSUE 20) — trace_attrs() reads the
+        # serving thread's current trace, {} on every untraced path.
         with tel.span("solve", op="solve", n_sources=len(sources),
-                      predecessors=predecessors):
+                      predecessors=predecessors, **_trace_attrs()):
             decision = self._solver_decision(graph, sources)
             if decision.chosen.plan.name == "condensed+fw":
                 res = self._try_condensed(
@@ -407,7 +411,8 @@ class ParallelJohnsonSolver:
         )
         tel = self._tel
         tel.progress(op="solve_reduced", sources_total=len(sources))
-        with tel.span("solve", op="solve_reduced", n_sources=len(sources)):
+        with tel.span("solve", op="solve_reduced", n_sources=len(sources),
+                      **_trace_attrs()):
             return self._solve_reduced_body(
                 graph, sources, stats, reduce_rows
             )
@@ -464,7 +469,8 @@ class ParallelJohnsonSolver:
         stats = SolverStats()
         tel = self._tel
         tel.progress(op="sssp", source=int(source))
-        with tel.span("solve", op="sssp", source=int(source)):
+        with tel.span("solve", op="sssp", source=int(source),
+                      **_trace_attrs()):
             return self._sssp_body(graph, source, predecessors, stats)
 
     def _sssp_body(self, graph, source, predecessors, stats):
@@ -507,7 +513,8 @@ class ParallelJohnsonSolver:
         sources = np.asarray(sources, np.int64)
         tel = self._tel
         tel.progress(op="multi_source", sources_total=len(sources))
-        with tel.span("solve", op="multi_source", n_sources=len(sources)):
+        with tel.span("solve", op="multi_source", n_sources=len(sources),
+                      **_trace_attrs()):
             with phase_timer(stats, "upload", tel):
                 dgraph = self.backend.upload(graph)
             with phase_timer(stats, "fanout", tel):
@@ -1111,7 +1118,7 @@ class ParallelJohnsonSolver:
             if finalize is None:
                 return payload, 0.0
             with tel.span("finalize", batch=bi, parent=parent,
-                          resumed=resumed):
+                          resumed=resumed, **_trace_attrs()):
                 if resumed:
                     return finalize(bi, b, payload, True), 0.0
                 t0 = time.perf_counter()

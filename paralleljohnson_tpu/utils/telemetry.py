@@ -105,7 +105,8 @@ class Tracer:
     """Thread-safe span/event recorder with an optional JSONL flight file.
 
     Records (one JSON object per line / list entry):
-      ``{"type": "meta", "pid", "start_ts", "t": 0.0}``           (first)
+      ``{"type": "meta", "pid", "proc", ["label"], "start_ts",
+         "t": 0.0}``                                              (first)
       ``{"type": "span_begin", "id", "parent", "name", "t", "tid",
          "thread", "attrs"}``
       ``{"type": "span_end", "id", "t", "status", ["error"]}``
@@ -113,12 +114,19 @@ class Tracer:
 
     ``t`` is monotonic seconds since tracer creation (``perf_counter``
     based — wall-clock steps cannot reorder the story); ``start_ts`` in
-    the meta line anchors it to the epoch. Every line appended to the
-    flight file is flushed immediately: a killed process leaves batches
-    0..k-1 closed and batch k OPEN, which is exactly the diagnosis.
+    the meta line anchors it to the epoch. ``proc`` is a unique
+    per-tracer id (pid + random suffix): span ids are only locally
+    unique, so the cross-process trace assembler
+    (``observe/trace.py``) addresses spans by the *global ref*
+    ``"<proc>:<span_id>"`` — :meth:`global_ref` — which is what rides
+    the serve wire as the downstream hop's ``parent``. Every line
+    appended to the flight file is flushed immediately: a killed
+    process leaves batches 0..k-1 closed and batch k OPEN, which is
+    exactly the diagnosis.
     """
 
-    def __init__(self, flight_path: str | Path | None = None) -> None:
+    def __init__(self, flight_path: str | Path | None = None,
+                 label: str | None = None) -> None:
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._t0 = time.perf_counter()
@@ -126,12 +134,16 @@ class Tracer:
         self._open: dict[int, dict] = {}
         self._file = None
         self.flight_path: Path | None = None
+        self.proc = f"{os.getpid():x}-{os.urandom(3).hex()}"
         if flight_path is not None:
             self.flight_path = Path(flight_path)
             self.flight_path.parent.mkdir(parents=True, exist_ok=True)
             self._file = open(self.flight_path, "a", encoding="utf-8")
-        self._emit({"type": "meta", "pid": os.getpid(),
-                    "start_ts": time.time(), "t": 0.0})
+        meta = {"type": "meta", "pid": os.getpid(), "proc": self.proc,
+                "start_ts": time.time(), "t": 0.0}
+        if label is not None:
+            meta["label"] = label
+        self._emit(meta)
 
     # -- recording --------------------------------------------------------
 
@@ -187,6 +199,42 @@ class Tracer:
 
     def current_span_id(self) -> int | None:
         return _CURRENT_SPAN.get()
+
+    def global_ref(self, span_id: int | None = None) -> str | None:
+        """The process-unique address of a span (``"<proc>:<id>"``) —
+        what a forwarding hop puts on the wire as the downstream
+        process's ``parent``. Defaults to the current span; None when
+        there is none."""
+        if span_id is None:
+            span_id = _CURRENT_SPAN.get()
+        if span_id is None:
+            return None
+        return f"{self.proc}:{span_id}"
+
+    def begin_span(self, name: str, *, parent: int | None = None,
+                   **attrs) -> int:
+        """Open a span WITHOUT entering it on the calling thread's
+        contextvar stack — for work tracked on behalf of another thread
+        (the MicroBatcher leader opening one ``convoy_member`` span per
+        follower slot). Close with :meth:`finish_span`."""
+        span_id = next(self._ids)
+        if parent is None:
+            parent = _CURRENT_SPAN.get()
+        tid, tname = _thread_label()
+        rec = {
+            "type": "span_begin", "id": span_id, "parent": parent,
+            "name": name, "t": self._now(), "tid": tid, "thread": tname,
+            "attrs": attrs,
+        }
+        with self._lock:
+            self._open[span_id] = rec
+        self._emit(rec)
+        return span_id
+
+    def finish_span(self, span_id: int, status: str = "ok",
+                    error: str | None = None) -> None:
+        """Close a span opened with :meth:`begin_span`."""
+        self._end_span(span_id, status, error)
 
     def records(self) -> list[dict]:
         with self._lock:
@@ -578,7 +626,8 @@ _PROM_METRICS = (
 
 def write_prom_metrics(stats: Any, path: str | Path, *,
                        labels: dict | None = None,
-                       metrics: tuple | None = None) -> Path:
+                       metrics: tuple | None = None,
+                       exemplars: bool = False) -> Path:
     """Write one stats object in Prometheus textfile-collector format
     (atomic tmp+rename — node_exporter may scrape mid-write). ``labels``
     adds constant labels to every sample (e.g. ``{"config": "rmat_apsp"}``).
@@ -602,6 +651,13 @@ def write_prom_metrics(stats: Any, path: str | Path, *,
     PromQL (``histogram_quantile``) instead of only via the exported
     p50/p99 gauges. Run :func:`validate_prom_text` over the output in
     tests — the cumulative-bucket invariants are checked, not assumed.
+
+    ``exemplars=True`` (ISSUE 20) appends an OpenMetrics-style exemplar
+    to each histogram bucket line whose ``LogHistogram`` bucket
+    recorded one — ``<bucket sample> # {trace_id="<id>"} <value>`` —
+    so a scrape can jump from "the p99 bucket" to a concrete request
+    trace. Off by default: plain Prometheus text-format parsers reject
+    the suffix; only enable for OpenMetrics-aware collectors.
     """
 
     def fmt_labels(extra: dict | None = None) -> str:
@@ -638,11 +694,20 @@ def write_prom_metrics(stats: Any, path: str | Path, *,
         lines.append(f"# TYPE {name} {mtype}")
         if mtype == "histogram":
             hist = get(stats)
+            ex_by_edge = {}
+            if exemplars and hasattr(hist, "bucket_exemplars"):
+                ex_by_edge = hist.bucket_exemplars() or {}
             for edge, cum in hist.cumulative_buckets():
-                lines.append(
+                line = (
                     f"{name}_bucket{fmt_labels({'le': fmt_le(edge)})} "
                     f"{float(cum)}"
                 )
+                ex = ex_by_edge.get(edge)
+                if ex is not None:
+                    trace_id, ex_value = ex
+                    line += (f' # {{trace_id="{trace_id}"}} '
+                             f"{float(ex_value)}")
+                lines.append(line)
             lines.append(f"{name}_sum{label_str} {float(hist.sum)}")
             lines.append(f"{name}_count{label_str} {float(hist.count)}")
             continue
@@ -665,9 +730,12 @@ def validate_prom_text(text: str) -> None:
     TYPE lines, and histogram series satisfy the cumulative-bucket
     contract — ``le`` edges strictly increasing, bucket counts
     non-decreasing, a closing ``le="+Inf"`` bucket whose count equals
-    ``<name>_count``, and ``_sum``/``_count`` present. The telemetry
-    tests run every export through this before anything may claim
-    scrape-ready (the ``validate_chrome_trace`` pattern)."""
+    ``<name>_count``, and ``_sum``/``_count`` present. An
+    OpenMetrics-style exemplar suffix (``# {trace_id="..."} <value>``,
+    ISSUE 20) is accepted ONLY on histogram ``_bucket`` lines — one
+    anywhere else raises. The telemetry tests run every export through
+    this before anything may claim scrape-ready (the
+    ``validate_chrome_trace`` pattern)."""
     import re
 
     global _PROM_SAMPLE_RE
@@ -675,7 +743,9 @@ def validate_prom_text(text: str) -> None:
         _PROM_SAMPLE_RE = re.compile(
             r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
             r"(?:\{(?P<labels>[^}]*)\})?"
-            r" (?P<value>[-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|inf|nan))$"
+            r" (?P<value>[-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|inf|nan))"
+            r"(?P<exemplar> # \{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\"\}"
+            r" [-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|inf|nan))?$"
         )
     typed: dict[str, str] = {}
     helped: set[str] = set()
@@ -721,6 +791,13 @@ def validate_prom_text(text: str) -> None:
                 f"line {n}: sample {name} has no preceding TYPE"
             )
         value = float(m.group("value"))
+        if m.group("exemplar") and not (
+            typed[base] == "histogram" and name == base + "_bucket"
+        ):
+            raise ValueError(
+                f"line {n}: exemplar on a non-histogram-bucket "
+                f"sample: {line!r}"
+            )
         if typed[base] == "histogram":
             if name == base + "_bucket":
                 labels = m.group("labels") or ""
@@ -796,7 +873,8 @@ class Telemetry:
             return None
         tracer = Tracer(
             flight_path=(Path(trace_dir) / f"flight-{label}.jsonl")
-            if trace_dir else None
+            if trace_dir else None,
+            label=label,
         )
         hb = None
         if heartbeat_file is not None:
@@ -831,6 +909,17 @@ class Telemetry:
 
     def current_span_id(self) -> int | None:
         return self.tracer.current_span_id()
+
+    def global_ref(self, span_id: int | None = None) -> str | None:
+        return self.tracer.global_ref(span_id)
+
+    def begin_span(self, name: str, *, parent: int | None = None,
+                   **attrs) -> int:
+        return self.tracer.begin_span(name, parent=parent, **attrs)
+
+    def finish_span(self, span_id: int, status: str = "ok",
+                    error: str | None = None) -> None:
+        self.tracer.finish_span(span_id, status, error)
 
     def summary(self) -> dict:
         return self.tracer.summary()
@@ -894,6 +983,15 @@ class _NullTelemetry:
         return None
 
     def current_span_id(self):
+        return None
+
+    def global_ref(self, span_id=None):
+        return None
+
+    def begin_span(self, name, *, parent=None, **attrs):
+        return None
+
+    def finish_span(self, span_id, status="ok", error=None):
         return None
 
     def summary(self):

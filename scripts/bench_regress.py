@@ -269,6 +269,17 @@ def main(argv: list[str] | None = None) -> int:
                        else " — demotion skipped (incomplete record)")
                 )
                 continue
+            if f.get("kind") == "trace":
+                # Trace-hop regression (ISSUE 20): one serving hop's
+                # assembled p50 (wall or convoy queue-wait) moved — the
+                # why-line names the hop, so the flag arrives
+                # pre-attributed even when the end-to-end wall hid it.
+                print(
+                    f"  REGRESSION (trace/{f.get('axis')}) {key}: "
+                    f"{f['why']} over {f['history_n']} runs "
+                    f"({f['slowdown']:.2f}x)"
+                )
+                continue
             if f.get("kind") == "size":
                 # Hopset size regression (ISSUE 17): the shortcut set
                 # got fatter for the same shape bucket + knobs — every

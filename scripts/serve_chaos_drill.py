@@ -18,8 +18,10 @@ same line. Three parts, all deterministic (every fault comes from a
    zero hung connections, zero unflagged approximations, every exact
    answer bitwise-identical to a direct solve, every shed answer inside
    its certified bound, the burn + shed transitions actually happened
-   (``slo_burn`` / ``slo_shed`` flight events on disk), and shedding
-   DISENGAGES once the storm passes.
+   (``slo_burn`` / ``slo_shed`` flight events on disk), shedding
+   DISENGAGES once the storm passes, and (ISSUE 20) a shed answer's
+   ``trace_id`` reconstructs into ONE parented request trace whose
+   spans include the ``shed_decision`` itself.
 2. **SIGTERM drain** — a real ``pjtpu serve --listen`` subprocess is
    terminated mid-traffic: it must exit 0 with parseable
    ``serve_stats.json`` / ``serve_live.json``.
@@ -221,6 +223,7 @@ def drill_fault_storm(tmp: Path) -> dict:
 
     # Grade every response against the oracle.
     shed_n = internal_n = exact_n = rejected_n = 0
+    shed_trace = None  # a shed answer's trace_id (ISSUE 20 assertion)
     for src, dst, r in responses:
         if "error" in r:
             if r["error"].startswith("internal"):
@@ -233,6 +236,8 @@ def drill_fault_storm(tmp: Path) -> dict:
         want = float(oracle[src, dst])
         if r.get("shed"):
             shed_n += 1
+            if shed_trace is None and r.get("trace_id"):
+                shed_trace = r["trace_id"]
             if r.get("exact") is not False or "max_error" not in r:
                 fail(f"shed answer not flagged: {r}")
             elif not np.isfinite(float(r["max_error"])):
@@ -304,6 +309,25 @@ def drill_fault_storm(tmp: Path) -> dict:
     engaged = sum(1 for e in events if e == "slo_shed")
     if engaged < 2:
         fail(f"expected slo_shed events for BOTH transitions, got {engaged}")
+
+    # ISSUE 20: a shed answer must reconstruct into ONE parented trace
+    # with the shed decision visible as a span — "p99 went up" joins to
+    # the concrete request that was degraded and WHY (policy + mode).
+    from paralleljohnson_tpu.observe.trace import assemble
+
+    if shed_trace is None:
+        fail("no shed answer carried a trace_id (tracing was on)")
+    else:
+        tr = assemble([tmp / "telemetry"])["traces"].get(shed_trace)
+        if tr is None:
+            fail(f"shed trace {shed_trace} did not assemble from the "
+                 "flight dir")
+        elif not tr["single_rooted"]:
+            fail(f"shed trace {shed_trace} not single-rooted: "
+                 f"roots={tr['roots']} unresolved={tr['unresolved']}")
+        elif not any(s["name"] == "shed_decision" for s in tr["spans"]):
+            fail(f"shed trace {shed_trace} has no shed_decision span: "
+                 f"{[s['name'] for s in tr['spans']]}")
 
     stats_file = store.ckpt.dir / "serve_stats.json"
     try:

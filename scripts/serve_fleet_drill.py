@@ -24,7 +24,15 @@ gates on):
   colder, never wrong);
 - the surviving replicas' latency histograms merge into one
   service-level SLO verdict (the ``pjtpu top --fleet-dir`` view) and
-  that merged verdict is in-SLO.
+  that merged verdict is in-SLO;
+- request tracing holds across the kill (ISSUE 20): router + replicas
+  all run flight recorders, and the offline join
+  (``observe.trace.assemble``) must reconstruct the kill-survivor
+  probe into ONE single-rooted timeline spanning router and replica,
+  show the retry hop (a ``forward`` span with ``attempt >= 2``) in at
+  least one single-rooted trace, and carry the scheduled
+  ``serve_solve`` inside the trace of a query for the one
+  deliberately never-pre-solved source.
 
 Run standalone (CPU, seconds):  python scripts/serve_fleet_drill.py
 Staged in scripts/tpu_round3_run.sh as ``serve-fleet-drill``.
@@ -75,7 +83,10 @@ def main() -> int:
         f"{d['answered']} bitwise-exact answers "
         f"({d['rejected']} rejected, {d['shed_answers']} shed), "
         f"merged p99 {d['p99_ms']}±{d['p99_err_ms']} ms, "
-        f"fleet verdict {d['verdict']!r}"
+        f"fleet verdict {d['verdict']!r}, "
+        f"{d['traces_assembled']} traces assembled "
+        f"({d['traces_single_rooted']} single-rooted, "
+        f"{d['retry_traces']} with retry hops)"
     )
     return 0
 

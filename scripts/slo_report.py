@@ -121,6 +121,12 @@ def report_snapshot(path: Path, snap: dict, out=sys.stdout) -> None:
             f"p99 {_fmt(h.get('p99_ms'))}±{_fmt(h.get('p99_err_ms'))} ms  "
             f"max {_fmt(h.get('max'))} ms", file=out,
         )
+        # Tail exemplars (ISSUE 20): the trace ids behind the slowest
+        # buckets — `scripts/trace_summary.py --request ID` expands one.
+        tail = live.tail_exemplars_from_dict(h.get("hist"))
+        if tail:
+            print("          tail traces " + "  ".join(
+                f"{e}@{_fmt(v)}ms" for e, v in tail), file=out)
     shed = (counters.get("pjtpu_shed_answers") or {}).get("total")
     if shed is not None:
         answered = (counters.get("pjtpu_queries") or {}).get("total") or 0
@@ -312,6 +318,10 @@ def report_fleet(root: Path, out=sys.stdout) -> None:
                   f"±{_fmt(pct.get('p50_err_ms'))} ms  "
                   f"p99 {_fmt(pct.get('p99_ms'))}"
                   f"±{_fmt(pct.get('p99_err_ms'))} ms", file=out)
+            tail = merged_hist.tail_exemplars()
+            if tail:
+                print("  merged tail traces " + "  ".join(
+                    f"{e}@{_fmt(v)}ms" for e, v in tail), file=out)
             print(f"  service verdict: {verdict}  availability "
                   f"{_fmt(avail, 5)} (bad {_fmt(bad, 0)}/"
                   f"{_fmt(events, 0)})  p{_fmt(lat_pct, 0)} vs target "

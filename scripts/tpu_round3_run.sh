@@ -247,7 +247,16 @@ run 900 jax-serve-overload python -m paralleljohnson_tpu.cli bench serve_overloa
 #        bitwise-exact non-shed answers, a monotonic routing epoch, and
 #        an in-SLO merged fleet verdict. CPU replicas by design (they
 #        must never dial the single-tenant tunnel).
-run 600 serve-fleet-drill env JAX_PLATFORMS=cpu python scripts/serve_fleet_drill.py
+run 600 serve-fleet-drill env JAX_PLATFORMS=cpu PJ_FLEET_TRACE_OUT=$PWD/bench_artifacts/trace/fleet python scripts/serve_fleet_drill.py
+
+# 4g''''') request-trace assembly (ISSUE 20): re-join the fleet drill's
+#          preserved flight recorders OFFLINE — every span must parent
+#          back to its minted trace_id (single root, no unresolved wire
+#          parents; the SIGKILLed replica's open spans are flagged, not
+#          dropped) — write one Perfetto timeline per request and stage
+#          the per-hop p50 rows (wall + convoy queue-wait) for
+#          hop-level regression grading by bench_regress
+run 300 trace-assemble python scripts/trace_assemble.py bench_artifacts/trace/fleet --check --perfetto-dir bench_artifacts/trace/perfetto --regress-out bench_artifacts/trace/fleet_hops.jsonl --bench serve_fleet --backend jax --platform tpu --preset full
 
 # 4g'''') the recorded serve-fleet bench row (ISSUE 18): the same
 #         drill at full preset with jax-backend replicas — the detail

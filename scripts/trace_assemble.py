@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Join router + replica + fleet-worker flight recorders into per-trace
+request timelines (ISSUE 20 tentpole; README "Request tracing").
+
+Every process on a request's path appends to its OWN flight JSONL —
+exactly the PR-5 flight recorder, no collector daemon. The wire trace
+context (``{"trace": {"id", "parent", "sampled"}}``) gives each
+cross-process hop an explicit ``wire_parent`` span ref; this script
+performs the join: one timeline per ``trace_id``, every span parented
+back to the minted ingress.
+
+Outputs:
+
+  - a human summary per trace (hop count, processes touched, roots,
+    OPEN spans = where a process died mid-request);
+  - ``--perfetto-dir OUT``: one Perfetto-loadable ``trace-<id>.json``
+    per assembled trace (router / replicas / workers as separate
+    process tracks);
+  - ``--check``: exit 1 unless every assembled trace is single-rooted
+    (exactly one root, every wire parent resolved) — the drills'
+    "every span parented" acceptance gate. OPEN ingress spans are
+    flagged (a SIGKILLed replica's death point) but do not fail the
+    check on their own: a killed hop is a fact to surface, a missing
+    flight file is a broken join;
+  - ``--regress-out FILE --bench NAME``: append ``kind:"trace"``
+    regression rows (one per hop: p50 wall, p50 convoy queue-wait)
+    that ``observe/regress.py`` grades — a silently doubled convoy
+    wait flags ``bench_regress`` with the hop named in the why-line;
+  - ``--json``: the machine-readable assembly summary on stdout.
+
+Usage:
+  python scripts/trace_assemble.py td/trace/router td/trace/replica-*
+  python scripts/trace_assemble.py DIR... --perfetto-dir out/ --check
+  python scripts/trace_assemble.py DIR... --regress-out rows.jsonl \\
+      --bench serve_fleet --backend cpu --platform cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from paralleljohnson_tpu.observe.trace import (  # noqa: E402
+    assemble,
+    format_request_tree,
+    hop_summary,
+    perfetto_trace,
+)
+from paralleljohnson_tpu.utils.telemetry import (  # noqa: E402
+    validate_chrome_trace,
+)
+
+
+def summarize(assembly: dict) -> dict:
+    """The machine summary ``--json`` prints and the drills assert on."""
+    traces = assembly["traces"]
+    return {
+        "processes": len(assembly["processes"]),
+        "traces": len(traces),
+        "single_rooted": sum(
+            1 for t in traces.values() if t["single_rooted"]
+        ),
+        "with_open_spans": sum(1 for t in traces.values() if t["open"]),
+        "unresolved_parents": sum(
+            len(t["unresolved"]) for t in traces.values()
+        ),
+        "hops": hop_summary(assembly),
+        "per_trace": {
+            tid: {
+                "spans": len(t["spans"]),
+                "processes": t["processes"],
+                "single_rooted": t["single_rooted"],
+                "roots": t["roots"],
+                "open": t["open"],
+                "linked": t.get("linked") or [],
+                "unresolved": t["unresolved"],
+            }
+            for tid, t in sorted(traces.items())
+        },
+    }
+
+
+def write_regress_rows(assembly: dict, out_path: Path, *, bench: str,
+                       backend: str, platform: str, preset: str) -> int:
+    """Append one ``kind:"trace"`` row per hop for bench_regress: the
+    row's bench key is ``trace:<bench>:<hop>`` so each hop gets its own
+    baseline series, wall_s is the hop's p50 wall, and the convoy's p50
+    queue wait rides in ``detail`` (graded via the why-line)."""
+    hops = hop_summary(assembly)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    with out_path.open("a", encoding="utf-8") as fh:
+        for hop, row in sorted(hops.items()):
+            rec = {
+                "kind": "trace",
+                "bench": bench,
+                "hop": hop,
+                "backend": backend,
+                "platform": platform,
+                "preset": preset,
+                "wall_s": row["wall_p50_s"],
+                "count": row["count"],
+                "open": row["open"],
+            }
+            if "queue_wait_p50_ms" in row:
+                rec["queue_wait_p50_ms"] = row["queue_wait_p50_ms"]
+            fh.write(json.dumps(rec) + "\n")
+    return len(hops)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="assemble per-request traces from many flight "
+                    "recorder dirs (router + replicas + workers)"
+    )
+    ap.add_argument("sources", nargs="+", metavar="DIR_OR_FILE",
+                    help="flight-*.jsonl files or --trace-dir dirs "
+                         "(one per process on the request path)")
+    ap.add_argument("--perfetto-dir", default=None, metavar="DIR",
+                    help="write one Perfetto trace-<id>.json per "
+                         "assembled trace")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="limit output to one trace id")
+    ap.add_argument("--tree", action="store_true",
+                    help="print each trace's full span tree (same "
+                         "rendering as trace_summary.py --request)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless every assembled trace is "
+                         "single-rooted with all wire parents resolved")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable summary instead "
+                         "of the human one")
+    ap.add_argument("--regress-out", default=None, metavar="JSONL",
+                    help="append kind:'trace' per-hop regression rows "
+                         "for observe/regress.py")
+    ap.add_argument("--bench", default="serve",
+                    help="bench name for --regress-out rows")
+    ap.add_argument("--backend", default="auto",
+                    help="backend label for --regress-out rows")
+    ap.add_argument("--platform", default="unknown",
+                    help="platform label for --regress-out rows")
+    ap.add_argument("--preset", default="default",
+                    help="preset label for --regress-out rows")
+    args = ap.parse_args(argv)
+
+    assembly = assemble(args.sources)
+    if args.trace is not None:
+        tr = assembly["traces"].get(args.trace)
+        if tr is None:
+            print(f"error: trace {args.trace!r} not found; have: "
+                  f"{', '.join(sorted(assembly['traces'])) or '(none)'}",
+                  file=sys.stderr)
+            return 2
+        assembly = {"processes": assembly["processes"],
+                    "traces": {args.trace: tr}}
+
+    summary = summarize(assembly)
+    # With --json, stdout is EXACTLY the summary document — every
+    # status line below moves to stderr so the output stays parseable.
+    status = sys.stderr if args.json else sys.stdout
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(f"assembled {summary['traces']} trace(s) from "
+              f"{summary['processes']} flight recorder(s): "
+              f"{summary['single_rooted']} single-rooted, "
+              f"{summary['with_open_spans']} with OPEN spans, "
+              f"{summary['unresolved_parents']} unresolved wire "
+              "parent(s)")
+        for tid, info in summary["per_trace"].items():
+            mark = "ok " if info["single_rooted"] else "!! "
+            procs = ", ".join(info["processes"])
+            extra = ""
+            if info["open"]:
+                extra += f"  OPEN: {len(info['open'])} span(s)"
+            if info["unresolved"]:
+                extra += (f"  unresolved: "
+                          f"{', '.join(info['unresolved'])}")
+            print(f"  {mark}{tid}  {info['spans']} spans over "
+                  f"[{procs}]  roots={len(info['roots'])}{extra}")
+        if args.tree:
+            for tr in assembly["traces"].values():
+                print()
+                for line in format_request_tree(tr):
+                    print(line)
+
+    if args.perfetto_dir is not None:
+        out_dir = Path(args.perfetto_dir)
+        out_dir.mkdir(parents=True, exist_ok=True)
+        for tid, tr in assembly["traces"].items():
+            trace = perfetto_trace(tr)
+            validate_chrome_trace(trace)
+            out = out_dir / f"trace-{tid}.json"
+            out.write_text(json.dumps(trace), encoding="utf-8")
+        print(f"wrote {len(assembly['traces'])} Perfetto trace(s) to "
+              f"{args.perfetto_dir} — load in https://ui.perfetto.dev",
+              file=status)
+
+    if args.regress_out is not None:
+        n = write_regress_rows(
+            assembly, Path(args.regress_out), bench=args.bench,
+            backend=args.backend, platform=args.platform,
+            preset=args.preset,
+        )
+        print(f"appended {n} kind:'trace' hop row(s) to "
+              f"{args.regress_out}", file=status)
+
+    if args.check:
+        bad = [tid for tid, t in assembly["traces"].items()
+               if not t["single_rooted"]]
+        opens = [tid for tid, t in assembly["traces"].items()
+                 if t["open"]]
+        for tid in opens:
+            tr = assembly["traces"][tid]
+            print(f"check: trace {tid} has OPEN span(s) "
+                  f"{tr['open']} — a process died mid-request",
+                  file=sys.stderr)
+        if bad:
+            for tid in bad:
+                tr = assembly["traces"][tid]
+                print(f"check FAILED: trace {tid} roots="
+                      f"{tr['roots']} unresolved={tr['unresolved']}",
+                      file=sys.stderr)
+            return 1
+        if not assembly["traces"]:
+            print("check FAILED: no traces assembled (tracing off, or "
+                  "wrong dirs?)", file=sys.stderr)
+            return 1
+        print(f"check ok: {summary['traces']} trace(s), every span "
+              "parented", file=status)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

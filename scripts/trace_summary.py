@@ -27,10 +27,20 @@ No dependency on the package being importable beyond ``utils.telemetry``
     process died, inside what, and when each lease claim / commit /
     requeue happened relative to it.
 
+  - ``--request TRACE_ID``: one REQUEST's span tree across the merged
+    flight dirs (ISSUE 20) — router forward hop, replica admission,
+    convoy member (with its explicit queue wait), engine query, device
+    megabatch — each hop with its wall clock and the start delta from
+    its parent (the cross-hop queue/network wait). Torn-tail tolerant
+    like everything else here; a hop whose process was SIGKILLed shows
+    as OPEN, not dropped.
+
 Usage:
   python scripts/trace_summary.py bench_artifacts/telemetry/flight-solve.jsonl
   python scripts/trace_summary.py flight.jsonl --chrome trace.json --top 20
   python scripts/trace_summary.py --merge /path/to/coord/telemetry
+  python scripts/trace_summary.py --request 9f2ab31c44d0be77 --merge \\
+      td/trace/router td/trace/replica-0 td/trace/replica-1
 """
 
 from __future__ import annotations
@@ -340,6 +350,28 @@ def print_merged(sources: list[tuple[str, list[dict]]],
             print(f"   {label}: {n} open span(s)", file=out)
 
 
+def print_request(trace_id: str, sources: list[str],
+                  out=sys.stdout) -> int:
+    """``--request``: assemble the sources and print ONE request's span
+    tree (per-hop wall + parent-start deltas + convoy queue waits)."""
+    from paralleljohnson_tpu.observe.trace import (
+        assemble,
+        format_request_tree,
+    )
+
+    assembly = assemble(sources)
+    tr = assembly["traces"].get(trace_id)
+    if tr is None:
+        have = ", ".join(sorted(assembly["traces"])) or "(none)"
+        print(f"error: trace {trace_id!r} not found in "
+              f"{len(assembly['processes'])} flight recorder(s); "
+              f"have: {have}", file=sys.stderr)
+        return 2
+    for line in format_request_tree(tr):
+        print(line, file=out)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="summarize a flight-recorder JSONL (pjtpu --trace-dir)"
@@ -352,6 +384,12 @@ def main(argv: list[str] | None = None) -> int:
                          "id — the one-command fleet post-mortem (pass "
                          "a fleet's coordinator telemetry/ dir, or the "
                          "per-worker dirs)")
+    ap.add_argument("--request", default=None, metavar="TRACE_ID",
+                    help="print ONE request's cross-process span tree "
+                         "(ISSUE 20) from the --merge dirs (or the "
+                         "positional flight file): per-hop wall clock, "
+                         "parent-start deltas, convoy queue waits; "
+                         "SIGKILLed hops show as OPEN")
     ap.add_argument("--top", type=int, default=10,
                     help="how many slowest spans to list")
     ap.add_argument("--chrome", default=None, metavar="OUT.json",
@@ -368,6 +406,14 @@ def main(argv: list[str] | None = None) -> int:
                          "collapsed at the last recorded iteration")
     args = ap.parse_args(argv)
 
+    if args.request is not None:
+        sources = list(args.merge or [])
+        if args.flight is not None:
+            sources.append(args.flight)
+        if not sources:
+            ap.error("--request needs flight sources (--merge DIR... "
+                     "or a positional flight file)")
+        return print_request(args.request, sources)
     if args.merge is not None:
         print_merged(_merge_sources(args.merge))
         if args.flight is None:

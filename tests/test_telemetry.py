@@ -482,14 +482,20 @@ def test_default_config_is_null_telemetry():
 def test_null_telemetry_near_free():
     """The disabled path allocates nothing per call and costs ~nothing:
     20k span+event+progress round-trips well under a generous bound
-    (the per-solve call count is orders of magnitude smaller)."""
+    (the per-solve call count is orders of magnitude smaller). The
+    ISSUE-20 tracing surface (begin_span / finish_span / global_ref)
+    rides the same loop — tracing off must stay in the no-op regime."""
     assert NULL_TELEMETRY.span("a", batch=1) is NULL_TELEMETRY.span("b")
+    assert NULL_TELEMETRY.global_ref() is None
     t0 = time.perf_counter()
     for _ in range(20_000):
         with NULL_TELEMETRY.span("x", batch=0, attempt=1):
             pass
         NULL_TELEMETRY.event("y", a=1)
         NULL_TELEMETRY.progress(stage="s")
+        sid = NULL_TELEMETRY.begin_span("z", parent=None, attempt=2)
+        NULL_TELEMETRY.global_ref(sid)
+        NULL_TELEMETRY.finish_span(sid)
     assert time.perf_counter() - t0 < 1.0
 
 

@@ -1,0 +1,783 @@
+"""Fleet-wide request tracing tests (ISSUE 20 tentpole).
+
+The contract under test:
+- the wire context (``req["trace"]``) carries one ``trace_id`` minted at
+  first ingress plus the upstream span's global ref; head sampling is a
+  pure function of the id, so every process computing it independently
+  reaches the same verdict (rates 0 / 0.5 / 1 pinned, including from a
+  standalone subprocess loading ``observe/trace.py`` with no package);
+- a traced request runs inside a ``serve_request`` span, its response is
+  stamped ``trace_id``, and the MicroBatcher convoy's follower spans are
+  explicitly ``parent=``-linked to the leader's ``convoy_batch`` span
+  with their queue wait recorded;
+- the offline assembler joins router + replica flight files into ONE
+  single-rooted per-trace timeline (wire parents stitch the processes),
+  flags spans left OPEN by a SIGKILL instead of dropping them, and
+  exports a schema-valid Perfetto trace;
+- tracing off stays on the pre-trace code path: responses are
+  bitwise-identical, with no ``trace_id`` key and no wire mutation;
+- OpenMetrics exemplars ride histogram ``_bucket`` lines only (the
+  validator rejects them anywhere else), and ``kind: "trace"`` per-hop
+  rows flag ``bench_regress`` with the hop named when a convoy queue
+  wait doubles.
+
+Real-SIGKILL / full-storm variants ride the slow set and the staged
+drills (``scripts/serve_fleet_drill.py`` asserts the kill-survivor
+timeline on real subprocesses).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from paralleljohnson_tpu import (
+    ParallelJohnsonSolver,
+    SolverConfig,
+    Telemetry,
+    Tracer,
+)
+from paralleljohnson_tpu.graphs import erdos_renyi, grid2d
+from paralleljohnson_tpu.observe import trace as trace_mod
+from paralleljohnson_tpu.observe.live import SLO, LogHistogram
+from paralleljohnson_tpu.observe.regress import (
+    detect_regressions,
+    normalize_record,
+)
+from paralleljohnson_tpu.observe.trace import (
+    TraceContext,
+    assemble,
+    format_request_tree,
+    hop_summary,
+    ingress,
+    mint_trace_id,
+    perfetto_trace,
+    should_sample,
+    use_trace,
+)
+from paralleljohnson_tpu.serve import (
+    FleetRouter,
+    LandmarkIndex,
+    MicroBatcher,
+    QueryEngine,
+    ServeFrontend,
+    TileStore,
+)
+from paralleljohnson_tpu.utils.telemetry import (
+    validate_chrome_trace,
+    validate_prom_text,
+    write_prom_metrics,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _cfg(**kw) -> SolverConfig:
+    return SolverConfig(backend="numpy", **kw)
+
+
+_TIGHT_SLO = SLO(name="serve", latency_ms=25.0, latency_pct=99.0,
+                 availability=0.9, rules=((10.0, 1.0, 2.0),))
+
+
+def _world(tmp_path, *, warm=16, n=32, telemetry=None, landmarks=False,
+           **fe_kw):
+    g = erdos_renyi(n, 0.15, seed=3)
+    store = TileStore(tmp_path / "store", g, warm_rows=n)
+    lm = (LandmarkIndex.build(g, 4, config=_cfg(), seed=0)
+          if landmarks else None)
+    engine = QueryEngine(g, store, landmarks=lm,
+                         config=_cfg(telemetry=telemetry),
+                         slo=_TIGHT_SLO, stats_interval_s=0)
+    engine.warm(np.arange(warm))
+    fe_kw.setdefault("shed_policy", "landmark" if landmarks else "reject")
+    frontend = ServeFrontend(engine, **fe_kw).start()
+    return g, engine, frontend
+
+
+class _Client:
+    def __init__(self, addr, timeout=30.0):
+        self.sock = socket.create_connection(addr, timeout=timeout)
+        self.sock.settimeout(timeout)
+        self.f = self.sock.makefile("rw", encoding="utf-8", newline="\n")
+        self.header = json.loads(self.f.readline())
+
+    def ask(self, req: dict) -> dict:
+        self.f.write(json.dumps(req) + "\n")
+        self.f.flush()
+        return json.loads(self.f.readline())
+
+    def close(self):
+        self.f.close()
+        self.sock.close()
+
+
+# -- wire context + deterministic head sampling -------------------------------
+
+
+def test_mint_and_wire_roundtrip():
+    tid = mint_trace_id()
+    assert len(tid) == 16 and int(tid, 16) >= 0
+    ctx = TraceContext(tid, parent="abc:7")
+    back = TraceContext.from_wire(ctx.to_wire())
+    assert back.trace_id == tid and back.parent == "abc:7" and back.sampled
+    # Unsampled contexts still travel — the head decision is made once.
+    declined = TraceContext(tid, sampled=False)
+    wire = declined.to_wire()
+    assert wire["sampled"] is False
+    assert TraceContext.from_wire(wire).sampled is False
+    # Garbage degrades to untraced, never raises.
+    for bad in (None, 7, "x", {}, {"id": ""}, {"id": 3}):
+        assert TraceContext.from_wire(bad) is None
+
+
+def test_ingress_honors_wire_and_rate_zero_mints_nothing():
+    upstream = TraceContext(mint_trace_id(), parent="r:1")
+    req = {"source": 0, "trace": upstream.to_wire()}
+    ctx = ingress(req, rate=0.0)
+    assert ctx is not None and ctx.trace_id == upstream.trace_id
+    assert ctx.parent == "r:1"
+    # No wire context + rate 0: the untraced path mints nothing.
+    assert ingress({"source": 0}, rate=0.0) is None
+    # Rate 1 mints a fresh sampled context.
+    minted = ingress({"source": 0}, rate=1.0)
+    assert minted is not None and minted.sampled and minted.parent is None
+
+
+def test_sampling_determinism_rates_0_half_1():
+    ids = [mint_trace_id() for _ in range(2000)]
+    assert not any(should_sample(t, 0.0) for t in ids)
+    assert all(should_sample(t, 1.0) for t in ids)
+    half = [should_sample(t, 0.5) for t in ids]
+    # Deterministic: the same verdict on every recomputation.
+    assert half == [should_sample(t, 0.5) for t in ids]
+    frac = sum(half) / len(half)
+    assert 0.4 < frac < 0.6  # a fair head-sampling coin
+    # Cross-process determinism: a standalone subprocess loading the
+    # stdlib-only module (no package import) must agree verdict-for-
+    # verdict — this is what lets router and replicas sample
+    # independently without coordinating.
+    probe = ids[:64]
+    code = (
+        "import importlib.util, json, sys\n"
+        "spec = importlib.util.spec_from_file_location('pj_trace', "
+        f"{str(REPO / 'paralleljohnson_tpu/observe/trace.py')!r})\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(m)\n"
+        "ids = json.loads(sys.stdin.read())\n"
+        "print(json.dumps([m.should_sample(t, 0.5) for t in ids]))\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code],
+                         input=json.dumps(probe), capture_output=True,
+                         text=True, check=True)
+    assert json.loads(out.stdout) == half[:64]
+
+
+def test_current_trace_contextvar_and_attrs():
+    assert trace_mod.current_trace_id() is None
+    assert trace_mod.trace_attrs() == {}
+    ctx = TraceContext("feedbeef00000001")
+    with use_trace(ctx):
+        assert trace_mod.current_trace_id() == ctx.trace_id
+        assert trace_mod.trace_attrs() == {"trace": ctx.trace_id}
+    assert trace_mod.current_trace_id() is None
+    # An unsampled context is installed but contributes no attrs — deep
+    # call sites tag nothing for a declined request.
+    with use_trace(TraceContext("feedbeef00000002", sampled=False)):
+        assert trace_mod.current_trace_id() is None
+        assert trace_mod.trace_attrs() == {}
+
+
+# -- frontend ingress span + response stamp -----------------------------------
+
+
+def test_frontend_serve_request_span_and_trace_id_stamp(tmp_path):
+    tel = Telemetry(tracer=Tracer())
+    g, engine, fe = _world(tmp_path, telemetry=tel)
+    try:
+        c = _Client(fe.address)
+        r = c.ask({"id": 1, "source": 0, "dst": 5})
+        c.close()
+        assert r["exact"] is True
+        tid = r["trace_id"]
+        assert isinstance(tid, str) and len(tid) == 16
+        recs = tel.tracer.records()
+        serve = next(r_ for r_ in recs if r_.get("type") == "span_begin"
+                     and r_["name"] == "serve_request")
+        assert serve["attrs"]["trace"] == tid
+        # The convoy member span joined the same trace, parented to its
+        # batch span, with the queue wait made visible.
+        member = next(r_ for r_ in recs if r_.get("type") == "span_begin"
+                      and r_["name"] == "convoy_member")
+        batch = next(r_ for r_ in recs if r_.get("type") == "span_begin"
+                     and r_["name"] == "convoy_batch")
+        assert member["attrs"]["trace"] == tid
+        assert member["parent"] == batch["id"]
+        assert member["attrs"]["queue_wait_ms"] >= 0.0
+        assert member["attrs"]["leader"] is True
+    finally:
+        fe.drain()
+
+
+def test_disabled_path_bitwise_identical_no_trace_key(tmp_path):
+    _, _, plain = _world(tmp_path / "plain")
+    tel = Telemetry(tracer=Tracer())
+    _, _, traced = _world(tmp_path / "traced", telemetry=tel)
+    try:
+        q = {"id": 9, "source": 2, "dst": 17}
+        c = _Client(plain.address)
+        r_plain = c.ask(dict(q))
+        c.close()
+        assert "trace_id" not in r_plain
+        c = _Client(traced.address)
+        r_traced = c.ask(dict(q))
+        c.close()
+        assert "trace_id" in r_traced
+        # Tracing changes the response by EXACTLY the trace_id stamp.
+        del r_traced["trace_id"]
+        assert r_traced == r_plain
+    finally:
+        plain.drain()
+        traced.drain()
+
+
+def test_shed_decision_span_nests_under_serve_request(tmp_path):
+    """The chaos drill's in-process twin: a burn-shed answer's trace
+    must contain the shed_decision span, parented into serve_request."""
+    tel = Telemetry(tracer=Tracer())
+    _, engine, fe = _world(tmp_path, warm=16, telemetry=tel,
+                           landmarks=True, shed_min_events=1)
+    try:
+        for _ in range(50):
+            engine.metrics.observe_slo(engine.slo.name, None, ok=False)
+        assert engine.slo_tracker().burning
+        c = _Client(fe.address)
+        r = c.ask({"id": 2, "source": 30, "dst": 1})  # store MISS
+        c.close()
+        assert r.get("shed") is True and "trace_id" in r
+        recs = tel.tracer.records()
+        serve = next(x for x in recs if x.get("type") == "span_begin"
+                     and x["name"] == "serve_request"
+                     and x["attrs"].get("trace") == r["trace_id"])
+        shed = next(x for x in recs if x.get("type") == "span_begin"
+                    and x["name"] == "shed_decision")
+        assert shed["attrs"]["trace"] == r["trace_id"]
+        assert shed["parent"] == serve["id"]
+    finally:
+        fe.drain()
+
+
+# -- convoy follower -> leader linkage ---------------------------------------
+
+
+class _SlowTracedEngine:
+    """Stand-in engine: slow enough to convoy, carrying a real tracer
+    so the MicroBatcher opens its convoy spans."""
+
+    def __init__(self, delay_s=0.01):
+        self._tel = Telemetry(tracer=Tracer())
+        self.delay_s = delay_s
+
+    def query_batch(self, reqs):
+        time.sleep(self.delay_s)
+        return [{"id": r.get("id")} for r in reqs]
+
+
+def test_convoy_followers_link_to_leader_batch_span():
+    eng = _SlowTracedEngine()
+    mb = MicroBatcher(eng, max_width=8, wait_ms=0.0)
+    n = 12
+    ctxs = [TraceContext(mint_trace_id()) for _ in range(n)]
+    out = [None] * n
+
+    def worker(i):
+        with use_trace(ctxs[i]):
+            out[i] = mb.submit({"id": i})
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert [o["id"] for o in out] == list(range(n))
+    recs = eng._tel.tracer.records()
+    batches = {r["id"]: r for r in recs if r.get("type") == "span_begin"
+               and r["name"] == "convoy_batch"}
+    members = [r for r in recs if r.get("type") == "span_begin"
+               and r["name"] == "convoy_member"]
+    # Every submitter's trace got exactly one member span, each
+    # explicitly parented to a convoy_batch span (the leader's thread
+    # opened it — contextvars do NOT cross the submit boundary).
+    assert sorted(m["attrs"]["trace"] for m in members) == sorted(
+        c.trace_id for c in ctxs)
+    assert all(m["parent"] in batches for m in members)
+    assert all(m["attrs"]["queue_wait_ms"] >= 0.0 for m in members)
+    # The delay convoys followers: some batch carried > 1 member, and
+    # exactly one member per batch is flagged leader.
+    widths = {}
+    for m in members:
+        widths[m["parent"]] = widths.get(m["parent"], 0) + 1
+    assert max(widths.values()) > 1
+    for bid, w in widths.items():
+        leaders = [m for m in members if m["parent"] == bid
+                   and m["attrs"]["leader"]]
+        assert len(leaders) == 1
+    # Ends balance: no convoy span leaks open.
+    ends = [r for r in recs if r.get("type") == "span_end"]
+    assert len(ends) == len(members) + len(batches)
+
+
+# -- the assembler: cross-process join, SIGKILL flagging, Perfetto -----------
+
+
+def _two_process_flights(tmp_path, *, kill_serve=False):
+    """Synthesize a router flight + a replica flight joined by a wire
+    parent — the deterministic twin of the subprocess tests. When
+    ``kill_serve``, the replica's ingress span is left OPEN (exactly
+    what a SIGKILL mid-request leaves on disk)."""
+    tid = mint_trace_id()
+    router = Telemetry.create(trace_dir=tmp_path / "router", label="router")
+    with router.span("route_request", trace=tid, source="0"):
+        fwd = router.begin_span("forward", trace=tid, replica="rep-0",
+                                attempt=1)
+        wire_parent = router.global_ref(fwd)
+        serve = Telemetry.create(trace_dir=tmp_path / "rep-0",
+                                 label="serve")
+        sid = serve.begin_span("serve_request", trace=tid,
+                               wire_parent=wire_parent, source=0)
+        qid = serve.begin_span("query", parent=sid, source=0)
+        serve.finish_span(qid)
+        if not kill_serve:
+            serve.finish_span(sid)
+            serve.close()
+        router.finish_span(fwd)
+    router.close()
+    return tid
+
+
+def test_assembler_joins_processes_single_rooted(tmp_path):
+    tid = _two_process_flights(tmp_path)
+    asm = assemble([tmp_path])
+    assert {p["label"] for p in asm["processes"]} == {"router", "serve"}
+    tr = asm["traces"][tid]
+    assert tr["single_rooted"] is True
+    assert tr["open"] == [] and tr["unresolved"] == []
+    assert set(tr["processes"]) == {"router", "serve"}
+    by_name = {s["name"]: s for s in tr["spans"]}
+    assert set(by_name) == {"route_request", "forward", "serve_request",
+                            "query"}
+    # Every span parented: the wire hop stitches the processes.
+    assert by_name["route_request"]["parent_ref"] is None
+    assert by_name["forward"]["parent_ref"] == by_name["route_request"]["ref"]
+    assert by_name["serve_request"]["wire_parent"] == by_name["forward"]["ref"]
+    assert by_name["serve_request"]["parent_ref"] == by_name["forward"]["ref"]
+    assert by_name["query"]["parent_ref"] == by_name["serve_request"]["ref"]
+    # The request tree renders with the cross-process hop labeled.
+    lines = format_request_tree(tr)
+    assert lines[0].startswith(f"trace {tid}")
+    assert any("[serve] serve_request" in ln for ln in lines)
+    # Hop summary aggregates per span name.
+    hops = hop_summary(asm)
+    assert hops["serve_request"]["count"] == 1
+    assert hops["query"]["wall_p50_s"] >= 0.0
+
+
+def test_assembler_flags_open_ingress_span_as_kill_diagnosis(tmp_path):
+    tid = _two_process_flights(tmp_path, kill_serve=True)
+    tr = assemble([tmp_path])["traces"][tid]
+    open_names = {s["name"] for s in tr["spans"] if s["open"]}
+    assert open_names == {"serve_request"}
+    assert len(tr["open"]) == 1
+    # Open is a diagnosis, not a join failure: the tree stays rooted.
+    assert tr["single_rooted"] is True
+    lines = format_request_tree(tr)
+    assert any("OPEN" in ln for ln in lines)
+    # Perfetto keeps the death point visible as a begin-only event.
+    doc = perfetto_trace(tr)
+    validate_chrome_trace(doc)
+    phases = {e["name"]: e["ph"] for e in doc["traceEvents"]
+              if e["ph"] in ("B", "X")}
+    assert phases["serve_request"] == "B"
+    assert phases["query"] == "X"
+
+
+def test_assembler_cross_trace_convoy_link_is_not_a_root(tmp_path):
+    """A follower whose convoy_member span is parented to the LEADER's
+    convoy_batch span (another trace) stays single-rooted: the member
+    is a cross-trace LINK, not an orphan."""
+    tel = Telemetry.create(trace_dir=tmp_path, label="serve")
+    tid_leader, tid_follow = mint_trace_id(), mint_trace_id()
+    lead = tel.begin_span("serve_request", trace=tid_leader, source=0)
+    batch = tel.begin_span("convoy_batch", parent=lead, width=2, traced=2)
+    m_lead = tel.begin_span("convoy_member", parent=batch,
+                            trace=tid_leader, leader=True,
+                            queue_wait_ms=0.1)
+    follow = tel.begin_span("serve_request", trace=tid_follow, source=1)
+    m_follow = tel.begin_span("convoy_member", parent=batch,
+                              trace=tid_follow, leader=False,
+                              queue_wait_ms=2.5)
+    for sid in (m_follow, follow, m_lead, batch, lead):
+        tel.finish_span(sid)
+    tel.close()
+    traces = assemble([tmp_path])["traces"]
+    assert traces[tid_leader]["single_rooted"] is True
+    assert traces[tid_leader]["linked"] == []
+    tr = traces[tid_follow]
+    assert tr["single_rooted"] is True, tr["roots"]
+    assert len(tr["roots"]) == 1 and len(tr["linked"]) == 1
+    member = next(s for s in tr["spans"] if s["ref"] == tr["linked"][0])
+    assert member["name"] == "convoy_member"
+    # The tree names where the linked span is parented.
+    assert any("linked under" in ln for ln in format_request_tree(tr))
+
+
+def test_assembler_unresolved_wire_parent_breaks_single_rooting(tmp_path):
+    """A missing upstream flight (the router's dir was not joined) must
+    be SAID, not papered over."""
+    tid = _two_process_flights(tmp_path)
+    tr = assemble([tmp_path / "rep-0"])["traces"][tid]
+    assert tr["single_rooted"] is False
+    assert len(tr["unresolved"]) == 1
+    assert tr["unresolved"][0].endswith(":" + tr["unresolved"][0].split(":")[-1])
+
+
+def test_assembler_splits_appended_sessions_per_meta(tmp_path):
+    """Flight files open in APPEND mode: a restarted process pointed at
+    the same trace dir reuses the same flight-*.jsonl — a fresh meta
+    record, span ids restarting at 1. Each record must bind to the most
+    recent meta: keying the whole file to the FIRST meta mis-attributes
+    the second session's spans, so every wire join against them reports
+    an unresolved parent (caught live by the verify drive)."""
+    tid1 = _two_process_flights(tmp_path)
+    # "Restart" router and replica: same dirs, same labels — the second
+    # session appends to the session-1 files with new proc ids.
+    tid2 = _two_process_flights(tmp_path)
+    assert tid1 != tid2
+    asm = assemble([tmp_path])
+    # 2 files x 2 sessions = 4 process records, labels preserved.
+    assert len(asm["processes"]) == 4
+    assert {p["label"] for p in asm["processes"]} == {"router", "serve"}
+    assert len({p["proc"] for p in asm["processes"]}) == 4
+    for tid in (tid1, tid2):
+        tr = asm["traces"][tid]
+        assert tr["single_rooted"] is True, tr
+        assert tr["unresolved"] == []
+        by_name = {s["name"]: s for s in tr["spans"]}
+        assert by_name["serve_request"]["parent_ref"] == \
+            by_name["forward"]["ref"]
+    # The two sessions' spans carry their OWN session's proc.
+    procs_per_trace = [
+        {s["proc"] for s in asm["traces"][tid]["spans"]}
+        for tid in (tid1, tid2)
+    ]
+    assert procs_per_trace[0].isdisjoint(procs_per_trace[1])
+
+
+# -- in-process router -> replica end-to-end ----------------------------------
+
+
+def test_router_mints_and_replica_joins_end_to_end(tmp_path):
+    g = grid2d(5, 5, seed=0)
+    n = g.num_nodes
+    exact = np.asarray(ParallelJohnsonSolver(_cfg()).solve(g).matrix)
+    fleet = tmp_path / "fleet"
+    trace_root = tmp_path / "tr"
+    store = TileStore(tmp_path / "store", g, warm_rows=n)
+    rep_tel = Telemetry.create(trace_dir=trace_root / "rep", label="serve")
+    engine = QueryEngine(g, store, config=_cfg(telemetry=rep_tel),
+                         stats_interval_s=0)
+    engine.warm(np.arange(n))
+    fe = ServeFrontend(engine, shed_policy="reject", fleet_dir=fleet,
+                       replica_id="rep-0", fleet_heartbeat_s=0.2).start()
+    router = None
+    router_tel = Telemetry.create(trace_dir=trace_root / "router",
+                                  label="router")
+    try:
+        router = FleetRouter(fleet, stale_after_s=5.0,
+                             refresh_interval_s=0.1,
+                             telemetry=router_tel).start()
+        c = _Client(router.address())
+        r = c.ask({"id": 0, "source": 3, "dst": 11})
+        c.close()
+        assert float(r["distance"]) == float(exact[3, 11])
+        tid = r["trace_id"]
+    finally:
+        if router is not None:
+            router.drain()
+        fe.drain()
+        router_tel.close()
+        rep_tel.close()
+    tr = assemble([trace_root])["traces"][tid]
+    assert tr["single_rooted"] is True, tr["roots"]
+    assert set(tr["processes"]) == {"router", "serve"}
+    names = [s["name"] for s in tr["spans"]]
+    for required in ("route_request", "forward", "serve_request"):
+        assert required in names, names
+    serve_span = next(s for s in tr["spans"]
+                      if s["name"] == "serve_request")
+    fwd = next(s for s in tr["spans"] if s["name"] == "forward")
+    assert serve_span["parent_ref"] == fwd["ref"]
+    assert not tr["open"] and not tr["unresolved"]
+
+
+# -- subprocess cross-process join (a real socket, a real process) -----------
+
+
+def _spawn_serve(tmp_path, graph_spec, store_dir, trace_dir, extra=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(REPO), env.get("PYTHONPATH")) if p)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "paralleljohnson_tpu.cli", "serve",
+         graph_spec, "--listen", "127.0.0.1:0",
+         "--store-dir", str(store_dir), "--backend", "numpy",
+         "--trace-dir", str(trace_dir), *extra],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    announce = json.loads(p.stdout.readline())
+    return p, (announce["host"], announce["port"])
+
+
+def test_cross_process_trace_join_via_subprocess(tmp_path):
+    rows = 4
+    g = grid2d(rows, rows, seed=0)
+    n = g.num_nodes
+    store_dir = tmp_path / "store"
+    seed = QueryEngine(g, TileStore(store_dir, g, warm_rows=n),
+                       config=_cfg(), stats_interval_s=0)
+    seed.warm(np.arange(n))
+    seed.close()
+    trace_root = tmp_path / "tr"
+    proc, addr = _spawn_serve(tmp_path, f"grid:rows={rows},cols={rows}",
+                              store_dir, trace_root / "replica")
+    up = Telemetry.create(trace_dir=trace_root / "up", label="router")
+    try:
+        tid = mint_trace_id()
+        with up.span("route_request", trace=tid) as span:
+            ctx = TraceContext(tid, parent=up.global_ref(span.id))
+            c = _Client(addr)
+            r = c.ask({"id": 0, "source": 1, "dst": 2,
+                       "trace": ctx.to_wire()})
+            c.close()
+        assert r["trace_id"] == tid  # the replica honored the wire id
+    finally:
+        up.close()
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    tr = assemble([trace_root])["traces"][tid]
+    assert tr["single_rooted"] is True, tr["roots"]
+    assert set(tr["processes"]) == {"router", "serve"}
+    serve_span = next(s for s in tr["spans"]
+                      if s["name"] == "serve_request")
+    root = next(s for s in tr["spans"] if s["name"] == "route_request")
+    assert serve_span["wire_parent"] == root["ref"]
+    assert not tr["open"] and not tr["unresolved"]
+
+
+@pytest.mark.slow  # real subprocess + SIGKILL mid-request
+def test_sigkill_mid_request_leaves_flagged_open_ingress(tmp_path):
+    n = 800
+    g = erdos_renyi(n, 0.01, seed=1)
+    store_dir = tmp_path / "store"
+    TileStore(store_dir, g, warm_rows=n)  # cold store: queries solve
+    trace_root = tmp_path / "tr"
+    flight = trace_root / "replica" / "flight-serve.jsonl"
+    proc, addr = _spawn_serve(tmp_path, f"er:n={n},p=0.01,seed=1",
+                              store_dir, trace_root / "replica")
+    try:
+        sock = socket.create_connection(addr, timeout=30)
+        f = sock.makefile("rw", encoding="utf-8", newline="\n")
+        f.readline()  # header
+        f.write(json.dumps({"id": 0, "source": 0, "dst": 1}) + "\n")
+        f.flush()
+        # The flight is flushed per record: wait for the ingress span
+        # to open, then kill while the scheduled solve is in flight.
+        deadline = time.monotonic() + 30.0
+        opened = False
+        while time.monotonic() < deadline:
+            if flight.exists() and "serve_request" in flight.read_text(
+                    encoding="utf-8"):
+                opened = True
+                break
+            time.sleep(0.005)
+        assert opened, "ingress span never reached the flight file"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        sock.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    asm = assemble([trace_root])
+    (tid, tr), = [(k, v) for k, v in asm["traces"].items()]
+    assert any(s["name"] == "serve_request" and s["open"]
+               for s in tr["spans"]), tr["spans"]
+    assert tr["open"], "the kill left no flagged open span"
+    assert any("OPEN" in ln for ln in format_request_tree(tr))
+
+
+# -- offline tools: trace_assemble.py / trace_summary.py --request ------------
+
+
+def _run_script(script, *argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, str(REPO / "scripts" / script), *argv],
+        capture_output=True, text=True, env=env)
+
+
+def test_trace_assemble_script_check_perfetto_and_regress_rows(tmp_path):
+    tid = _two_process_flights(tmp_path / "flights")
+    out_dir = tmp_path / "perfetto"
+    rows = tmp_path / "hops.jsonl"
+    res = _run_script("trace_assemble.py", str(tmp_path / "flights"),
+                      "--check", "--json",
+                      "--perfetto-dir", str(out_dir),
+                      "--regress-out", str(rows),
+                      "--bench", "unit", "--backend", "numpy",
+                      "--platform", "cpu", "--preset", "smoke")
+    assert res.returncode == 0, res.stderr
+    summary = json.loads(res.stdout)
+    assert summary["traces"] == 1 and summary["single_rooted"] == 1
+    doc = json.loads((out_dir / f"trace-{tid}.json").read_text())
+    validate_chrome_trace(doc)
+    hop_rows = [json.loads(ln) for ln in
+                rows.read_text().strip().splitlines()]
+    assert {r["hop"] for r in hop_rows} >= {"serve_request", "forward"}
+    assert all(r["kind"] == "trace" and r["bench"] == "unit"
+               for r in hop_rows)
+    # The rows normalize into gradeable history entries.
+    normed = [row for r in hop_rows for row in normalize_record(r)]
+    assert all(row["bench"].startswith("trace:unit:") for row in normed)
+
+
+def test_trace_assemble_check_fails_on_broken_join(tmp_path):
+    _two_process_flights(tmp_path / "flights")
+    # Joining ONLY the replica dir leaves the wire parent unresolved.
+    res = _run_script("trace_assemble.py",
+                      str(tmp_path / "flights" / "rep-0"), "--check")
+    assert res.returncode == 1
+    assert "unresolved" in (res.stdout + res.stderr)
+    # Zero traces is a failure too, not a silent pass.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert _run_script("trace_assemble.py", str(empty),
+                       "--check").returncode == 1
+
+
+def test_trace_summary_request_mode_prints_span_tree(tmp_path):
+    tid = _two_process_flights(tmp_path / "flights")
+    res = _run_script("trace_summary.py", "--request", tid,
+                      "--merge", str(tmp_path / "flights"))
+    assert res.returncode == 0, res.stderr
+    assert f"trace {tid}" in res.stdout
+    for name in ("route_request", "forward", "serve_request", "query"):
+        assert name in res.stdout
+    # Unknown id: explicit error + the available ids named.
+    miss = _run_script("trace_summary.py", "--request", "0" * 16,
+                       "--merge", str(tmp_path / "flights"))
+    assert miss.returncode == 2
+    assert tid in (res.stdout + miss.stderr)
+
+
+# -- exemplars: histogram tail + OpenMetrics suffix ---------------------------
+
+
+def test_histogram_exemplars_survive_dict_roundtrip_and_merge():
+    h = LogHistogram()
+    for i, v in enumerate((1.0, 2.0, 150.0, 170.0, 900.0)):
+        h.record(v, exemplar=f"trace{i:04x}")
+    h.record(3.0)  # no exemplar recorded for untraced observations
+    tail = h.tail_exemplars(limit=3)
+    assert tail[0][0] == "trace0004"  # slowest bucket first
+    assert len(tail) == 3
+    doc = h.as_dict()
+    assert doc["exemplars"]
+    from paralleljohnson_tpu.observe.live import tail_exemplars_from_dict
+    assert tail_exemplars_from_dict(doc, limit=3) == [
+        (e, float(v)) for e, v in tail]
+    back = LogHistogram.from_dict(doc)
+    assert back.tail_exemplars(limit=3) == tail
+    merged = back.merge(LogHistogram.from_dict(doc))
+    assert merged.tail_exemplars()[0][0] == "trace0004"
+
+
+def test_prom_exemplars_on_bucket_lines_only(tmp_path):
+    h = LogHistogram()
+    h.record(5.0, exemplar="cafe0123beef4567")
+    h.record(250.0, exemplar="cafe0123beef4568")
+    table = (
+        ("pjtpu_test_latency_ms", "histogram", "unit-test latency",
+         lambda s: h),
+        ("pjtpu_test_total", "counter", "unit-test counter",
+         lambda s: 3.0),
+    )
+    # Off by default: no suffix anywhere.
+    p = write_prom_metrics(None, tmp_path / "plain.prom", metrics=table)
+    plain = p.read_text(encoding="utf-8")
+    assert "# {" not in plain.replace("# HELP", "").replace("# TYPE", "")
+    validate_prom_text(plain)
+    # On: the suffix rides bucket lines and still validates.
+    p = write_prom_metrics(None, tmp_path / "ex.prom", metrics=table,
+                           exemplars=True)
+    text = p.read_text(encoding="utf-8")
+    bucket_ex = [ln for ln in text.splitlines()
+                 if "_bucket" in ln and '# {trace_id="' in ln]
+    assert len(bucket_ex) == 2
+    validate_prom_text(text)
+    # Negative: an exemplar anywhere but a histogram bucket is rejected.
+    bad = text.replace("pjtpu_test_total 3.0",
+                       'pjtpu_test_total 3.0 # {trace_id="x"} 3.0')
+    with pytest.raises(ValueError, match="exemplar"):
+        validate_prom_text(bad)
+    bad_sum = text.replace(
+        "pjtpu_test_latency_ms_sum",
+        'pjtpu_test_latency_ms_count 2.0 # {trace_id="y"} 1.0\n'
+        "pjtpu_test_latency_ms_sum", 1)
+    with pytest.raises(ValueError):
+        validate_prom_text(bad_sum)
+
+
+# -- regression grading of per-hop trace rows ---------------------------------
+
+
+def _hop_row(wall_s, qw_ms):
+    return {"bench": "trace:serve_fleet:convoy_member",
+            "backend": "numpy", "platform": "cpu", "preset": "smoke",
+            "wall_s": wall_s,
+            "detail": {"hop": "convoy_member", "count": 40, "open": 0,
+                       "queue_wait_p50_ms": qw_ms}}
+
+
+def test_regress_flags_doubled_convoy_queue_wait_naming_the_hop():
+    history = [_hop_row(0.002, 5.0) for _ in range(3)]
+    flags = detect_regressions([_hop_row(0.002, 12.0)], history)
+    assert len(flags) == 1
+    f = flags[0]
+    assert f["kind"] == "trace" and f["axis"] == "queue_wait"
+    assert f["hop"] == "convoy_member"
+    assert "convoy_member" in f["why"] and "queue-wait" in f["why"]
+    # Within band: clean. (The 50% trace band + 2ms absolute floor.)
+    assert detect_regressions([_hop_row(0.002, 6.0)], history) == []
+    # The hop's p50 wall grades on its own axis.
+    wall_flags = detect_regressions([_hop_row(0.06, 5.0)], history)
+    assert [f["axis"] for f in wall_flags] == ["wall"]
+    assert "convoy_member" in wall_flags[0]["why"]
+    # Hop rows never leak into the plain-bench wall baseline.
+    plain_hist = [{"bench": "b", "backend": "numpy", "platform": "cpu",
+                   "preset": "smoke", "wall_s": 1.0} for _ in range(3)]
+    assert detect_regressions(
+        [{"bench": "b", "backend": "numpy", "platform": "cpu",
+          "preset": "smoke", "wall_s": 1.05, "detail": {}}],
+        plain_hist + history) == []
